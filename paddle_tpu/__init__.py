@@ -1,0 +1,111 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference snapshot ≈ v2.0/2.1-dev).
+
+Not a port: eager tensors + a vjp tape replace the C++ dygraph engine,
+``paddle_tpu.jit`` (to_static) replaces ProgramDesc/Executor with XLA capture,
+and ``paddle_tpu.distributed`` replaces NCCL rings with jax.sharding meshes
+over ICI/DCN.  See SURVEY.md at the repo root for the layer-by-layer mapping.
+"""
+from __future__ import annotations
+
+import os as _os
+
+# Full dtype coverage (float64/int64 ops exist in the reference); jax's
+# default truncates to 32-bit.  Creation APIs still default to float32
+# (paddle semantics), so TPU-hot code stays 32/16-bit.
+import jax as _jax
+_jax.config.update("jax_enable_x64", True)
+
+from paddle_tpu.core import (  # noqa: F401,E402
+    Tensor, Parameter, CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace,
+    XPUPlace, set_device, get_device, device_count, no_grad, enable_grad,
+    is_grad_enabled, set_grad_enabled, get_default_dtype, set_default_dtype,
+    convert_dtype, VarDesc,
+)
+from paddle_tpu import autograd  # noqa: E402,F401
+from paddle_tpu.autograd import grad  # noqa: E402,F401
+from paddle_tpu.tensor import *  # noqa: F401,F403,E402
+from paddle_tpu.tensor import add_n, einsum  # noqa: E402,F401
+from paddle_tpu.tensor.random import (  # noqa: E402,F401
+    seed, get_rng_state, set_rng_state, default_generator, Generator)
+
+import paddle_tpu.tensor as tensor  # noqa: E402,F401
+
+# dtype singletons, paddle.float32-style
+import jax.numpy as _jnp  # noqa: E402
+float16 = _jnp.dtype(_jnp.float16)
+bfloat16 = _jnp.dtype(_jnp.bfloat16)
+float32 = _jnp.dtype(_jnp.float32)
+float64 = _jnp.dtype(_jnp.float64)
+int8 = _jnp.dtype(_jnp.int8)
+uint8 = _jnp.dtype(_jnp.uint8)
+int16 = _jnp.dtype(_jnp.int16)
+int32 = _jnp.dtype(_jnp.int32)
+int64 = _jnp.dtype(_jnp.int64)
+bool = _jnp.dtype(_jnp.bool_)  # noqa: A001 — paddle exposes paddle.bool
+complex64 = _jnp.dtype(_jnp.complex64)
+complex128 = _jnp.dtype(_jnp.complex128)
+
+from paddle_tpu import nn  # noqa: E402,F401
+from paddle_tpu import regularizer  # noqa: E402,F401
+from paddle_tpu import optimizer  # noqa: E402,F401
+from paddle_tpu import framework  # noqa: E402,F401
+from paddle_tpu import io  # noqa: E402,F401
+from paddle_tpu import metric  # noqa: E402,F401
+from paddle_tpu import amp  # noqa: E402,F401
+from paddle_tpu import jit  # noqa: E402,F401
+from paddle_tpu import static  # noqa: E402,F401
+from paddle_tpu.framework.io import save, load  # noqa: E402,F401
+from paddle_tpu.hapi.model import Model  # noqa: E402,F401
+from paddle_tpu.hapi import summary, flops  # noqa: E402,F401
+from paddle_tpu.nn.layer.common import ParamAttr  # noqa: E402,F401
+
+__version__ = "0.1.0"
+
+
+def is_compiled_with_cuda() -> bool:
+    """False: there is no CUDA here — use is_compiled_with_tpu()."""
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    from paddle_tpu.core import _accelerator_platform
+    return _accelerator_platform() is not None
+
+
+def in_dynamic_mode() -> bool:
+    return not static._in_static_mode()
+
+
+def enable_static():
+    static._enable_static()
+
+
+def disable_static():
+    static._disable_static()
+
+
+def disable_signal_handler():
+    pass
+
+
+def set_grad_enabled_(mode):
+    set_grad_enabled(mode)
+
+
+def get_flags(flags):
+    from paddle_tpu.framework import flags as _flags
+    return _flags.get_flags(flags)
+
+
+def set_flags(flags):
+    from paddle_tpu.framework import flags as _flags
+    return _flags.set_flags(flags)
+
+
+def summary_(*a, **k):  # placeholder to avoid name clash
+    raise NotImplementedError

@@ -1,0 +1,207 @@
+"""Reverse-mode engine over the eager tape.
+
+Replaces the reference's ``imperative::BasicEngine`` (reference:
+paddle/fluid/imperative/basic_engine.cc — Init :39, PrepareDeps :235,
+Execute :305) and ``PartialGradEngine`` (partial_grad_engine.cc, backing
+``paddle.grad``).  The walk is a straightforward reverse-topological sweep:
+each TapeNode holds the eager ``jax.vjp`` pullback for the op, cotangents are
+accumulated per output, and leaf Tensors receive ``.grad`` (sum-accumulation,
+≈ imperative/gradient_accumulator.cc).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import (Tensor, TapeNode, no_grad, enable_grad,
+                             is_grad_enabled, set_grad_enabled)
+
+__all__ = ["backward", "backward_from", "grad", "no_grad", "enable_grad",
+           "is_grad_enabled", "set_grad_enabled"]
+
+
+def _topo_order(roots: Sequence[TapeNode]) -> List[TapeNode]:
+    """Postorder DFS (iterative) → reverse = topological order from outputs."""
+    order: List[TapeNode] = []
+    seen = set()
+    stack = [(n, False) for n in roots if n is not None]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in seen:
+                stack.append((t._node, False))
+    return order
+
+
+def _run_engine(root_tensors, root_grads, retain_graph=False,
+                accumulate_into_grad=True, capture=None):
+    """Shared sweep.  ``capture``: optional dict id(tensor)->None to also
+    collect cotangents for non-leaf tensors (paddle.grad path)."""
+    roots = [t._node for t in root_tensors if t._node is not None]
+    order = _topo_order(roots)
+
+    # cotangent store per node-output and per leaf tensor
+    node_cots = {}   # id(node) -> list of arrays per output slot
+    leaf_cots = {}   # id(tensor) -> array
+
+    _leaf_refs = {}
+
+    def add_cotangent(t: Tensor, c):
+        if capture is not None and id(t) in capture:
+            prev = capture.get(id(t))
+            capture[id(t)] = c if prev is None else prev + c
+        if t._node is None:
+            if not t.stop_gradient and accumulate_into_grad:
+                key = id(t)
+                leaf_cots[key] = c if key not in leaf_cots else leaf_cots[key] + c
+                _leaf_refs[key] = t
+        else:
+            node = t._node
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time, but "
+                    "the saved intermediate results have already been freed. "
+                    "Specify retain_graph=True on the first backward() call.")
+            slots = node_cots.setdefault(id(node), [None] * len(node.outputs))
+            idx = t._out_index
+            slots[idx] = c if slots[idx] is None else slots[idx] + c
+
+    for t, g in zip(root_tensors, root_grads):
+        add_cotangent(t, g)
+
+    for node in reversed(order):
+        slots = node_cots.get(id(node))
+        if slots is None:
+            continue
+        # materialise missing output cotangents as zeros
+        cots = []
+        for ref, c in zip(node.outputs, slots):
+            if c is not None:
+                cots.append(c)
+            else:
+                t = ref()
+                if t is None:
+                    # output died and nothing flowed into it; a dead output
+                    # cannot have received a cotangent — zeros are correct,
+                    # but we need its aval; vjp accepts zeros of primal shape
+                    # which we cannot recover, so this situation only occurs
+                    # for unused multi-outputs kept alive by the node itself.
+                    raise RuntimeError(
+                        f"backward: lost output of node {node.name}")
+                cots.append(jnp.zeros(t._data.shape, t._data.dtype))
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time, but the "
+                "saved intermediate results have already been freed. Specify "
+                "retain_graph=True on the first backward() call.")
+        if len(cots) == 1:
+            in_grads = node.vjp_fn(cots[0])
+        else:
+            in_grads = node.vjp_fn(tuple(cots))
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if t._hooks:
+                gt = Tensor(g)
+                for hook in list(t._hooks):
+                    res = hook(gt)
+                    if res is not None:
+                        gt = res if isinstance(res, Tensor) else Tensor(res)
+                g = gt._data
+            add_cotangent(t, g)
+        if not retain_graph:
+            node.vjp_fn = None
+
+    # write .grad on leaves
+    for key, arr in leaf_cots.items():
+        t = _leaf_refs[key]
+        if t._grad is None:
+            t._grad = Tensor(arr)
+        else:
+            t._grad = Tensor(t._grad._data + arr)
+
+    if not retain_graph:
+        for node in order:
+            node.inputs = []
+            node.outputs = []
+
+
+def backward_from(tensor: Tensor, grad_tensor=None, retain_graph=False):
+    if tensor.stop_gradient and tensor._node is None:
+        raise RuntimeError(
+            "backward() on a tensor with stop_gradient=True and no graph")
+    if grad_tensor is None:
+        g = jnp.ones(tensor._data.shape, tensor._data.dtype)
+    else:
+        g = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    _run_engine([tensor], [g], retain_graph=retain_graph)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    gs = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            gs.append(jnp.ones(t._data.shape, t._data.dtype))
+        else:
+            gs.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+    _run_engine(tensors, gs, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """``paddle.grad`` parity (reference: imperative/partial_grad_engine.cc).
+
+    Returns grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``.
+    ``create_graph`` (double backward) is not supported on the eager tape —
+    use the functional ``paddle_tpu.incubate.autograd`` / raw jax.grad for
+    higher-order derivatives.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use "
+            "jax.grad composition via paddle_tpu.jit for higher-order grads")
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if retain_graph is None:
+        retain_graph = False
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    gs = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            gs.append(jnp.ones(t._data.shape, t._data.dtype))
+        else:
+            gs.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
+    capture = {id(t): None for t in inputs}
+    _run_engine(outputs, gs, retain_graph=retain_graph,
+                accumulate_into_grad=False, capture=capture)
+    results = []
+    for t in inputs:
+        c = capture[id(t)]
+        if c is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input tensor {t.name} is unused in the graph "
+                    "(pass allow_unused=True to get None)")
+            results.append(None)
+        else:
+            results.append(Tensor(c))
+    return results

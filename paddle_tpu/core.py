@@ -1,0 +1,650 @@
+"""Core runtime: Tensor facade, eager autograd tape, dtype/device plumbing.
+
+This is the TPU-native replacement for the reference's C++ core:
+
+- ``Tensor`` plays the role of ``imperative::VarBase`` (reference:
+  paddle/fluid/imperative/layer.h:66) — an eager tensor carrying autograd
+  metadata — but wraps a ``jax.Array`` instead of an allocator-backed buffer.
+- The tape (``TapeNode`` + ``apply``) replaces ``Tracer::TraceOp`` recording a
+  grad-op graph (reference: paddle/fluid/imperative/tracer.cc:132,205): every
+  differentiable op is routed through ``jax.vjp`` eagerly, and ``backward()``
+  replaces ``BasicEngine::Execute`` (reference:
+  paddle/fluid/imperative/basic_engine.cc:305) with a reverse-topological walk.
+- There is no Place/DeviceContext/Allocator layer (reference:
+  paddle/fluid/platform/device_context.h, paddle/fluid/memory/) — XLA/PJRT owns
+  streams and device memory. ``CPUPlace``/``TPUPlace`` survive as thin device
+  handles for API parity only.
+
+Design note (TPU-first): eager mode executes op-by-op through jax's cached
+dispatch; the performance path is whole-step capture via ``paddle_tpu.jit``
+(to_static) where forward+backward+update fuse into one XLA computation.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "apply",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPlace",
+    "CUDAPinnedPlace",
+    "XPUPlace",
+    "set_device",
+    "get_device",
+    "device_count",
+    "convert_dtype",
+    "get_default_dtype",
+    "set_default_dtype",
+    "VarDesc",
+]
+
+# ---------------------------------------------------------------------------
+# dtype system
+# ---------------------------------------------------------------------------
+
+# Mirrors the reference's proto dtype enum surface (framework.proto:107-125)
+# without the proto: everything is a numpy/jax dtype under the hood.
+_DTYPE_ALIASES = {
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float": jnp.float32,
+    "float64": jnp.float64,
+    "fp64": jnp.float64,
+    "double": jnp.float64,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "int": jnp.int32,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalise any dtype spec (str / np.dtype / jnp dtype / Tensor dtype)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key in _DTYPE_ALIASES:
+            return jnp.dtype(_DTYPE_ALIASES[key])
+        return jnp.dtype(key)
+    return jnp.dtype(dtype)
+
+
+_default_dtype = jnp.dtype(jnp.float32)
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16),
+                 jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
+        raise TypeError(
+            "set_default_dtype only supports float16/bfloat16/float32/float64, "
+            f"got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+class VarDesc:
+    """Compat shim: ``VarDesc.VarType.FP32``-style dtype enums.
+
+    The reference exposes proto enums (framework.proto:107); user code sometimes
+    touches them. Here they are just jnp dtypes.
+    """
+
+    class VarType:
+        FP16 = jnp.dtype(jnp.float16)
+        BF16 = jnp.dtype(jnp.bfloat16)
+        FP32 = jnp.dtype(jnp.float32)
+        FP64 = jnp.dtype(jnp.float64)
+        INT8 = jnp.dtype(jnp.int8)
+        UINT8 = jnp.dtype(jnp.uint8)
+        INT16 = jnp.dtype(jnp.int16)
+        INT32 = jnp.dtype(jnp.int32)
+        INT64 = jnp.dtype(jnp.int64)
+        BOOL = jnp.dtype(jnp.bool_)
+        COMPLEX64 = jnp.dtype(jnp.complex64)
+        COMPLEX128 = jnp.dtype(jnp.complex128)
+
+
+# ---------------------------------------------------------------------------
+# Places / device handles (API parity with platform/place.h)
+# ---------------------------------------------------------------------------
+
+
+class _Place:
+    _kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._device_id == other._device_id)
+
+    def __hash__(self):
+        return hash((self._kind, self._device_id))
+
+    def __repr__(self):
+        if self._kind == "cpu":
+            return "Place(cpu)"
+        return f"Place({self._kind}:{self._device_id})"
+
+
+class CPUPlace(_Place):
+    _kind = "cpu"
+
+
+class TPUPlace(_Place):
+    _kind = "tpu"
+
+
+class CUDAPlace(TPUPlace):
+    """Alias of TPUPlace: code written against CUDAPlace runs on the TPU chip."""
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    _kind = "cpu"
+
+
+class XPUPlace(TPUPlace):
+    _kind = "tpu"
+
+
+_current_device: Optional[str] = None
+_device_lock = threading.Lock()
+
+
+def _accelerator_platform() -> Optional[str]:
+    for plat in ("tpu", "axon", "gpu"):
+        try:
+            if jax.devices(plat):
+                return plat
+        except RuntimeError:
+            continue
+    return None
+
+
+def get_device() -> str:
+    """'tpu:0' when an accelerator is attached, else 'cpu'."""
+    global _current_device
+    if _current_device is None:
+        with _device_lock:
+            if _current_device is None:
+                plat = _accelerator_platform()
+                _current_device = "tpu:0" if plat else "cpu"
+    return _current_device
+
+
+def set_device(device: str):
+    """Parity with paddle.set_device; accepts 'cpu', 'tpu', 'tpu:N', 'gpu'...
+
+    'gpu' is accepted and mapped onto the TPU chip so reference-style scripts
+    run unchanged.
+    """
+    global _current_device
+    device = device.lower()
+    if device in ("gpu", "cuda", "xpu"):
+        device = "tpu"
+    if device.startswith(("gpu:", "cuda:", "xpu:")):
+        device = "tpu:" + device.split(":", 1)[1]
+    if device == "tpu":
+        device = "tpu:0"
+    if device != "cpu" and not device.startswith("tpu:"):
+        raise ValueError(f"unsupported device {device!r}")
+    if device.startswith("tpu:") and _accelerator_platform() is None:
+        # graceful: fall back to cpu when no chip is attached (tests, CI)
+        device = "cpu"
+    _current_device = device
+    return _place_of(device)
+
+
+def _place_of(device: str) -> _Place:
+    if device == "cpu":
+        return CPUPlace()
+    return TPUPlace(int(device.split(":")[1]))
+
+
+def device_count() -> int:
+    plat = _accelerator_platform()
+    return len(jax.devices(plat)) if plat else len(jax.devices())
+
+
+def _default_jax_device():
+    dev = get_device()
+    if dev == "cpu":
+        return jax.devices("cpu")[0]
+    plat = _accelerator_platform()
+    idx = int(dev.split(":")[1])
+    devices = jax.devices(plat)
+    return devices[min(idx, len(devices) - 1)]
+
+
+# ---------------------------------------------------------------------------
+# grad mode
+# ---------------------------------------------------------------------------
+
+_grad_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_grad_state, "enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _grad_state.enabled = bool(mode)
+
+
+class _GradModeGuard(contextlib.ContextDecorator):
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+def no_grad(func=None):
+    """Context-manager *and* decorator, parity with paddle.no_grad."""
+    if func is None:
+        return _GradModeGuard(False)
+    return _GradModeGuard(False)(func)
+
+
+def enable_grad(func=None):
+    if func is None:
+        return _GradModeGuard(True)
+    return _GradModeGuard(True)(func)
+
+
+# ---------------------------------------------------------------------------
+# autograd tape
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded differentiable op (≈ imperative::GradOpNode,
+    reference: paddle/fluid/imperative/layer.h + tracer.cc:205)."""
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, outputs, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list[Tensor] (differentiable inputs)
+        self.outputs = outputs        # list[weakref to output Tensors]
+        self.name = name
+
+
+def _is_float_dtype(d) -> bool:
+    return jnp.issubdtype(d, jnp.floating) or jnp.issubdtype(d, jnp.complexfloating)
+
+
+class Tensor:
+    """Eager tensor wrapping a jax.Array (or a jax tracer under to_static).
+
+    API parity target: the reference's dygraph VarBase as surfaced through
+    python/paddle/fluid/dygraph/varbase_patch_methods.py (``backward`` :166,
+    ``gradient``, ``clear_gradient``) plus the ~200 tensor methods patched in
+    python/paddle/tensor/.  Methods are attached by
+    ``paddle_tpu.tensor._patch_tensor_methods`` to keep this file small.
+    """
+
+    __slots__ = ("_data", "stop_gradient", "_grad", "_node", "_out_index",
+                 "name", "persistable", "trainable", "is_leaf_", "_hooks",
+                 "__weakref__", "__dict__")
+
+    _name_counter = [0]
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None,
+                 persistable=False):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not _is_tracer(data):
+            # python floats/lists default to the framework dtype (float32);
+            # explicit numpy arrays keep their dtype (paddle semantics)
+            was_ndarray = isinstance(data, np.ndarray)
+            data = np.asarray(data)
+            if dtype is None and data.dtype == np.float64 and not was_ndarray:
+                data = data.astype(_default_dtype)
+            data = jnp.asarray(data, dtype=convert_dtype(dtype))
+        elif dtype is not None:
+            data = data.astype(convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_index = 0
+        self.persistable = persistable
+        self.trainable = True
+        self.is_leaf_ = True
+        self._hooks = None
+        if name is None:
+            Tensor._name_counter[0] += 1
+            name = f"generated_tensor_{Tensor._name_counter[0]}"
+        self.name = name
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else value
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._data.dtype)
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def place(self):
+        dev = get_device()
+        return _place_of(dev)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return np.asarray(self._data).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_str = "stop_gradient=True" if self.stop_gradient else "stop_gradient=False"
+        try:
+            value = np.asarray(self._data)
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                    f"place={self.place}, {grad_str},\n       {value})")
+        except Exception:
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                    f"{grad_str}, <traced>)")
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is "
+                "ambiguous")
+        return bool(np.asarray(self._data))
+
+    def __int__(self):
+        return int(np.asarray(self._data))
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def __index__(self):
+        return int(np.asarray(self._data))
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    # -- autograd -----------------------------------------------------------
+    def register_hook(self, hook: Callable):
+        """Gradient hook, parity with VarBase hooks (imperative/hooks.h)."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        handle = _HookHandle(self._hooks, hook)
+        return handle
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        """Reverse sweep (≈ BasicEngine::Execute, basic_engine.cc:305)."""
+        from paddle_tpu import autograd as _ag
+        _ag.backward_from(self, grad_tensor, retain_graph)
+
+    def gradient(self):
+        return None if self._grad is None else self._grad.numpy()
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._data))
+        else:
+            self._grad = None
+
+    clear_grad = clear_gradient
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def clone(self) -> "Tensor":
+        return apply(lambda x: x + 0, self, name="clone")[0] if not (
+            self.stop_gradient or not is_grad_enabled()) else Tensor(
+                self._data, stop_gradient=self.stop_gradient)
+
+    # -- mutation (leaf only) ----------------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, dtype=self.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}")
+        self._data = value
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    # -- device/dtype movement ---------------------------------------------
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def cuda(self, device_id=0):
+        return self.tpu(device_id)
+
+    def tpu(self, device_id=0):
+        plat = _accelerator_platform()
+        if plat is None:
+            return self
+        return Tensor(jax.device_put(self._data, jax.devices(plat)[device_id]),
+                      stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def block_until_ready(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable tensor (≈ framework::Parameter / ParamBase).
+
+    ``stop_gradient`` defaults to False; ``trainable`` mirrors the reference's
+    ParamAttr.trainable.
+    """
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name, persistable=True)
+        self.trainable = trainable
+        self.is_leaf_ = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+class _HookHandle:
+    def __init__(self, hooks, hook):
+        self._hooks = hooks
+        self._hook = hook
+
+    def remove(self):
+        try:
+            self._hooks.remove(self._hook)
+        except ValueError:
+            pass
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+# ---------------------------------------------------------------------------
+# op application — the single entry point every op goes through
+# ---------------------------------------------------------------------------
+
+# amp autocast hook, installed by paddle_tpu.amp when a level is active
+# (≈ AmpOperators consultation inside Tracer::TraceOp, amp_auto_cast.cc)
+_amp_hook = [None]
+
+
+def apply(fn: Callable, *args, name: str = "", nondiff: Sequence[int] = (),
+          **kwargs):
+    """Run a pure-jax ``fn`` over a mix of Tensors/arrays/python values.
+
+    Replaces ``Tracer::TraceOp`` (tracer.cc:132): executes now, and if grad
+    mode is on and any Tensor input requires grad, records a TapeNode whose
+    pullback is the eager ``jax.vjp`` of ``fn`` (restricted to the
+    differentiable tensor positions).
+
+    Returns a tuple of output Tensors (matching fn's output structure
+    flattened); callers unpack.  ``nondiff`` marks positional tensor args to
+    exclude from differentiation (e.g. integer indices).
+    """
+    if _amp_hook[0] is not None:
+        args = _amp_hook[0](name or getattr(fn, "__name__", "op"), args)
+    tensor_pos = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            tensor_pos.append(i)
+    grad_pos = [
+        i for i in tensor_pos
+        if i not in nondiff and not args[i].stop_gradient
+        and _is_float_dtype(args[i].dtype)
+    ]
+    track = is_grad_enabled() and bool(grad_pos)
+
+    frozen = list(args)
+    for i in tensor_pos:
+        frozen[i] = frozen[i]._data
+
+    if not track:
+        out = fn(*frozen, **kwargs)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    grad_arrays = [args[i]._data for i in grad_pos]
+
+    def pure(*darrs):
+        full = list(frozen)
+        for i, arr in zip(grad_pos, darrs):
+            full[i] = arr
+        return fn(*full, **kwargs)
+
+    out, vjp_fn = jax.vjp(pure, *grad_arrays)
+    outs = _wrap_outputs(out, stop_gradient=False)
+    node = TapeNode(vjp_fn, [args[i] for i in grad_pos],
+                    [weakref.ref(t) for t in outs], name=name or getattr(
+                        fn, "__name__", "op"))
+    for idx, t in enumerate(outs):
+        t._node = node
+        t._out_index = idx
+        t.is_leaf_ = False
+    return outs
+
+
+def _wrap_outputs(out, stop_gradient: bool):
+    if isinstance(out, (tuple, list)):
+        return tuple(
+            Tensor(o, stop_gradient=stop_gradient) if not isinstance(o, Tensor)
+            else o for o in out)
+    return (Tensor(out, stop_gradient=stop_gradient),)
+
+
+def apply1(fn, *args, **kwargs) -> Tensor:
+    """apply() for single-output ops."""
+    return apply(fn, *args, **kwargs)[0]

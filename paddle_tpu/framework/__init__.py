@@ -1,0 +1,24 @@
+"""Framework-level utilities: flags, io, RNG re-exports.
+
+The reference's L3 core (ProgramDesc/Executor/Scope) has no equivalent here —
+XLA is that machinery.  What remains framework-level is the typed flag/config
+system (replacing gflags + env bootstrap, reference: platform/flags.cc,
+pybind/global_value_getter_setter.cc:330) and serialization.
+"""
+from paddle_tpu.framework import flags  # noqa: F401
+from paddle_tpu.framework.io import save, load  # noqa: F401
+from paddle_tpu.tensor.random import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, default_generator, Generator)
+from paddle_tpu.core import (  # noqa: F401
+    Tensor, Parameter, CPUPlace, TPUPlace, CUDAPlace, get_default_dtype,
+    set_default_dtype, no_grad)
+
+
+def _current_expected_place():
+    from paddle_tpu.core import get_device, _place_of
+    return _place_of(get_device())
+
+
+def in_dygraph_mode():
+    from paddle_tpu import static
+    return not static._in_static_mode()

@@ -1,0 +1,72 @@
+"""Typed global flag registry.
+
+One config system replacing the reference's gflags (126 DEFINE_* across
+platform/flags.cc etc.) + env-var bootstrap (python/paddle/fluid/__init__.py
+__bootstrap__) + runtime get/set (pybind/global_value_getter_setter.cc:330,
+surfaced as paddle.set_flags/get_flags).  Flags here are typed, env-seeded
+(FLAGS_<name>), and readable/writable at runtime.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_registry: Dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    with _lock:
+        _registry[name] = value
+    return value
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _registry:
+            raise ValueError(f"unknown flag {n}")
+        out[n] = _registry[key]
+    return out
+
+
+def set_flags(flags: dict):
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _registry:
+            raise ValueError(f"unknown flag {n}")
+        with _lock:
+            _registry[key] = v
+
+
+def flag(name: str):
+    return _registry[name]
+
+
+# the flags the reference exposes that still mean something on TPU
+define_flag("check_nan_inf", False,
+            "per-op NaN/Inf watcher (ref: FLAGS_check_nan_inf, "
+            "framework/details/nan_inf_utils.h)")
+define_flag("benchmark", False, "sync + time every op")
+define_flag("paddle_num_threads", 1, "host threads for data feeding")
+define_flag("use_bf16_matmul", True,
+            "allow bf16 matmul accumulation on MXU where AMP is active")
+define_flag("cudnn_deterministic", False,
+            "accepted for compat; XLA on TPU is deterministic by default")
+define_flag("max_inplace_grad_add", 0, "compat no-op")
+define_flag("conv_workspace_size_limit", 512, "compat no-op")

@@ -1,0 +1,73 @@
+"""paddle.save / paddle.load parity.
+
+Reference: python/paddle/framework/io.py:351 (save), :515 (load) — pickle of
+nested state dicts with a tensor protocol.  Here tensors serialise as numpy
+arrays inside a pickle; ``.pdparams``/``.pdopt`` conventions are preserved so
+reference-style checkpointing code runs unchanged.  Sharded/distributed
+checkpointing lives in paddle_tpu.distributed.checkpoint (orbax-style).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from paddle_tpu.core import Tensor, Parameter
+
+
+_SENTINEL = b"PTPU1"
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return {"__ptpu_tensor__": True,
+                "data": np.asarray(obj._data),
+                "name": obj.name,
+                "stop_gradient": obj.stop_gradient,
+                "is_param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__ptpu_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_param") else Tensor
+            if cls is Parameter:
+                t = Parameter(obj["data"], name=obj["name"])
+            else:
+                t = Tensor(obj["data"], stop_gradient=obj["stop_gradient"],
+                           name=obj["name"])
+            return t
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    if isinstance(path, str):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+    payload = _to_serializable(obj)
+    with open(path, "wb") as f:
+        f.write(_SENTINEL)
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    with open(path, "rb") as f:
+        head = f.read(len(_SENTINEL))
+        if head != _SENTINEL:
+            f.seek(0)
+        payload = pickle.load(f)
+    return _from_serializable(payload, return_numpy=return_numpy)

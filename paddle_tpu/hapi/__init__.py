@@ -1,0 +1,8 @@
+"""High-level API (parity: python/paddle/hapi/ — model.py:810 Model,
+callbacks.py, model_summary.py)."""
+from paddle_tpu.hapi.model import Model  # noqa: F401
+from paddle_tpu.hapi.model_summary import summary, flops  # noqa: F401
+from paddle_tpu.hapi import callbacks  # noqa: F401
+
+summary = summary
+flops = flops

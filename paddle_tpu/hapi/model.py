@@ -1,0 +1,254 @@
+"""hapi.Model (parity: python/paddle/hapi/model.py:810 Model, :1299 fit,
+:1515 evaluate, :1596 predict).
+
+TPU-first: train_batch runs through paddle_tpu.jit.TrainStep (one fused XLA
+step) when possible, falling back to eager tape for exotic losses.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from paddle_tpu.core import Tensor, no_grad
+from paddle_tpu.hapi.callbacks import CallbackList, ProgBarLogger
+from paddle_tpu.metric import Metric
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._train_step = None
+        self._amp_level = None
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level")
+        return self
+
+    # -- per-batch -----------------------------------------------------------
+    def _ensure_train_step(self):
+        if self._train_step is None and self._loss is not None:
+            from paddle_tpu.jit import TrainStep
+            loss_layer = self._loss
+
+            def loss_fn(net, *batch):
+                # assume last arg(s) are labels; network takes the rest
+                n_in = getattr(self, "_n_inputs", 1)
+                inputs, labels = batch[:n_in], batch[n_in:]
+                out = net(*inputs)
+                outs = out if isinstance(out, (list, tuple)) else (out,)
+                return loss_layer(*outs, *labels)
+            self._train_step = TrainStep(self.network, loss_fn,
+                                         self._optimizer,
+                                         amp_level=self._amp_level)
+        return self._train_step
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is not None else []
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        self._n_inputs = len(inputs)
+        step = self._ensure_train_step()
+        if step is not None and update:
+            loss = step(*inputs, *labels)
+            metrics = self._eval_metrics_on_batch(inputs, labels)
+            return ([float(loss.numpy())], metrics) if metrics else \
+                [float(loss.numpy())]
+        # eager fallback
+        out = self.network(*inputs)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        loss = self._loss(*outs, *labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.numpy())]
+
+    def _eval_metrics_on_batch(self, inputs, labels):
+        if not self._metrics:
+            return None
+        with no_grad():
+            self.network.eval()
+            out = self.network(*inputs)
+            self.network.train()
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        res = []
+        for m in self._metrics:
+            c = m.compute(*outs, *labels)
+            res.append(m.update(c))
+        return res
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is not None else []
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        out = self.network(*inputs)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        results = {}
+        if self._loss is not None:
+            loss = self._loss(*outs, *labels)
+            results["loss"] = [float(loss.numpy())]
+        for m in self._metrics:
+            c = m.compute(*outs, *labels)
+            m.update(c)
+        return results
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        return [o.numpy() for o in outs]
+
+    # -- loops ---------------------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle):
+        from paddle_tpu.io import DataLoader, Dataset
+        if data is None or hasattr(data, "__iter__") and not isinstance(
+                data, Dataset):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle)
+        eval_loader = self._to_loader(eval_data, batch_size, False)
+        cbks = CallbackList(callbacks or [ProgBarLogger(log_freq,
+                                                        verbose=verbose)])
+        cbks.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps,
+                         "verbose": verbose})
+        cbks.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                inputs, labels = batch[:-1] or [batch[0]], batch[-1:]
+                if len(batch) == 1:
+                    inputs, labels = [batch[0]], []
+                res = self.train_batch(inputs, labels)
+                if isinstance(res, tuple):
+                    loss_v, metr = res
+                else:
+                    loss_v, metr = res, None
+                logs = {"loss": loss_v}
+                for m in self._metrics:
+                    logs[m.name() if isinstance(m.name(), str) else
+                         m.name()[0]] = m.accumulate()
+                cbks.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+        cbks.on_train_end()
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False)
+        cbks = callbacks if isinstance(callbacks, CallbackList) else \
+            CallbackList(callbacks or [])
+        cbks.set_model(self)
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            if len(batch) == 1:
+                inputs, labels = [batch[0]], []
+            else:
+                inputs, labels = batch[:-1], batch[-1:]
+            res = self.eval_batch(inputs, labels)
+            if "loss" in res:
+                losses.extend(res["loss"])
+        if losses:
+            logs["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            name = m.name() if isinstance(m.name(), str) else m.name()[0]
+            logs[name] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._to_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            outputs.append(self.predict_batch(batch))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        from paddle_tpu.framework.io import save as _save
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from paddle_tpu.framework.io import load as _load
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from paddle_tpu.hapi.model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

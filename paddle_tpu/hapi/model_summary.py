@@ -1,0 +1,118 @@
+"""summary/flops (parity: python/paddle/hapi/model_summary.py,
+dynamic_flops.py)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from paddle_tpu.core import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["summary", "flops"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        sizes = [s if isinstance(s, (list, tuple)) else (s,) for s in sizes]
+        inputs = [Tensor(np.zeros([1 if d in (-1, None) else d for d in s],
+                                  dtype=np.float32)) for s in sizes]
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    records = OrderedDict()
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, ins, outs):
+            out = outs[0] if isinstance(outs, (list, tuple)) else outs
+            n_params = sum(p.size for p in layer.parameters(
+                include_sublayers=False))
+            records[name] = {
+                "type": type(layer).__name__,
+                "output_shape": list(getattr(out, "shape", [])),
+                "params": n_params,
+            }
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        hooks.append(sub.register_forward_post_hook(make_hook(
+            f"{type(sub).__name__}-{name}")))
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+    line = "-" * 72
+    print(line)
+    print(f"{'Layer (type)':<32}{'Output Shape':<24}{'Param #':<12}")
+    print("=" * 72)
+    for name, rec in records.items():
+        print(f"{name:<32}{str(rec['output_shape']):<24}"
+              f"{rec['params']:<12,}")
+    print("=" * 72)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net: Layer, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs counter for conv/linear layers (parity:
+    hapi/dynamic_flops.py)."""
+    from paddle_tpu.nn.layer.conv import _ConvNd
+    from paddle_tpu.nn.layer.common import Linear
+
+    total = [0]
+    hooks = []
+
+    def conv_hook(layer, ins, outs):
+        out = outs[0] if isinstance(outs, (list, tuple)) else outs
+        kernel_ops = int(np.prod(layer._kernel_size)) * (
+            layer._in_channels // layer._groups)
+        output_elements = int(np.prod(out.shape))
+        total[0] += output_elements * (2 * kernel_ops - 1)
+
+    def linear_hook(layer, ins, outs):
+        out = outs[0] if isinstance(outs, (list, tuple)) else outs
+        batch = int(np.prod(out.shape[:-1]))
+        total[0] += batch * (2 * layer.in_features - 1) * layer.out_features
+
+    for sub in net.sublayers(include_self=True):
+        if isinstance(sub, _ConvNd):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+        elif custom_ops and type(sub) in custom_ops:
+            fn = custom_ops[type(sub)]
+            hooks.append(sub.register_forward_post_hook(
+                lambda l, i, o, _fn=fn: total.__setitem__(
+                    0, total[0] + _fn(l, i, o))))
+
+    sizes = input_size if isinstance(input_size[0], (list, tuple)) else \
+        [input_size]
+    inputs = [Tensor(np.zeros(s, dtype=np.float32)) for s in sizes]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

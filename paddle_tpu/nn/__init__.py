@@ -1,0 +1,16 @@
+"""paddle_tpu.nn — parity with python/paddle/nn/ (~20.4k LoC in reference)."""
+from paddle_tpu.nn import functional  # noqa: F401
+from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.nn.layer.layers import (Layer, LayerList, ParameterList,  # noqa: F401
+                                        Sequential)
+from paddle_tpu.nn.layer.common import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.activation import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.conv import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.norm import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.pooling import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.rnn import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.transformer import *  # noqa: F401,F403
+from paddle_tpu.nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
+                                ClipGradByGlobalNorm)
+from paddle_tpu.nn import utils  # noqa: F401

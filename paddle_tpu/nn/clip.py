@@ -1,0 +1,99 @@
+"""Gradient clipping (parity: python/paddle/fluid/clip.py — ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            garr = g._data if isinstance(g, Tensor) else g
+            out.append((p, Tensor(jnp.clip(garr, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            garr = g._data if isinstance(g, Tensor) else g
+            norm = jnp.sqrt(jnp.sum(garr * garr))
+            scale = jnp.where(norm > self.clip_norm, self.clip_norm /
+                              jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor(garr * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq_sum = 0.0
+        arrs = []
+        for p, g in params_grads:
+            garr = g._data if isinstance(g, Tensor) else g
+            arrs.append((p, garr))
+            if garr is not None:
+                sq_sum = sq_sum + jnp.sum(
+                    garr.astype(jnp.float32) ** 2)
+        global_norm = jnp.sqrt(sq_sum)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-12),
+                            1.0)
+        return [(p, Tensor((garr * scale).astype(garr.dtype))
+                 if garr is not None else None) for p, garr in arrs]
+
+    def functional_clip(self, grads: dict) -> dict:
+        """Pure pytree variant used by jitted train steps."""
+        import jax
+        sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                 for g in jax.tree_util.tree_leaves(grads))
+        gn = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        return jax.tree_util.tree_map(
+            lambda g: (g * scale).astype(g.dtype), grads)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data) ** norm_type) for g in grads])) ** (
+                1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p._grad is not None:
+            p._grad._data = p._grad._data * scale
+    return Tensor(total)

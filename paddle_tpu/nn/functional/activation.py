@@ -1,0 +1,212 @@
+"""Activation functionals (parity: python/paddle/nn/functional/activation.py;
+reference kernels: paddle/fluid/operators/activation_op.*).
+
+All map to jax.nn / jnp ops that XLA fuses into adjacent matmuls on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor, apply1
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "selu", "celu", "gelu", "sigmoid",
+    "hardsigmoid", "hardswish", "hardtanh", "hardshrink", "softshrink",
+    "tanhshrink", "leaky_relu", "prelu", "rrelu", "log_sigmoid", "maxout",
+    "silu", "swish", "mish", "softmax", "log_softmax", "softplus", "softsign",
+    "tanh", "tanh_", "thresholded_relu", "glu", "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return apply1(jax.nn.relu, x, name="relu")
+
+
+def relu_(x, name=None):
+    x._data = jax.nn.relu(x._data)
+    return x
+
+
+def relu6(x, name=None):
+    return apply1(lambda a: jnp.clip(a, 0.0, 6.0), x, name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply1(lambda a: jax.nn.elu(a, alpha=alpha), x, name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply1(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                  x, name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply1(lambda a: jax.nn.celu(a, alpha=alpha), x, name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply1(lambda a: jax.nn.gelu(a, approximate=approximate), x,
+                  name="gelu")
+
+
+def sigmoid(x, name=None):
+    return apply1(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply1(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x,
+                  name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply1(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x,
+                  name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply1(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply1(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
+                  name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply1(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x, name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return apply1(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply1(lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope),
+                  x, name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _prelu(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        elif data_format == "NCHW" and a.ndim > 1:
+            wb = w.reshape((1, -1) + (1,) * (a.ndim - 2))
+        else:
+            wb = w
+        return jnp.where(a > 0, a, wb * a)
+    return apply1(_prelu, x, weight, name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        from paddle_tpu.tensor.random import default_generator
+        k = default_generator.split()
+
+        def _rrelu(a):
+            slope = jax.random.uniform(k, a.shape, dtype=a.dtype,
+                                       minval=lower, maxval=upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply1(_rrelu, x, name="rrelu")
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def log_sigmoid(x, name=None):
+    return apply1(jax.nn.log_sigmoid, x, name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _maxout(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply1(_maxout, x, name="maxout")
+
+
+def silu(x, name=None):
+    return apply1(jax.nn.silu, x, name="silu")
+
+
+def swish(x, name=None):
+    return apply1(jax.nn.silu, x, name="swish")
+
+
+def mish(x, name=None):
+    return apply1(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, name="mish")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def _softmax(a):
+        if dtype is not None:
+            from paddle_tpu.core import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply1(_softmax, x, name="softmax")
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def _lsm(a):
+        if dtype is not None:
+            from paddle_tpu.core import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply1(_lsm, x, name="log_softmax")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply1(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            (1.0 / beta) * jnp.log1p(jnp.exp(beta * a))),
+        x, name="softplus")
+
+
+def softsign(x, name=None):
+    return apply1(jax.nn.soft_sign, x, name="softsign")
+
+
+def tanh(x, name=None):
+    return apply1(jnp.tanh, x, name="tanh")
+
+
+def tanh_(x, name=None):
+    x._data = jnp.tanh(x._data)
+    return x
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply1(lambda a: jnp.where(a > threshold, a, 0.0), x,
+                  name="thresholded_relu")
+
+
+def glu(x, axis=-1, name=None):
+    def _glu(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply1(_glu, x, name="glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from paddle_tpu.tensor.random import default_generator
+    k = default_generator.split()
+
+    def _gs(a):
+        g = jax.random.gumbel(k, a.shape, dtype=a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = y_hard + jax.lax.stop_gradient(y) - jax.lax.stop_gradient(y) \
+                + (y - jax.lax.stop_gradient(y))
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+    return apply1(_gs, x, name="gumbel_softmax")

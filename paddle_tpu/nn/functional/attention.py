@@ -1,0 +1,89 @@
+"""Attention functionals.
+
+The reference ships only full-materialised attention
+(python/paddle/nn/layer/transformer.py:115 MultiHeadAttention) plus fused
+inference kernels (operators/fused/multihead_matmul_op.cu).  The TPU-native
+replacement is a Pallas flash-attention kernel (paddle_tpu/ops/pallas/
+flash_attention.py) — blockwise online-softmax so the S×S score matrix never
+hits HBM — with a pure-XLA fallback for CPU tests and odd shapes.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor, apply1
+from paddle_tpu.framework import flags as _flags
+
+__all__ = ["scaled_dot_product_attention", "flash_attention"]
+
+
+def _xla_attention(q, k, v, mask, scale, causal):
+    # q,k,v: (B, S, H, D) paddle layout
+    qh = jnp.einsum("bshd->bhsd", q)
+    kh = jnp.einsum("bshd->bhsd", k)
+    vh = jnp.einsum("bshd->bhsd", v)
+    scores = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool),
+                               k=s_k - s_q)
+        scores = jnp.where(causal_mask, scores, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -1e30)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
+    return jnp.einsum("bhsd->bshd", out)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """(B, S, H, D) attention.  Uses the Pallas flash kernel on TPU when
+    shapes allow, falling back to the XLA path (still fused reasonably well
+    by XLA, but materialises scores)."""
+    d = query.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+
+    use_flash = False
+    try:
+        from paddle_tpu.ops.pallas import flash_attention as _fa
+        use_flash = _fa.supported(tuple(query.shape), tuple(key.shape),
+                                  attn_mask is None)
+    except Exception:
+        use_flash = False
+
+    if use_flash:
+        from paddle_tpu.ops.pallas import flash_attention as _fa
+
+        def _run(q, k, v):
+            return _fa.flash_attention(q, k, v, causal=is_causal, scale=scale)
+        out = apply1(_run, query, key, value, name="flash_attention")
+    else:
+        def _run(q, k, v, *m):
+            return _xla_attention(q, k, v, m[0] if m else None, scale,
+                                  is_causal)
+        if attn_mask is not None:
+            out = apply1(_run, query, key, value, attn_mask,
+                         name="sdp_attention")
+        else:
+            out = apply1(_run, query, key, value, name="sdp_attention")
+    if dropout_p > 0.0 and training:
+        from paddle_tpu.nn.functional.common import dropout
+        out = dropout(out, p=dropout_p, training=training)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal)
+    if return_softmax:
+        return out, None
+    return out

@@ -1,0 +1,335 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, etc.
+(parity: python/paddle/nn/functional/common.py + input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply1
+from paddle_tpu.tensor.random import default_generator
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "pad", "zeropad2d", "interpolate",
+           "upsample", "unfold", "fold", "bilinear", "cosine_similarity",
+           "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+           "label_smooth", "class_center_sample", "pairwise_distance"]
+
+
+def linear(x, weight, bias=None, name=None):
+    """FC over the MXU. paddle weight layout: (in_features, out_features)."""
+    if bias is not None:
+        return apply1(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias,
+                      name="linear")
+    return apply1(jnp.matmul, x, weight, name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    if p == 1:
+        return apply1(lambda a: jnp.zeros_like(a), x, name="dropout")
+    k = default_generator.split()
+
+    def _dropout(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply1(_dropout, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    k = default_generator.split()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _ad(a):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+    return apply1(_ad, x, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup (reference: operators/lookup_table_v2_op).  ``sparse`` is
+    accepted; on TPU dense one-hot-free gather is already the fast path and
+    sparse grads are handled by the embedding-table subsystem
+    (paddle_tpu.distributed.ps) instead of SelectedRows."""
+    def _emb(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply1(_emb, x, weight, nondiff=(0,), name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply1(lambda a: jax.nn.one_hot(a, num_classes), x, nondiff=(0,),
+                  name="one_hot")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = [int(p) for p in pad]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def _pad(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW convention: pad lists innermost spatial dims
+            # [left, right, top, bottom, ...] applying to last dims first
+            n_spatial = len(pad) // 2
+            pairs = [(0, 0)] * nd
+            if data_format in ("NCHW", "NCL", "NCDHW"):
+                dims = list(range(nd - 1, nd - 1 - n_spatial, -1))
+            else:
+                dims = list(range(nd - 2, nd - 2 - n_spatial, -1))
+            for i, d in enumerate(dims):
+                pairs[d] = (pad[2 * i], pad[2 * i + 1])
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode=jmode, constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+    return apply1(_pad, x, name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+    mode = mode.lower()
+
+    def _interp(a):
+        nd = a.ndim
+        n_spatial = nd - 2
+        if channel_last:
+            spatial_axes = list(range(1, nd - 1))
+        else:
+            spatial_axes = list(range(2, nd))
+        in_sizes = [a.shape[ax] for ax in spatial_axes]
+        if size is not None:
+            s = size.numpy().tolist() if isinstance(size, Tensor) else size
+            out_sizes = [int(v) for v in (s if isinstance(s, (list, tuple))
+                                          else [s] * n_spatial)]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * n_spatial
+            out_sizes = [int(i * f) for i, f in zip(in_sizes, sf)]
+        if mode == "nearest":
+            out = a
+            for ax, (i_s, o_s) in zip(spatial_axes, zip(in_sizes, out_sizes)):
+                idx = jnp.floor(jnp.arange(o_s) * (i_s / o_s)).astype(jnp.int32)
+                out = jnp.take(out, idx, axis=ax)
+            return out
+        if mode in ("bilinear", "linear", "trilinear", "bicubic"):
+            meth = "cubic" if mode == "bicubic" else "linear"
+            if channel_last:
+                new_shape = (a.shape[0],) + tuple(out_sizes) + (a.shape[-1],)
+            else:
+                new_shape = a.shape[:2] + tuple(out_sizes)
+            if align_corners:
+                # jax.image doesn't do align_corners; emulate with map_coordinates
+                coords = []
+                for i_s, o_s in zip(in_sizes, out_sizes):
+                    if o_s == 1:
+                        coords.append(jnp.zeros((o_s,)))
+                    else:
+                        coords.append(jnp.linspace(0, i_s - 1, o_s))
+                mesh = jnp.meshgrid(*coords, indexing="ij")
+                batch_axes = [ax for ax in range(nd) if ax not in spatial_axes]
+
+                def interp_one(img):
+                    return jax.scipy.ndimage.map_coordinates(
+                        img, [m for m in mesh], order=1, mode="nearest")
+                flat = jnp.moveaxis(a, spatial_axes,
+                                    list(range(nd - n_spatial, nd)))
+                lead_shape = flat.shape[:nd - n_spatial]
+                flat2 = flat.reshape((-1,) + flat.shape[nd - n_spatial:])
+                out = jax.vmap(interp_one)(flat2)
+                out = out.reshape(lead_shape + tuple(out_sizes))
+                return jnp.moveaxis(out, list(range(nd - n_spatial, nd)),
+                                    spatial_axes)
+            return jax.image.resize(a, new_shape, method=meth)
+        if mode == "area":
+            # adaptive average pooling
+            out = a
+            for ax, o_s in zip(spatial_axes, out_sizes):
+                i_s = out.shape[ax]
+                if i_s % o_s == 0:
+                    kk = i_s // o_s
+                    shp = out.shape[:ax] + (o_s, kk) + out.shape[ax + 1:]
+                    out = jnp.mean(out.reshape(shp), axis=ax + 1)
+                else:
+                    idx = jnp.floor(jnp.arange(o_s) * (i_s / o_s)).astype(
+                        jnp.int32)
+                    out = jnp.take(out, idx, axis=ax)
+            return out
+        raise ValueError(f"unsupported interpolate mode {mode}")
+    return apply1(_interp, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/math/im2col) — used by fold/unfold API."""
+    def _t(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _t(kernel_sizes)
+    sh, sw = _t(strides)
+    dh, dw = _t(dilations)
+    if isinstance(paddings, int):
+        pads = (paddings,) * 4
+    elif len(paddings) == 2:
+        pads = (paddings[0], paddings[0], paddings[1], paddings[1])
+    else:
+        pads = tuple(paddings)
+
+    def _unfold(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])])
+        out_h = (a.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (a.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = []
+        for i in range(kh):
+            for j in range(kw):
+                sl = a[:, :, i * dh: i * dh + out_h * sh: sh,
+                       j * dw: j * dw + out_w * sw: sw]
+                patches.append(sl)
+        stacked = jnp.stack(patches, axis=2)  # n, c, kh*kw, oh, ow
+        return stacked.reshape(n, c * kh * kw, out_h * out_w)
+    return apply1(_unfold, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _t(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _t(output_sizes)
+    kh, kw = _t(kernel_sizes)
+    sh, sw = _t(strides)
+    dh, dw = _t(dilations)
+    ph, pw = _t(paddings) if not isinstance(paddings, int) else (paddings,
+                                                                paddings)
+
+    def _fold(a):
+        n, ckk, l = a.shape
+        c = ckk // (kh * kw)
+        out_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        out_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        a = a.reshape(n, c, kh, kw, out_h, out_w)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh: i * dh + out_h * sh: sh,
+                             j * dw: j * dw + out_w * sw: sw].add(
+                    a[:, :, i, j])
+        return out[:, :, ph: ph + oh, pw: pw + ow]
+    return apply1(_fold, x, name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bilinear(a, b, w, *mb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if mb:
+            out = out + mb[0]
+        return out
+    if bias is not None:
+        return apply1(_bilinear, x1, x2, weight, bias, name="bilinear")
+    return apply1(_bilinear, x1, x2, weight, name="bilinear")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _cs(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply1(_cs, x1, x2, name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def _pd(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return apply1(_pd, x, y, name="pairwise_distance")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _ps(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return apply1(_ps, x, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _pu(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        return a.reshape(n, c * r * r, h // r, w // r)
+    return apply1(_pu, x, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _cs(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = jnp.transpose(a, (0, 2, 1, 3, 4))
+        return a.reshape(n, c, h, w)
+    return apply1(_cs, x, name="channel_shuffle")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return apply1(_ls, label, prior_dist, name="label_smooth")
+    return apply1(_ls, label, name="label_smooth")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample: PS-style class sampling is provided by "
+        "paddle_tpu.distributed.ps")

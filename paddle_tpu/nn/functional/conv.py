@@ -1,0 +1,179 @@
+"""Convolution functionals (reference kernels: operators/conv_op.*,
+conv_transpose_op.*, operators/math/im2col — here: lax.conv_general_dilated,
+which XLA tiles straight onto the MXU; no im2col materialisation).
+
+Layout: accepts paddle's NCHW/NHWC ``data_format``; weights OIHW (paddle
+convention).  On TPU, NHWC + bf16 is the fast path — layers expose
+``data_format`` so models can run either.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply1
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuplify(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n, strides=None):
+    """paddle padding: int | list[int] (per-dim) | list of pairs | 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer))
+                                 for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             channel_last, name):
+    strides = _tuplify(stride, n)
+    dil = _tuplify(dilation, n)
+    pad = _norm_padding(padding, n)
+    if channel_last:
+        spatial = "".join("DHW"[3 - n:][i] for i in range(n))
+        lhs_spec = "N" + spatial + "C"
+    else:
+        spatial = "".join("DHW"[3 - n:][i] for i in range(n))
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
+
+    def _conv(a, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            c_axis = out.ndim - 1 if channel_last else 1
+            shape[c_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply1(_conv, x, weight, bias, name=name)
+    return apply1(_conv, x, weight, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    data_format in ("NLC",), "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format == "NHWC", "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format == "NDHWC", "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, channel_last, output_size, name):
+    strides = _tuplify(stride, n)
+    dil = _tuplify(dilation, n)
+    pad = _norm_padding(padding, n)
+    out_pad = _tuplify(output_padding, n) if output_padding is not None else (0,) * n
+    spatial = "".join("DHW"[3 - n:][i] for i in range(n))
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: (in_channels, out_channels/groups,
+    # *k).  For groups>1 we reshape to OI-per-group so feature_group_count
+    # sees rhs I = in_channels/groups with output blocks contiguous.
+    rhs_spec = ("IO" if groups == 1 else "OI") + spatial
+    w_shape = tuple(weight.shape)
+    if groups > 1:
+        cin, cog = w_shape[0], w_shape[1]
+        w_shape = (cog * groups, cin // groups) + w_shape[2:]
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), w_shape, (lhs_spec, rhs_spec, lhs_spec))
+
+    pad_pairs = pad
+
+    def _convt(a, w, *maybe_b):
+        # transpose conv = conv with lhs dilation + spatially flipped kernel
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            cin, cog = w.shape[0], w.shape[1]
+            # (g, cin/g, cog, *k) → (g, cog, cin/g, *k) → (cout, cin/g, *k)
+            wg = w.reshape((groups, cin // groups, cog) + w.shape[2:])
+            wg = jnp.swapaxes(wg, 1, 2)
+            w = wg.reshape((groups * cog, cin // groups) + w.shape[2:])
+        k_shape = w.shape[2:]
+        if isinstance(pad_pairs, str):
+            trans_pad = pad_pairs
+        else:
+            trans_pad = []
+            for i in range(n):
+                k_eff = (k_shape[i] - 1) * dil[i] + 1
+                lo = k_eff - 1 - pad_pairs[i][0]
+                hi = k_eff - 1 - pad_pairs[i][1] + out_pad[i]
+                trans_pad.append((lo, hi))
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=(1,) * n, padding=trans_pad,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            c_axis = out.ndim - 1 if channel_last else 1
+            shape[c_axis] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply1(_convt, x, weight, bias, name=name)
+    return apply1(_convt, x, weight, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format == "NLC",
+                              output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format == "NHWC",
+                              output_size, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format == "NDHWC",
+                              output_size, "conv3d_transpose")

@@ -1,0 +1,325 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py; reference
+kernels: operators/cross_entropy_op.*, softmax_with_cross_entropy_op.*,
+bce_loss_op.*, smooth_l1_loss_op.*, kldiv_loss_op.*, margin_rank_loss_op.*).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor, apply1
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "nll_loss", "l1_loss", "mse_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_embedding_loss", "ctc_loss", "square_error_cost", "log_loss",
+    "sigmoid_focal_loss", "dice_loss", "npair_loss", "triplet_margin_loss",
+    "soft_margin_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    def _ce(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            loss = -jnp.sum(lab * logp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(jnp.maximum(lab_i, 0), axis), axis=axis)
+            loss = jnp.squeeze(loss, axis=axis)
+            valid = (lab_i != ignore_index)
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                wt = jnp.take(w[0], jnp.maximum(lab_i, 0), axis=0)
+                wt = jnp.where(valid, wt, 0.0)
+                loss = loss * wt
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    nondiff = (1,) if not soft_label else ()
+    if weight is not None:
+        args.append(weight)
+    return apply1(_ce, *args, nondiff=nondiff, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    if loss.ndim == logits.ndim - 1:
+        from paddle_tpu.tensor.manipulation import unsqueeze
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from paddle_tpu.nn.functional.activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def _bce(p, l, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(l * jnp.log(p) + (1 - l) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply1(_bce, *args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def _bcel(z, l, *extra):
+        i = 0
+        if pos_weight is not None:
+            pw = extra[i]; i += 1
+            l_w = 1.0 + (pw - 1.0) * l
+            base = (1.0 - l) * z + l_w * (
+                jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(-z, 0.0))
+        else:
+            base = jnp.maximum(z, 0.0) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if weight is not None:
+            base = base * extra[i]
+        return _reduce(base, reduction)
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply1(_bcel, *args, name="bce_with_logits")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def _nll(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(
+            jnp.maximum(lab_i, 0), 1), axis=1)[:, 0]
+        valid = lab_i != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.maximum(lab_i, 0))
+            wt = jnp.where(valid, wt, 0.0)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply1(_nll, *args, nondiff=(1,), name="nll_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply1(lambda a, b: _reduce(jnp.abs(a - b), reduction), input,
+                  label, name="l1_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply1(lambda a, b: _reduce((a - b) ** 2, reduction), input, label,
+                  name="mse_loss")
+
+
+def square_error_cost(input, label, name=None):
+    return apply1(lambda a, b: (a - b) ** 2, input, label,
+                  name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply1(
+        lambda p, l: -l * jnp.log(p + epsilon) - (1 - l) * jnp.log(
+            1 - p + epsilon), input, label, name="log_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _sl1(a, b):
+        d = a - b
+        abs_d = jnp.abs(d)
+        loss = jnp.where(abs_d < delta, 0.5 * d * d,
+                         delta * (abs_d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply1(_sl1, input, label, name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def _kl(logp, t):
+        loss = t * (jnp.log(jnp.maximum(t, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply1(_kl, input, label, name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def _mrl(a, b, l):
+        loss = jnp.maximum(0.0, -l * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return apply1(_mrl, input, other, label, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def _hel(a, l):
+        loss = jnp.where(l == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply1(_hel, input, label, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def _cel(a, b, l):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(l == 1, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply1(_cel, input1, input2, label, name="cosine_embedding_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _sfl(z, l, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * l + (1 - p) * (1 - l)
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(normalizer)
+    return apply1(_sfl, *args, name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def _dice(p, l):
+        l_oh = jax.nn.one_hot(l[..., 0].astype(jnp.int32), p.shape[-1])
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = 2.0 * jnp.sum(p * l_oh, axis=reduce_dims)
+        denom = jnp.sum(p, axis=reduce_dims) + jnp.sum(l_oh, axis=reduce_dims)
+        return jnp.mean(1.0 - (inter + epsilon) / (denom + epsilon))
+    return apply1(_dice, input, label, nondiff=(1,), name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    def _np(a, p, l):
+        sim = jnp.matmul(a, p.T)
+        lab = l.reshape(-1)
+        tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
+                        jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return ce + reg
+    return apply1(_np, anchor, positive, labels, nondiff=(2,),
+                  name="npair_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def _tml(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        return _reduce(jnp.maximum(0.0, d_ap - d_an + margin), reduction)
+    return apply1(_tml, input, positive, negative, name="triplet_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply1(lambda a, l: _reduce(jnp.log1p(jnp.exp(-l * a)), reduction),
+                  input, label, name="soft_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (reference: operators/warpctc_op → here a lax.scan DP, no warpctc).
+
+    log_probs: (T, N, C) logits (will be log-softmaxed), labels: (N, S) padded.
+    """
+    def _ctc(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        # extended label sequence with blanks: length 2S+1
+        ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_len = 2 * lab_len.astype(jnp.int32) + 1
+        NEG = -1e30
+        # alpha init
+        alpha0 = jnp.full((N, 2 * S + 1), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(N), blank])
+        first_lab = jnp.where(lab_len > 0, ext[:, 1], blank)
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp[0, jnp.arange(N), first_lab], NEG))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate(
+                [jnp.full((N, 1), NEG), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((N, 2), NEG), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, NEG, a_shift2)
+            merged = jnp.logaddexp(alpha, a_shift1)
+            merged = jnp.logaddexp(merged, a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            # freeze past input length
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        idx_last = jnp.maximum(ext_len - 1, 0)
+        idx_prev = jnp.maximum(ext_len - 2, 0)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0])
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+    return apply1(_ctc, log_probs, labels, input_lengths, label_lengths,
+                  nondiff=(1, 2, 3), name="ctc_loss")

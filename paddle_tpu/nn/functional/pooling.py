@@ -1,0 +1,181 @@
+"""Pooling functionals (reference kernels: operators/pool_op.*,
+operators/math/pooling.*) via lax.reduce_window."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import apply1
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+           "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _tuplify(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _norm_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding),) * 2] * n
+    padding = list(padding)
+    if all(isinstance(p, (int, np.integer)) for p in padding):
+        if len(padding) == n:
+            return [(int(p), int(p)) for p in padding]
+        if len(padding) == 2 * n:
+            return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                    for i in range(n)]
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, mode, ceil_mode,
+          exclusive, name):
+    k = _tuplify(kernel, n)
+    s = _tuplify(stride if stride is not None else kernel, n)
+    pad = _norm_pad(padding, n)
+
+    def _run(a):
+        nd = a.ndim
+        if channel_last:
+            dims = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pads = [(0, 0)] + (list(pad) if not isinstance(pad, str) else pad) + [(0, 0)] \
+                if not isinstance(pad, str) else pad
+        else:
+            dims = (1, 1) + k
+            strides = (1, 1) + s
+            pads = [(0, 0), (0, 0)] + list(pad) if not isinstance(pad, str) else pad
+        if isinstance(pad, str):
+            pads = pad
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, dims, strides,
+                                         pads)
+        # avg
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides,
+                                       pads)
+        if exclusive and not isinstance(pads, str):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                           strides, pads)
+            return summed / counts
+        return summed / float(np.prod(k))
+    return apply1(_run, x, name=name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                "max", ceil_mode, True, "max_pool1d")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                "max", ceil_mode, True, "max_pool2d")
+    if return_mask:
+        # indices: argmax within each window (paddle returns flattened spatial idx)
+        raise NotImplementedError("return_mask=True not yet supported")
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "max", ceil_mode, True, "max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "avg", ceil_mode, exclusive, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 "avg", ceil_mode, exclusive, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "avg", ceil_mode, exclusive, "avg_pool3d")
+
+
+def _adaptive_pool(x, output_size, n, mode, channel_last, name):
+    if isinstance(output_size, (int, np.integer)):
+        out_sizes = (int(output_size),) * n
+    else:
+        out_sizes = tuple(int(o) if o is not None else None
+                          for o in output_size)
+
+    def _run(a):
+        spatial_start = 1 if channel_last else 2
+        out = a
+        for d in range(n):
+            axis = spatial_start + d
+            in_size = a.shape[axis]
+            o = out_sizes[d] if out_sizes[d] is not None else in_size
+            if in_size % o == 0:
+                k = in_size // o
+                new_shape = out.shape[:axis] + (o, k) + out.shape[axis + 1:]
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=axis + 1) if mode == "max" else \
+                    jnp.mean(r, axis=axis + 1)
+            else:
+                # general adaptive: per-output-bin slices (static unrolled)
+                slices = []
+                for i in range(o):
+                    lo = (i * in_size) // o
+                    hi = ((i + 1) * in_size + o - 1) // o
+                    sl = jax.lax.slice_in_dim(out, lo, hi, axis=axis)
+                    red = jnp.max(sl, axis=axis, keepdims=True) \
+                        if mode == "max" else jnp.mean(sl, axis=axis,
+                                                       keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=axis)
+        return out
+    return apply1(_run, x, name=name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", False,
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format == "NHWC",
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format == "NDHWC",
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", False,
+                          "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", False,
+                          "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max", False,
+                          "adaptive_max_pool3d")

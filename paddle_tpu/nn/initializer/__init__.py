@@ -1,0 +1,236 @@
+"""Weight initializers (parity: python/paddle/nn/initializer/ +
+python/paddle/fluid/initializer.py).
+
+Initializers are callables shape×dtype→jax array, seeded from the global
+Generator (paddle_tpu.tensor.random) so ``paddle_tpu.seed`` makes init
+deterministic, like the reference's per-op seed attributes.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Parameter, Tensor, convert_dtype
+from paddle_tpu.tensor.random import default_generator
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Dirac", "Orthogonal", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+             "selu": 3.0 / 4.0}
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity in gains:
+        return gains[nonlinearity]
+    raise ValueError(f"unknown nonlinearity {nonlinearity}")
+
+
+def _fan_in_out(shape: Sequence[int]):
+    shape = list(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = shape[0] if shape else 1
+    elif len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    else:
+        # conv kernels stored OIHW-style in the reference; receptive field =
+        # prod of trailing dims
+        receptive = int(np.prod(shape[2:]))
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(shape, self.value, dtype=convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        k = default_generator.split()
+        return self.mean + self.std * jax.random.normal(
+            k, shape, dtype=convert_dtype(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        k = default_generator.split()
+        return self.mean + self.std * jax.random.truncated_normal(
+            k, -2.0, 2.0, shape, dtype=convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        k = default_generator.split()
+        return jax.random.uniform(k, shape, dtype=convert_dtype(dtype),
+                                  minval=self.low, maxval=self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = default_generator.split()
+        return std * jax.random.normal(k, shape, dtype=convert_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = default_generator.split()
+        return jax.random.uniform(k, shape, dtype=convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        k = default_generator.split()
+        return std * jax.random.normal(k, shape, dtype=convert_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        k = default_generator.split()
+        return jax.random.uniform(k, shape, dtype=convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype=convert_dtype(dtype))
+        return arr.reshape(shape)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        arr = np.zeros(shape, dtype=np.float32)
+        out_per_group = shape[0] // self.groups
+        min_dim = min(out_per_group, shape[1])
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for d in range(min_dim):
+                arr[(g * out_per_group + d, d) + tuple(centers)] = 1.0
+        return jnp.asarray(arr, dtype=convert_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        k = default_generator.split()
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = jax.random.normal(k, (max(rows, cols), min(rows, cols)),
+                                 dtype=jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(
+            convert_dtype(dtype))
+
+
+# legacy-name aliases (fluid.initializer)
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign
+
+
+def _create_param(shape, dtype, attr=None, is_bias=False,
+                  default_initializer=None, name=None) -> Parameter:
+    """Shared parameter factory (≈ LayerHelper.create_parameter,
+    python/paddle/fluid/layer_helper_base.py)."""
+    from paddle_tpu.nn.layer.common import ParamAttr
+    shape = [int(s) for s in shape]
+    init = default_initializer
+    trainable = True
+    regularizer = None
+    lr = 1.0
+    pname = name
+    if isinstance(attr, ParamAttr):
+        init = attr.initializer or init
+        trainable = attr.trainable
+        regularizer = attr.regularizer
+        lr = attr.learning_rate
+        pname = attr.name or pname
+    elif attr is False:
+        raise ValueError("_create_param called with attr=False")
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierUniform()
+    data = init(tuple(shape), dtype=dtype or "float32")
+    p = Parameter(data, name=pname, trainable=trainable)
+    p.regularizer = regularizer
+    p.optimize_attr = {"learning_rate": lr}
+    p.is_bias = is_bias
+    return p

@@ -1,0 +1,446 @@
+"""nn.Layer — the module base class.
+
+Parity target: python/paddle/fluid/dygraph/layers.py (Layer.__call__ :888,
+hooks :911, parameter/sublayer registries, state_dict).  TPU-first addition:
+``functional_state`` / ``functional_call`` let paddle_tpu.jit trace a Layer as
+a pure function over a param pytree (the role the reference's
+ProgramDescTracer plays for @to_static, imperative/jit/program_desc_tracer.cc)
+without AST rewriting.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.core import Parameter, Tensor, convert_dtype
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, key: int):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_id = [0]
+        self._full_name = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute interception --------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ first")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params.pop(name)
+                    object.__setattr__(self, name, value)
+                elif isinstance(value, Tensor):
+                    params[name].set_value(value)
+                else:
+                    params.pop(name)
+                    object.__setattr__(self, name, value)
+            elif layers is not None and name in layers and not isinstance(
+                    value, Layer):
+                layers.pop(name)
+                object.__setattr__(self, name, value)
+            elif (buffers is not None and name in buffers
+                  and isinstance(value, Tensor)):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(
+            self._sub_layers) + list(self._buffers)
+
+    # -- call / forward -----------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id[0] += 1
+        self._forward_pre_hooks[self._hook_id[0]] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id[0])
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id[0] += 1
+        self._forward_post_hooks[self._hook_id[0]] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id[0])
+
+    # -- registry -----------------------------------------------------------
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Parameter:
+        from paddle_tpu.nn.initializer import _create_param
+        return _create_param(shape, dtype or self._dtype, attr=attr,
+                             is_bias=is_bias,
+                             default_initializer=default_initializer)
+
+    # -- iteration ----------------------------------------------------------
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "",
+                         include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer_prefix in self._traverse(prefix, include_sublayers):
+            layer = name
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = f"{layer_prefix}.{pname}" if layer_prefix else pname
+                yield full, p
+
+    def _traverse(self, prefix, include_sublayers):
+        yield self, prefix
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                yield from sub._traverse(sub_prefix, include_sublayers)
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self.named_children():
+            yield sub
+
+    def named_children(self):
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer, layer_prefix in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                full = f"{layer_prefix}.{bname}" if layer_prefix else bname
+                yield full, b
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def apply(self, fn: Callable):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- modes --------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        prefix = structured_name_prefix.rstrip(".")
+        for name, p in self.named_parameters(
+                prefix=prefix, include_sublayers=include_sublayers):
+            dest[name] = p
+        # exclude non-persistable buffers at every nesting level
+        skip = set()
+        for layer, layer_prefix in self._traverse(prefix, include_sublayers):
+            for bname in layer._non_persistable_buffer_names:
+                skip.add(f"{layer_prefix}.{bname}" if layer_prefix else bname)
+        for name, b in self.named_buffers(
+                prefix=prefix, include_sublayers=include_sublayers):
+            if name in skip:
+                continue
+            dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, value in state_dict.items():
+            if name not in own:
+                unexpected.append(name)
+                continue
+            target = own[name]
+            v = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+            if list(v.shape) != list(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: {v.shape} vs {target.shape}")
+            target.set_value(v.astype(target.dtype.name
+                                      if v.dtype.kind == "f" else v.dtype))
+        for name in own:
+            if name not in state_dict:
+                missing.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype/device movement ---------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            for p in self.parameters():
+                p._data = p._data.astype(dt)
+            for _, b in self.named_buffers():
+                if b is not None and b.dtype.kind == "f":
+                    b._data = b._data.astype(dt)
+            for layer in self.sublayers(include_self=True):
+                layer._dtype = dt.name
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def full_name(self):
+        return self._full_name
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    # -- functional bridge (TPU-first; used by paddle_tpu.jit) -------------
+    def functional_state(self):
+        """Return (params_dict, buffers_dict) of jax arrays keyed by
+        structured names — the pytree that paddle_tpu.jit traces over."""
+        params = {n: p._data for n, p in self.named_parameters()}
+        buffers = {n: b._data for n, b in self.named_buffers() if b is not None}
+        return params, buffers
+
+    @contextlib.contextmanager
+    def _swapped_state(self, params: Dict[str, object],
+                       buffers: Optional[Dict[str, object]] = None):
+        """Temporarily substitute raw arrays into the live parameters
+        (torch.func.functional_call-style) so tracing sees pure inputs."""
+        named_p = dict(self.named_parameters())
+        named_b = dict(self.named_buffers())
+        saved_p = {n: t._data for n, t in named_p.items()}
+        saved_b = {n: t._data for n, t in named_b.items() if t is not None}
+        saved_sg = {n: t.stop_gradient for n, t in named_p.items()}
+        try:
+            for n, arr in params.items():
+                named_p[n]._data = arr
+            if buffers:
+                for n, arr in buffers.items():
+                    if n in named_b and named_b[n] is not None:
+                        named_b[n]._data = arr
+            yield
+        finally:
+            for n, arr in saved_p.items():
+                named_p[n]._data = arr
+                named_p[n].stop_gradient = saved_sg[n]
+            for n, arr in saved_b.items():
+                named_b[n]._data = arr
+
+
+class Sequential(Layer):
+    """paddle.nn.Sequential parity."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and (
+                len(layers[0]) == 2 and isinstance(layers[0][0], str)):
+            layers = (layers[0],)
+        for i, item in enumerate(layers):
+            if isinstance(item, tuple):
+                name, layer = item
+            else:
+                name, layer = str(i), item
+            self.add_sublayer(name, layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers)
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers)
+        return self._sub_layers[keys[idx]]
+
+    def __setitem__(self, idx, layer):
+        keys = list(self._sub_layers)
+        self._sub_layers[keys[idx]] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        keys = list(self._parameters)
+        return self._parameters[keys[idx]]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self

@@ -1,0 +1,388 @@
+"""RNN layers (parity: python/paddle/nn/layer/rnn.py; reference kernel:
+operators/rnn_op + cudnn path).
+
+TPU-first: the time loop is a single ``lax.scan`` per direction per layer —
+one compiled XLA while-loop with the cell body fused, instead of the
+reference's per-step kernel launches / cuDNN descriptor machinery.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.initializer import Uniform, _create_param
+from paddle_tpu.nn.layer.common import ParamAttr
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from paddle_tpu.tensor.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(
+                shape[0], (list, tuple)):
+            return tuple(full([b] + list(s), init_value) for s in shape)
+        return full([b] + list(shape), init_value)
+
+
+def _cell_params(cls, input_size, hidden_size, gates, weight_ih_attr,
+                 weight_hh_attr, bias_ih_attr, bias_hh_attr):
+    std = 1.0 / np.sqrt(hidden_size)
+    init = Uniform(-std, std)
+    w_ih = _create_param([gates * hidden_size, input_size], "float32",
+                         attr=ParamAttr._to_attr(weight_ih_attr),
+                         default_initializer=init)
+    w_hh = _create_param([gates * hidden_size, hidden_size], "float32",
+                         attr=ParamAttr._to_attr(weight_hh_attr),
+                         default_initializer=init)
+    b_ih = None if bias_ih_attr is False else _create_param(
+        [gates * hidden_size], "float32", attr=ParamAttr._to_attr(bias_ih_attr),
+        default_initializer=init, is_bias=True)
+    b_hh = None if bias_hh_attr is False else _create_param(
+        [gates * hidden_size], "float32", attr=ParamAttr._to_attr(bias_hh_attr),
+        default_initializer=init, is_bias=True)
+    return w_ih, w_hh, b_ih, b_hh
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        (self.weight_ih, self.weight_hh,
+         self.bias_ih, self.bias_hh) = _cell_params(
+            type(self), input_size, hidden_size, 1, weight_ih_attr,
+            weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _step(x, h, w_ih, w_hh, *biases):
+            z = x @ w_ih.T + h @ w_hh.T
+            for b in biases:
+                z = z + b
+            return act(z)
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        from paddle_tpu.core import apply1
+        h = apply1(_step, *args, name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        (self.weight_ih, self.weight_hh,
+         self.bias_ih, self.bias_hh) = _cell_params(
+            type(self), input_size, hidden_size, 4, weight_ih_attr,
+            weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def _step(x, hp, cp, w_ih, w_hh, *biases):
+            z = x @ w_ih.T + hp @ w_hh.T
+            for b in biases:
+                z = z + b
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            cn = f * cp + i * g
+            hn = o * jnp.tanh(cn)
+            return hn, cn
+        args = [inputs, h, c, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        hn, cn = apply(_step, *args, name="lstm_cell")
+        return hn, (hn, cn)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        (self.weight_ih, self.weight_hh,
+         self.bias_ih, self.bias_hh) = _cell_params(
+            type(self), input_size, hidden_size, 3, weight_ih_attr,
+            weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _step(x, hp, w_ih, w_hh, *biases):
+            gi = x @ w_ih.T
+            gh = hp @ w_hh.T
+            if biases:
+                gi = gi + biases[0]
+                if len(biases) > 1:
+                    gh = gh + biases[1]
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * hp
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        from paddle_tpu.core import apply1
+        h = apply1(_step, *args, name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Run a cell over time (reference: nn/layer/rnn.py RNN) — lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outputs = []
+        # python loop over time on the tape (correct everywhere);
+        # the jitted fast path is the multi-layer LSTM/GRU below.
+        x = inputs
+        steps = x.shape[0] if self.time_major else x.shape[1]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = [None] * steps
+        for t in order:
+            xt = x[t] if self.time_major else x[:, t]
+            o, states = self.cell(xt, states)
+            outs[t] = o
+        from paddle_tpu.tensor.manipulation import stack
+        out = stack(outs, axis=0 if self.time_major else 1)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        from paddle_tpu.tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net over lax.scan.
+
+    The whole stack runs as one jax computation via apply() — weights enter as
+    differentiable tensor args, the scan is inside, so eager backward and jit
+    capture both work.
+    """
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, activation=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirectional else 1
+        gates = {"LSTM": 4, "GRU": 3}.get(self.MODE[:4].rstrip("_"), 1)
+        if self.MODE.startswith("LSTM"):
+            gates = 4
+        elif self.MODE.startswith("GRU"):
+            gates = 3
+        else:
+            gates = 1
+        self._gates = gates
+        self._num_dirs = num_dirs
+        self.weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                w_ih, w_hh, b_ih, b_hh = _cell_params(
+                    type(self), in_sz, hidden_size, gates, weight_ih_attr,
+                    weight_hh_attr, bias_ih_attr, bias_hh_attr)
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                self.add_parameter(f"weight_ih{sfx}", w_ih)
+                self.add_parameter(f"weight_hh{sfx}", w_hh)
+                if b_ih is not None:
+                    self.add_parameter(f"bias_ih{sfx}", b_ih)
+                if b_hh is not None:
+                    self.add_parameter(f"bias_hh{sfx}", b_hh)
+
+    def _cell_fn(self):
+        mode = self.MODE
+
+        def step(carry, xt, w_ih, w_hh, b_ih, b_hh):
+            if mode.startswith("LSTM"):
+                hp, cp = carry
+                z = xt @ w_ih.T + hp @ w_hh.T + b_ih + b_hh
+                i, f, g, o = jnp.split(z, 4, axis=-1)
+                i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                           jax.nn.sigmoid(o))
+                g = jnp.tanh(g)
+                cn = f * cp + i * g
+                hn = o * jnp.tanh(cn)
+                return (hn, cn), hn
+            if mode.startswith("GRU"):
+                hp = carry
+                gi = xt @ w_ih.T + b_ih
+                gh = hp @ w_hh.T + b_hh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                c = jnp.tanh(ic + r * hc)
+                hn = (1 - z) * c + z * hp
+                return hn, hn
+            hp = carry
+            act = jnp.tanh if mode.endswith("TANH") else jax.nn.relu
+            hn = act(xt @ w_ih.T + hp @ w_hh.T + b_ih + b_hh)
+            return hn, hn
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.MODE.startswith("LSTM")
+        L, D, H = self.num_layers, self._num_dirs, self.hidden_size
+        step = self._cell_fn()
+        time_major = self.time_major
+
+        param_list = []
+        for layer in range(L):
+            for d in range(D):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                param_list += [getattr(self, f"weight_ih{sfx}"),
+                               getattr(self, f"weight_hh{sfx}"),
+                               getattr(self, f"bias_ih{sfx}"),
+                               getattr(self, f"bias_hh{sfx}")]
+
+        n_state = 2 if is_lstm else 1
+        state_args = []
+        if initial_states is not None:
+            if is_lstm:
+                state_args = [initial_states[0], initial_states[1]]
+            else:
+                state_args = [initial_states]
+
+        def _run(x, *flat):
+            params = flat[:4 * L * D]
+            states = flat[4 * L * D:]
+            if time_major:
+                x = jnp.swapaxes(x, 0, 1)  # → batch-major internally? no: keep
+            xt = x if not time_major else x
+            seq = x if time_major else jnp.swapaxes(x, 0, 1)  # (T, B, F)
+            b = seq.shape[1]
+            if states:
+                h0_all = states[0]
+                c0_all = states[1] if is_lstm else None
+            else:
+                h0_all = jnp.zeros((L * D, b, H), seq.dtype)
+                c0_all = jnp.zeros((L * D, b, H), seq.dtype) if is_lstm else None
+            out = seq
+            h_final, c_final = [], []
+            for layer in range(L):
+                dir_outs = []
+                for d in range(D):
+                    idx = layer * D + d
+                    w_ih, w_hh, b_ih, b_hh = params[4 * idx: 4 * idx + 4]
+                    h0 = h0_all[idx]
+                    carry0 = (h0, c0_all[idx]) if is_lstm else h0
+                    seq_d = jnp.flip(out, axis=0) if d == 1 else out
+
+                    def body(carry, xt_, _w_ih=w_ih, _w_hh=w_hh, _b_ih=b_ih,
+                             _b_hh=b_hh):
+                        return step(carry, xt_, _w_ih, _w_hh, _b_ih, _b_hh)
+                    carry_f, ys = jax.lax.scan(body, carry0, seq_d)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    dir_outs.append(ys)
+                    if is_lstm:
+                        h_final.append(carry_f[0])
+                        c_final.append(carry_f[1])
+                    else:
+                        h_final.append(carry_f)
+                out = jnp.concatenate(dir_outs, axis=-1) if D == 2 else \
+                    dir_outs[0]
+            h_out = jnp.stack(h_final, axis=0)
+            outputs = out if time_major else jnp.swapaxes(out, 0, 1)
+            if is_lstm:
+                return outputs, h_out, jnp.stack(c_final, axis=0)
+            return outputs, h_out
+
+        results = apply(_run, inputs, *param_list, *state_args, name=self.MODE)
+        if is_lstm:
+            out, h, c = results
+            return out, (h, c)
+        out, h = results
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

@@ -1,0 +1,94 @@
+"""nn.utils — weight_norm/spectral_norm wrappers + clip helpers
+(parity: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference:
+    python/paddle/nn/utils/weight_norm_hook.py)."""
+    from paddle_tpu.core import Parameter
+    w = getattr(layer, name)
+    d = dim if dim is not None else -1
+    axes = tuple(i for i in range(w.ndim) if i != (dim if dim is not None else 0))
+    norm = jnp.sqrt(jnp.sum(np.asarray(w._data) ** 2, axis=axes, keepdims=True))
+    g = Parameter(jnp.asarray(norm), name=f"{name}_g")
+    v = Parameter(w._data, name=f"{name}_v")
+    delattr(layer, name)
+    layer.add_parameter(f"{name}_g", g)
+    layer.add_parameter(f"{name}_v", v)
+
+    def hook(lyr, inputs):
+        from paddle_tpu.core import apply1
+        gg = getattr(lyr, f"{name}_g")
+        vv = getattr(lyr, f"{name}_v")
+        axes2 = tuple(i for i in range(vv.ndim)
+                      if i != (dim if dim is not None else 0))
+
+        def _wn(gv, vval):
+            nrm = jnp.sqrt(jnp.sum(vval * vval, axis=axes2, keepdims=True))
+            return gv * vval / jnp.maximum(nrm, 1e-12)
+        w_new = apply1(_wn, gg, vv, name="weight_norm")
+        object.__setattr__(lyr, name, w_new)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    # materialise once so attribute exists before first call
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from paddle_tpu.core import Parameter
+    g = getattr(layer, f"{name}_g")
+    v = getattr(layer, f"{name}_v")
+    axes = tuple(range(1, v.ndim))
+    nrm = jnp.sqrt(jnp.sum(v._data ** 2, axis=axes, keepdims=True))
+    w = Parameter(g._data * v._data / jnp.maximum(nrm, 1e-12), name=name)
+    delattr(layer, f"{name}_g")
+    delattr(layer, f"{name}_v")
+    if hasattr(layer, "_weight_norm_handle"):
+        layer._weight_norm_handle.remove()
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from paddle_tpu.nn.layer.norm import SpectralNorm
+    w = getattr(layer, name)
+    sn = SpectralNorm(w.shape, dim=dim or 0, power_iters=n_power_iterations,
+                      eps=eps)
+    layer.add_sublayer(f"{name}_spectral_norm", sn)
+
+    def hook(lyr, inputs):
+        base = lyr._parameters.get(f"{name}_orig")
+        w_new = sn(base)
+        object.__setattr__(lyr, name, w_new)
+    orig = getattr(layer, name)
+    delattr(layer, name)
+    layer.add_parameter(f"{name}_orig", orig)
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    from paddle_tpu.tensor.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(vec[offset:offset + n].reshape(p.shape))
+        offset += n

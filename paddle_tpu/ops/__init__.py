@@ -1,0 +1,7 @@
+"""Low-level op backends: Pallas TPU kernels (ops/pallas) and native C++
+host-side engines (ops/native).
+
+Role in the architecture: the TPU-native replacement for the reference's
+fused CUDA kernels (paddle/fluid/operators/fused/) and the C++ host runtime
+pieces (data feed, embedding tables).
+"""

@@ -1,0 +1,14 @@
+"""Optimizers (parity: python/paddle/optimizer/ + reference C++ kernels in
+operators/optimizers/ — sgd_op, momentum_op, adam_op, adamw, lamb, lars,
+adagrad, adadelta, rmsprop).
+
+Design: paddle-style stateful API (``opt.step()`` reads ``param.grad``) over
+pure functional update rules.  Each optimizer exposes ``update(param, grad,
+state, lr) -> (new_param, new_state)`` as pure jax code so paddle_tpu.jit can
+fuse the whole update into the training step, and ``step()`` applies it
+eagerly for dygraph parity.
+"""
+from paddle_tpu.optimizer.optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb)
+from paddle_tpu.optimizer import lr  # noqa: F401

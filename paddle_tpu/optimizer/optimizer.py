@@ -1,0 +1,439 @@
+"""Optimizer implementations. See package docstring for design."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Parameter, Tensor, no_grad
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+def _as_float(v):
+    if isinstance(v, Tensor):
+        return v._data
+    return v
+
+
+class Optimizer:
+    """Base (reference: python/paddle/optimizer/optimizer.py Optimizer)."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        from paddle_tpu.optimizer.lr import LRScheduler
+        self._lr = learning_rate
+        self._lr_scheduler = learning_rate if isinstance(
+            learning_rate, LRScheduler) else None
+        if parameters is not None:
+            self._parameter_list = list(parameters)
+        else:
+            self._parameter_list = None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[int, dict] = {}
+        self._global_step = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._lr = value
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    # -- state ---------------------------------------------------------------
+    def _state_for(self, p: Parameter) -> dict:
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self.init_state(p._data)
+            self._accumulators[key]["__param_ref"] = p
+        return self._accumulators[key]
+
+    def init_state(self, value) -> dict:
+        return {}
+
+    def update(self, param, grad, state: dict, lr):
+        """Pure update rule: (array, array, state-dict of arrays, lr) →
+        (new_param, new_state).  Override in subclasses."""
+        raise NotImplementedError
+
+    def _apply_decay(self, p, param, grad):
+        """Coupled decay folded into the gradient (reference:
+        append_regularization_ops + L1/L2DecayRegularizer).  Per-parameter
+        ParamAttr regularizers take precedence over the optimizer-level
+        weight_decay, matching the reference's behavior; AdamW overrides to
+        decouple."""
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            return grad + reg(param)
+        wd = self._weight_decay
+        if wd is None:
+            return grad
+        if callable(wd) and not isinstance(wd, (int, float)):
+            return grad + wd(param)  # L1Decay/L2Decay instance
+        return grad + float(wd) * param
+
+    @no_grad()
+    def step(self):
+        lr = self.get_lr()
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("Optimizer created without parameters")
+        grads_and_params = [(p, p._grad) for p in params
+                            if p._grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            clipped = self._grad_clip(
+                [(p, g) for p, g in grads_and_params])
+            grads_and_params = clipped
+        self._global_step += 1
+        for p, g in grads_and_params:
+            state = self._state_for(p)
+            p_lr = lr * getattr(p, "optimize_attr",
+                                {"learning_rate": 1.0})["learning_rate"]
+            garr = g._data if isinstance(g, Tensor) else g
+            garr = self._apply_decay(p, p._data, garr)
+            new_p, new_state = self.update(p._data, garr, state, p_lr)
+            p._data = new_p
+            state.update(new_state)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- checkpoint ----------------------------------------------------------
+    def state_dict(self) -> dict:
+        sd = {}
+        for i, p in enumerate(self._parameter_list or []):
+            st = self._accumulators.get(id(p))
+            if st:
+                for k, v in st.items():
+                    if k == "__param_ref":
+                        continue
+                    sd[f"{p.name}_{k}"] = Tensor(v) if not isinstance(
+                        v, (int, float)) else v
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        sd["@global_step"] = self._global_step
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("@global_step", 0))
+        if self._lr_scheduler is not None and "LR_Scheduler" in state_dict:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._parameter_list or []:
+            st = self._state_for(p)
+            for k in list(st.keys()):
+                if k == "__param_ref":
+                    continue
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[k] = v._data if isinstance(v, Tensor) else v
+
+    # -- functional bridge for jit/distributed ------------------------------
+    def functional_update(self, params: dict, grads: dict, states: dict,
+                          lr=None, step=None):
+        """Pure pytree update used by paddle_tpu.jit.TrainStep and the Fleet
+        strategies: no host state is touched."""
+        lr = self.get_lr() if lr is None else lr
+        new_params, new_states = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_states[name] = states.get(name, {})
+                continue
+            st = dict(states.get(name, {}))
+            if self._weight_decay is not None and not isinstance(
+                    self, AdamW):
+                wd = self._weight_decay
+                g = g + (wd(p) if callable(wd) else float(wd) * p)
+            np_, ns = self.update(p, g, st, lr)
+            new_params[name] = np_
+            new_states[name] = ns
+        return new_params, new_states
+
+    def functional_init_states(self, params: dict) -> dict:
+        return {name: {k: v for k, v in self.init_state(p).items()}
+                for name, p in params.items()}
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op."""
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def update(self, param, grad, state, lr):
+        return param - lr * grad, {}
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op (use_nesterov attr)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, value):
+        return {"velocity": jnp.zeros_like(value)}
+
+    def update(self, param, grad, state, lr):
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            new_p = param - lr * (grad + self._momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op (beta pow accumulators)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def init_state(self, value):
+        return {"moment1": jnp.zeros_like(value),
+                "moment2": jnp.zeros_like(value),
+                "beta1_pow": jnp.ones((), value.dtype if jnp.issubdtype(
+                    value.dtype, jnp.floating) else jnp.float32),
+                "beta2_pow": jnp.ones((), value.dtype if jnp.issubdtype(
+                    value.dtype, jnp.floating) else jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        new_p = param - lr_t * m / (jnp.sqrt(v) + eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py —
+    decay applied directly to param, not through the moment estimates)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._coeff = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_decay(self, p, param, grad):
+        return grad  # decoupled — handled in update via param name check
+
+    def update(self, param, grad, state, lr):
+        new_p, new_state = super().update(param, grad, state, lr)
+        decay = lr * float(self._coeff)
+        new_p = new_p - decay * param
+        return new_p, new_state
+
+    def step(self):
+        if self._apply_decay_param_fun is None:
+            return super().step()
+        # selectively decay
+        coeff = self._coeff
+        lr = self.get_lr()
+        self._global_step += 1
+        grads_and_params = [(p, p._grad) for p in self._parameter_list
+                            if p._grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            grads_and_params = self._grad_clip(grads_and_params)
+        for p, g in grads_and_params:
+            state = self._state_for(p)
+            garr = g._data if isinstance(g, Tensor) else g
+            b1, b2, eps = self._beta1, self._beta2, self._epsilon
+            m = b1 * state["moment1"] + (1 - b1) * garr
+            v = b2 * state["moment2"] + (1 - b2) * garr * garr
+            b1p = state["beta1_pow"] * b1
+            b2p = state["beta2_pow"] * b2
+            lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+            new_p = p._data - lr_t * m / (jnp.sqrt(v) + eps)
+            if self._apply_decay_param_fun(p.name):
+                new_p = new_p - lr * coeff * p._data
+            p._data = new_p
+            state.update({"moment1": m, "moment2": v, "beta1_pow": b1p,
+                          "beta2_pow": b2p})
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_state(self, value):
+        return {"moment": jnp.zeros_like(value),
+                "inf_norm": jnp.zeros_like(value),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def update(self, param, grad, state, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(grad))
+        b1p = state["beta1_pow"] * b1
+        new_p = param - (lr / (1 - b1p)) * m / (u + eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, value):
+        return {"moment": jnp.full_like(value, self._init_acc)}
+
+    def update(self, param, grad, state, lr):
+        acc = state["moment"] + grad * grad
+        new_p = param - lr * grad / (jnp.sqrt(acc) + self._epsilon)
+        return new_p, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def init_state(self, value):
+        return {"avg_squared_grad": jnp.zeros_like(value),
+                "avg_squared_update": jnp.zeros_like(value)}
+
+    def update(self, param, grad, state, lr):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * grad * grad
+        upd = grad * jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        return param - lr * upd, {"avg_squared_grad": asg,
+                                  "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_state(self, value):
+        st = {"mean_square": jnp.zeros_like(value),
+              "momentum": jnp.zeros_like(value)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(value)
+        return st
+
+    def update(self, param, grad, state, lr):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * grad * grad
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum"] + lr * grad / denom
+        new_p = param - mom
+        st = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            st["mean_grad"] = mg
+        return new_p, st
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op — layer-wise trust ratio."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, value):
+        return {"moment1": jnp.zeros_like(value),
+                "moment2": jnp.zeros_like(value),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def update(self, param, grad, state, lr, decay=True):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + eps)
+        if decay:
+            r = r + self._lamb_wd * param
+        w_norm = jnp.linalg.norm(param.reshape(-1))
+        r_norm = jnp.linalg.norm(r.reshape(-1))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = param - lr * trust * r
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+    def step(self):
+        if self._exclude_fn is None:
+            return super().step()
+        lr = self.get_lr()
+        self._global_step += 1
+        grads_and_params = [(p, p._grad) for p in self._parameter_list
+                            if p._grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            grads_and_params = self._grad_clip(grads_and_params)
+        for p, g in grads_and_params:
+            state = self._state_for(p)
+            garr = g._data if isinstance(g, Tensor) else g
+            decay = not self._exclude_fn(p)
+            new_p, new_state = self.update(p._data, garr, state, lr,
+                                           decay=decay)
+            p._data = new_p
+            state.update(new_state)

@@ -1,0 +1,35 @@
+"""Weight-decay regularizers (parity: python/paddle/regularizer.py /
+fluid/regularizer.py — L1Decay/L2Decay appended to gradients by the
+optimizer, reference: optimizer.py append_regularization_ops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param):
+        return self.coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param):
+        return self.coeff * param
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"

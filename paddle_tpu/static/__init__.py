@@ -1,0 +1,66 @@
+"""Static-graph compatibility surface.
+
+The reference's static mode (ProgramDesc + Executor, python/paddle/static/)
+has no TPU-native analogue — jit capture *is* the static mode.  This module
+keeps the API names alive: ``paddle.enable_static()`` flips a flag,
+``static.InputSpec`` feeds paddle_tpu.jit.to_static, and Program/Executor
+raise informative errors pointing at the jit path.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["InputSpec", "enable_static", "disable_static"]
+
+_state = threading.local()
+
+
+def _in_static_mode() -> bool:
+    return getattr(_state, "static", False)
+
+
+def _enable_static():
+    _state.static = True
+
+
+def _disable_static():
+    _state.static = False
+
+
+def enable_static():
+    _enable_static()
+
+
+def disable_static():
+    _disable_static()
+
+
+class InputSpec:
+    """Shape/dtype spec for jit capture (parity:
+    paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype!r})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name)
+
+
+class Program:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ProgramDesc-style static graphs do not exist in paddle_tpu; "
+            "use paddle_tpu.jit.to_static (XLA capture) instead")
+
+
+class Executor:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "the C++ Executor does not exist in paddle_tpu; jit-compiled "
+            "functions dispatch straight to XLA (see paddle_tpu.jit)")

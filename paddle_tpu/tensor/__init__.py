@@ -1,0 +1,186 @@
+"""paddle_tpu.tensor — the tensor method library.
+
+Parity target: python/paddle/tensor/ (~9k LoC in the reference).  Every public
+function is exposed both as ``paddle_tpu.<fn>`` and as a ``Tensor`` method,
+mirroring the reference's monkey-patching of VarBase
+(python/paddle/fluid/dygraph/varbase_patch_methods.py + tensor/__init__.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply1, convert_dtype
+
+from paddle_tpu.tensor import creation, linalg, logic, manipulation, math
+from paddle_tpu.tensor import random, search, stat
+from paddle_tpu.tensor.creation import *  # noqa: F401,F403
+from paddle_tpu.tensor.linalg import *  # noqa: F401,F403
+from paddle_tpu.tensor.logic import *  # noqa: F401,F403
+from paddle_tpu.tensor.manipulation import *  # noqa: F401,F403
+from paddle_tpu.tensor.math import *  # noqa: F401,F403
+from paddle_tpu.tensor.random import *  # noqa: F401,F403
+from paddle_tpu.tensor.search import *  # noqa: F401,F403
+from paddle_tpu.tensor.stat import (mean, std, var, median, nanmedian,  # noqa: F401
+                                    quantile, nanquantile)
+
+
+def einsum(equation, *operands):
+    """paddle.einsum parity → jnp.einsum (MXU-friendly contraction)."""
+    return apply1(lambda *arrs: jnp.einsum(equation, *arrs), *operands,
+                  name="einsum")
+
+
+def histogramdd(*a, **k):
+    raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# operator overloads + method patching
+# ---------------------------------------------------------------------------
+
+def _coerce(other):
+    return other
+
+
+def _patch_tensor_methods():
+    T = Tensor
+
+    # arithmetic dunders
+    T.__add__ = lambda s, o: math.add(s, _coerce(o))
+    T.__radd__ = lambda s, o: math.add(s, _coerce(o))
+    T.__sub__ = lambda s, o: math.subtract(s, _coerce(o))
+    T.__rsub__ = lambda s, o: apply1(lambda a: jnp.subtract(o, a), s, name="rsub")
+    T.__mul__ = lambda s, o: math.multiply(s, _coerce(o))
+    T.__rmul__ = lambda s, o: math.multiply(s, _coerce(o))
+    T.__truediv__ = lambda s, o: math.divide(s, _coerce(o))
+    T.__rtruediv__ = lambda s, o: apply1(lambda a: jnp.divide(o, a), s,
+                                         name="rdiv")
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, _coerce(o))
+    T.__mod__ = lambda s, o: math.remainder(s, _coerce(o))
+    T.__pow__ = lambda s, o: math.pow(s, _coerce(o))
+    T.__rpow__ = lambda s, o: apply1(lambda a: jnp.power(o, a), s, name="rpow")
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: apply1(lambda a: jnp.matmul(o, a), s,
+                                        name="rmatmul")
+
+    # comparisons
+    T.__eq__ = lambda s, o: logic.equal(s, o)
+    T.__ne__ = lambda s, o: logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: logic.less_than(s, o)
+    T.__le__ = lambda s, o: logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    T.__invert__ = lambda s: logic.logical_not(s)
+    T.__and__ = lambda s, o: logic.bitwise_and(s, o)
+    T.__or__ = lambda s, o: logic.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logic.bitwise_xor(s, o)
+
+    # indexing
+    def _getitem(s, idx):
+        def unwrap(i):
+            if isinstance(i, Tensor):
+                return i._data
+            if isinstance(i, tuple):
+                return tuple(unwrap(j) for j in i)
+            return i
+        idx = unwrap(idx)
+        return apply1(lambda a: a[idx], s, name="getitem")
+
+    def _setitem(s, idx, value):
+        def unwrap(i):
+            if isinstance(i, Tensor):
+                return i._data
+            if isinstance(i, tuple):
+                return tuple(unwrap(j) for j in i)
+            return i
+        idx = unwrap(idx)
+        v = value._data if isinstance(value, Tensor) else value
+        s._data = s._data.at[idx].set(v)
+
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # attach function namespaces as methods
+    mods = [creation, linalg, logic, manipulation, math, search, stat, random]
+    skip = {"to_tensor", "as_tensor", "zeros", "ones", "full", "empty",
+            "arange", "linspace", "logspace", "eye", "meshgrid", "rand",
+            "randn", "randint", "uniform", "normal", "randperm", "seed",
+            "create_parameter", "create_tensor", "is_tensor",
+            "standard_normal", "poisson", "get_rng_state", "set_rng_state"}
+    for mod in mods:
+        for fname in getattr(mod, "__all__", []):
+            if fname in skip or hasattr(T, fname):
+                continue
+            fn = getattr(mod, fname, None)
+            if callable(fn):
+                setattr(T, fname, fn)
+
+    # common aliases / extras
+    T.astype = lambda s, dtype: manipulation.cast(s, dtype)
+    T.cast = T.astype
+    T.dim = lambda s: s.ndim
+    T.rank = lambda s: Tensor(np.int64(s.ndim))
+    T.mean = stat.mean
+    T.std = stat.std
+    T.var = stat.var
+    T.reshape = manipulation.reshape
+    T.pow = math.pow
+    T.abs = math.abs
+    T.sum = math.sum
+    T.max = math.max
+    T.min = math.min
+    T.matmul = linalg.matmul
+    T.mm = linalg.mm
+    T.norm = linalg.norm
+    T.scale = math.scale
+    T.exp = math.exp
+    T.log = math.log
+    T.sqrt = math.sqrt
+    T.tanh = math.tanh
+    T.sigmoid = math.sigmoid
+    T.unique = manipulation.unique
+    T.topk = search.topk
+    T.uniform_ = random.uniform_
+    T.normal_ = random.normal_
+    T.exponential_ = random.exponential_
+
+    def _add_(s, o):
+        s._data = s._data + (o._data if isinstance(o, Tensor) else o)
+        return s
+
+    def _scale_(s, scale=1.0, bias=0.0):
+        s._data = s._data * scale + bias
+        return s
+
+    def _subtract_(s, o):
+        s._data = s._data - (o._data if isinstance(o, Tensor) else o)
+        return s
+
+    def _clip_(s, min=None, max=None):
+        s._data = jnp.clip(s._data, min, max)
+        return s
+
+    T.add_ = _add_
+    T.scale_ = _scale_
+    T.subtract_ = _subtract_
+    T.clip_ = _clip_
+
+
+_patch_tensor_methods()
+
+
+def add_n(inputs, name=None):
+    """operators/sum_op parity."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply1(lambda *arrs: sum_arrays(arrs), *inputs, name="add_n")
+
+
+def sum_arrays(arrs):
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = out + a
+    return out

@@ -1,0 +1,191 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import (Tensor, Parameter, apply1, convert_dtype,
+                             get_default_dtype, _default_jax_device)
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "meshgrid", "diag", "diagflat", "tril", "triu", "assign", "clone",
+    "numel", "tolist", "create_parameter", "create_tensor", "complex",
+    "as_tensor",
+]
+
+
+def _resolve_dtype(dtype, default=None):
+    if dtype is None:
+        return convert_dtype(default) if default is not None else None
+    return convert_dtype(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity; place is accepted and ignored (XLA owns it)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    if isinstance(data, (list, tuple)) and any(
+            isinstance(x, Tensor) for x in jax.tree_util.tree_leaves(data)):
+        data = np.asarray(jax.tree_util.tree_map(
+            lambda x: x.numpy() if isinstance(x, Tensor) else x, data))
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+as_tensor = to_tensor
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._data) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    dtype = _resolve_dtype(dtype, get_default_dtype())
+    return Tensor(jnp.zeros(_shape_list(shape), dtype=dtype))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    dtype = _resolve_dtype(dtype, get_default_dtype())
+    return Tensor(jnp.ones(_shape_list(shape), dtype=dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int64
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(_shape_list(shape), fill_value,
+                           dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros_like(x._data, dtype=_resolve_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones_like(x._data, dtype=_resolve_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.full_like(x._data, fill_value,
+                                dtype=_resolve_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (jnp.int64 if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    dtype = _resolve_dtype(dtype, get_default_dtype())
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    dtype = _resolve_dtype(dtype, get_default_dtype())
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    dtype = _resolve_dtype(dtype, get_default_dtype())
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=dtype))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[a._data for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    def _diag(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            idx = jnp.arange(a.shape[0])
+            r = idx if offset >= 0 else idx - offset
+            c = idx + offset if offset >= 0 else idx
+            return base.at[r, c].set(a)
+        return jnp.diag(a, k=offset)
+    return apply1(_diag, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply1(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply1(lambda a: jnp.tril(a, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply1(lambda a: jnp.triu(a, k=diagonal), x, name="triu")
+
+
+def assign(x, output=None) -> Tensor:
+    val = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    if output is None:
+        return apply1(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact)
+                      else jnp.asarray(a), val, name="assign")
+    output.set_value(val)
+    return output
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone()
+
+
+def numel(x, name=None) -> Tensor:
+    return Tensor(np.int64(x.size))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def create_tensor(dtype="float32", name=None, persistable=False) -> Tensor:
+    return Tensor(jnp.zeros((), dtype=convert_dtype(dtype)),
+                  persistable=persistable, name=name)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None) -> Parameter:
+    from paddle_tpu.nn.initializer import _create_param
+    return _create_param(shape, dtype, attr=attr, is_bias=is_bias,
+                         default_initializer=default_initializer, name=name)
+
+
+def complex(real, imag, name=None) -> Tensor:
+    from paddle_tpu.core import apply1 as _a
+    return _a(jax.lax.complex, real, imag, name="complex")

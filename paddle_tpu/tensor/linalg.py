@@ -1,0 +1,220 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py).
+
+matmul maps straight onto the MXU via jnp; decompositions use
+jax.numpy.linalg / jax.scipy.linalg (XLA custom calls).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor, apply, apply1
+
+__all__ = [
+    "matmul", "bmm", "mm", "mv", "norm", "dist", "cond", "cholesky",
+    "cholesky_solve", "inverse", "det", "slogdet", "svd", "qr", "eig",
+    "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank", "multi_dot",
+    "pinv", "solve", "triangular_solve", "lstsq", "lu", "corrcoef", "cov",
+    "histogram", "bincount", "mode",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _matmul(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply1(_matmul, x, y, name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return apply1(jnp.matmul, x, y, name="bmm")
+
+
+def mv(x, vec, name=None):
+    return apply1(jnp.matmul, x, vec, name="mv")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def _norm(a):
+        if axis is None and p == "fro":
+            return jnp.sqrt(jnp.sum(a * a))
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=p, keepdims=keepdim)
+        if isinstance(axis, (list, tuple)):
+            return jnp.linalg.norm(a, ord="fro" if p == "fro" else p,
+                                   axis=tuple(axis), keepdims=keepdim)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis,
+                           keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return apply1(_norm, x, name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    def _dist(a, b):
+        d = a - b
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype)).astype(d.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply1(_dist, x, y, name="dist")
+
+
+def cond(x, p=None, name=None):
+    return apply1(lambda a: jnp.linalg.cond(a, p=p), x, name="cond")
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply1(_chol, x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _cs(b, l):
+        if upper:
+            l = jnp.swapaxes(l, -1, -2)
+        z = jax.scipy.linalg.solve_triangular(l, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(l, -1, -2), z, lower=False)
+    return apply1(_cs, x, y, name="cholesky_solve")
+
+
+def inverse(x, name=None):
+    return apply1(jnp.linalg.inv, x, name="inverse")
+
+
+def det(x, name=None):
+    return apply1(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    def _slogdet(a):
+        s, l = jnp.linalg.slogdet(a)
+        return jnp.stack([s, l])
+    return apply1(_slogdet, x, name="slogdet")
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                 x, name="svd")
+    return tuple(outs)
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, name="qr")
+    return tuple(outs) if mode != "r" else outs[0]
+
+
+def eig(x, name=None):
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x._data))
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, name="eigh")
+    return tuple(outs)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return Tensor(np.linalg.eigvals(np.asarray(x._data)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply1(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x,
+                  name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return apply1(lambda a: jnp.linalg.matrix_power(a, n), x,
+                  name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply1(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x,
+                  name="matrix_rank")
+
+
+def multi_dot(x, name=None):
+    return apply1(lambda *arrs: jnp.linalg.multi_dot(arrs), *x,
+                  name="multi_dot")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply1(lambda a: jnp.linalg.pinv(a, rtol=rcond), x, name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply1(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def _ts(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply1(_ts, x, y, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    outs = apply(lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+                 x, y, name="lstsq")
+    return tuple(outs)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32)))
+    if get_infos:
+        outs = outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply1(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply1(lambda a: jnp.cov(a, rowvar=rowvar,
+                                    ddof=1 if ddof else 0), x, name="cov")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def _hist(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        return jnp.histogram(a, bins=bins, range=(lo, hi))[0]
+    return apply1(_hist, input, nondiff=(0,), name="histogram")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    import numpy as np
+    w = np.asarray(weights._data) if weights is not None else None
+    return Tensor(np.bincount(np.asarray(x._data), weights=w,
+                              minlength=minlength))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    arr = np.asarray(x._data)
+    from scipy import stats as _stats  # pragma: no cover
+    raise NotImplementedError("mode: use paddle_tpu.tensor.search.kthvalue")

@@ -1,0 +1,488 @@
+"""Shape/layout manipulation ops (parity: python/paddle/tensor/manipulation.py).
+
+The reference implements these as C++ kernels (reshape_op.cc, transpose_op.cc,
+concat_op.cc, …); here every one is a jnp/lax view op that XLA folds away.
+"""
+from __future__ import annotations
+
+import builtins
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply, apply1, convert_dtype
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _int_list(v):
+    if isinstance(v, Tensor):
+        return [int(i) for i in v.numpy().tolist()]
+    if isinstance(v, (int, np.integer)):
+        return [int(v)]
+    return [int(i._data) if isinstance(i, Tensor) else int(i) for i in v]
+
+
+@_export
+def reshape(x, shape, name=None):
+    shape = _int_list(shape)
+    return apply1(lambda a: jnp.reshape(a, shape), x, name="reshape")
+
+
+@_export
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, _int_list(shape))
+    return x
+
+
+@_export
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _flatten(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply1(_flatten, x, name="flatten")
+
+
+@_export
+def transpose(x, perm, name=None):
+    perm = _int_list(perm)
+    return apply1(lambda a: jnp.transpose(a, perm), x, name="transpose")
+
+
+@_export
+def moveaxis(x, source, destination, name=None):
+    return apply1(lambda a: jnp.moveaxis(a, source, destination), x,
+                  name="moveaxis")
+
+
+@_export
+def swapaxes(x, axis1, axis2, name=None):
+    return apply1(lambda a: jnp.swapaxes(a, axis1, axis2), x, name="swapaxes")
+
+
+@_export
+def t(x, name=None):
+    def _t(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+    return apply1(_t, x, name="t")
+
+
+@_export
+def concat(x, axis=0, name=None):
+    axis = int(_unwrap(axis)) if not isinstance(axis, int) else axis
+    tensors = list(x)
+    return apply1(lambda *arrs: jnp.concatenate(arrs, axis=axis), *tensors,
+                  name="concat")
+
+
+@_export
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply1(lambda *arrs: jnp.stack(arrs, axis=axis), *tensors,
+                  name="stack")
+
+
+@_export
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    outs = apply(lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
+                 x, name="unstack")
+    return list(outs)
+
+
+@_export
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(_unwrap(axis)) if not isinstance(axis, int) else axis
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {axis} size {dim} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = _int_list(num_or_sections)
+        n_unknown = builtins.sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = builtins.sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes).tolist()
+
+    def _split(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, offsets[i], offsets[i + 1], axis=axis)
+            for i in range(len(sizes)))
+    return list(apply(_split, x, name="split"))
+
+
+@_export
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+@_export
+def squeeze(x, axis=None, name=None):
+    def _squeeze(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply1(_squeeze, x, name="squeeze")
+
+
+squeeze_ = squeeze
+__all__.append("squeeze_")
+
+
+@_export
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = _int_list(axes)
+
+    def _unsq(a):
+        out = a
+        for ax in axes:
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply1(_unsq, x, name="unsqueeze")
+
+
+unsqueeze_ = unsqueeze
+__all__.append("unsqueeze_")
+
+
+@_export
+def tile(x, repeat_times, name=None):
+    reps = _int_list(repeat_times)
+    return apply1(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+@_export
+def expand(x, shape, name=None):
+    shape = _int_list(shape)
+
+    def _expand(a):
+        tgt = list(shape)
+        # paddle: -1 means keep dim
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tgt)
+    return apply1(_expand, x, name="expand")
+
+
+@_export
+def expand_as(x, y, name=None):
+    tgt = tuple(y.shape)
+    return apply1(lambda a: jnp.broadcast_to(a, tgt), x, name="expand_as")
+
+
+@_export
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@_export
+def broadcast_tensors(inputs, name=None):
+    arrs = jnp.broadcast_arrays(*[t._data for t in inputs])
+    shapes = [a.shape for a in arrs]
+    outs = []
+    for t, s in zip(inputs, shapes):
+        outs.append(apply1(lambda a, _s=s: jnp.broadcast_to(a, _s), t,
+                           name="broadcast_tensors"))
+    return outs
+
+
+@_export
+def flip(x, axis, name=None):
+    axes = _int_list(axis if isinstance(axis, (list, tuple)) else [axis])
+    return apply1(lambda a: jnp.flip(a, axis=axes), x, name="flip")
+
+
+@_export
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply1(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, name="rot90")
+
+
+@_export
+def roll(x, shifts, axis=None, name=None):
+    return apply1(lambda a: jnp.roll(a, shifts, axis=axis), x, name="roll")
+
+
+@_export
+def gather(x, index, axis=0, name=None):
+    """operators/gather_op parity: select rows of `axis` by 1-D index."""
+    axis = int(_unwrap(axis)) if not isinstance(axis, int) else axis
+    return apply1(lambda a, idx: jnp.take(a, idx.astype(jnp.int32), axis=axis),
+                  x, index, nondiff=(1,), name="gather")
+
+
+@_export
+def gather_nd(x, index, name=None):
+    def _gather_nd(a, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[flat_idx] if k == a.ndim else a[flat_idx]
+    return apply1(_gather_nd, x, index, nondiff=(1,), name="gather_nd")
+
+
+@_export
+def take_along_axis(arr, indices, axis, name=None):
+    return apply1(lambda a, idx: jnp.take_along_axis(a, idx, axis=axis),
+                  arr, indices, nondiff=(1,), name="take_along_axis")
+
+
+@_export
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def _put(a, idx, v):
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        dims = [jnp.arange(s).reshape([-1 if i == d else 1
+                                       for i in range(idx.ndim)])
+                for d, s in enumerate(idx.shape)]
+        full_idx = tuple(idx if d == axis else jnp.broadcast_to(dims[d], idx.shape)
+                         for d in range(idx.ndim))
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce == "add":
+            return a.at[full_idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[full_idx].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply1(_put, arr, indices, values, nondiff=(1,), name="put_along_axis")
+
+
+@_export
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _scatter(a, idx, upd):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return a.at[idx].set(upd)
+        base = a.at[idx].set(jnp.zeros_like(upd))
+        return base.at[idx].add(upd)
+    return apply1(_scatter, x, index, updates, nondiff=(1,), name="scatter")
+
+
+@_export
+def scatter_nd_add(x, index, updates, name=None):
+    def _snd(a, idx, upd):
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[flat_idx].add(upd)
+    return apply1(_snd, x, index, updates, nondiff=(1,), name="scatter_nd_add")
+
+
+@_export
+def scatter_nd(index, updates, shape, name=None):
+    from paddle_tpu.tensor.creation import zeros
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+@_export
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis)
+
+
+@_export
+def index_sample(x, index, name=None):
+    return apply1(lambda a, idx: jnp.take_along_axis(a, idx.astype(jnp.int32),
+                                                     axis=1),
+                  x, index, nondiff=(1,), name="index_sample")
+
+
+@_export
+def index_add(x, index, axis, value, name=None):
+    def _ia(a, idx, v):
+        idx = idx.astype(jnp.int32)
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = am.at[idx].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+    return apply1(_ia, x, index, value, nondiff=(1,), name="index_add")
+
+
+@_export
+def slice(input, axes, starts, ends, name=None):
+    axes = _int_list(axes)
+    starts = _int_list(starts)
+    ends = _int_list(ends)
+
+    def _slice(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            s2 = builtins.max(s + dim, 0) if s < 0 else builtins.min(s, dim)
+            e2 = builtins.max(e + dim, 0) if e < 0 else builtins.min(e, dim)
+            idx[ax] = builtins.slice(s2, e2)
+        return a[tuple(idx)]
+    return apply1(_slice, input, name="slice")
+
+
+@_export
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts = _int_list(axes), _int_list(starts)
+    ends, strides = _int_list(ends), _int_list(strides)
+
+    def _ss(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+    return apply1(_ss, x, name="strided_slice")
+
+
+@_export
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _int_list(shape)
+    offsets = _int_list(offsets) if offsets is not None else [0] * len(shape)
+
+    def _crop(a):
+        idx = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+                    for i, (o, s) in enumerate(zip(offsets, shape)))
+        return a[idx]
+    return apply1(_crop, x, name="crop")
+
+
+@_export
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from paddle_tpu.nn.functional.common import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+@_export
+def cast(x, dtype):
+    dt = convert_dtype(dtype)
+
+    def _cast(a):
+        return a.astype(dt)
+    return apply1(_cast, x, name="cast")
+
+
+@_export
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # data-dependent shape → host computation (documented jit-incompatible,
+    # same as reference's unique op being CPU-bound for sync mode)
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+@_export
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        work_axis = 0
+    else:
+        work_axis = axis % arr.ndim
+        arr = np.moveaxis(arr, work_axis, 0)
+    n = arr.shape[0]
+    keep = np.ones(n, dtype=bool)
+    if n > 1:
+        flat = arr.reshape(n, -1)
+        keep[1:] = ~np.all(flat[1:] == flat[:-1], axis=1)
+    result = arr[keep]
+    if axis is not None:
+        result = np.moveaxis(result, 0, work_axis)
+    out = [Tensor(result)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, n))
+        out.append(Tensor(counts.astype(np.int64)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+@_export
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = _unwrap(repeats)
+    return apply1(lambda a: jnp.repeat(a, r, axis=axis), x,
+                  name="repeat_interleave")
+
+
+@_export
+def as_complex(x, name=None):
+    return apply1(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                  name="as_complex")
+
+
+@_export
+def as_real(x, name=None):
+    return apply1(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x,
+                  name="as_real")
+
+
+@_export
+def real(x, name=None):
+    return apply1(jnp.real, x, name="real")
+
+
+@_export
+def imag(x, name=None):
+    return apply1(jnp.imag, x, name="imag")
+
+
+@_export
+def tensordot(x, y, axes=2, name=None):
+    return apply1(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
+                  name="tensordot")
+
+
+@_export
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+@_export
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, k=offset, m=col)
+    return Tensor(np.stack([r, c]).astype(np.int64))
+
+
+@_export
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, k=offset, m=col)
+    return Tensor(np.stack([r, c]).astype(np.int64))
+
+
+@_export
+def one_hot(x, num_classes, name=None):
+    return apply1(lambda a: jax.nn.one_hot(a, num_classes), x, nondiff=(0,),
+                  name="one_hot")
+
+
+@_export
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """operators/shard_index_op parity (used by parallel embedding)."""
+    def _shard(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        in_shard = (a // shard_size) == shard_id
+        return jnp.where(in_shard, a % shard_size, ignore_value)
+    return apply1(_shard, input, name="shard_index")

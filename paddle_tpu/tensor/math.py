@@ -1,0 +1,374 @@
+"""Elementwise + reduction math ops.
+
+Parity targets: python/paddle/tensor/math.py and the reference C++ op groups
+operators/elementwise/, operators/reduce_ops/, activation_op.* — all of which
+collapse to jnp/lax calls that XLA fuses on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply1, apply, convert_dtype
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply1(jfn, x, name=name)
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+def _binary(jfn, name):
+    def op(x, y, name=None):
+        return apply1(jfn, x, y, name=name)
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+# --- unary ------------------------------------------------------------------
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+abs = _unary(jnp.abs, "abs")
+sign = _unary(jnp.sign, "sign")
+ceil = _unary(jnp.ceil, "ceil")
+floor = _unary(jnp.floor, "floor")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda x: x - jnp.trunc(x), "frac")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.lax.erf, "erf")
+erfinv = _unary(jax.lax.erf_inv, "erfinv")
+reciprocal = _unary(lambda x: 1.0 / x, "reciprocal")
+neg = _unary(jnp.negative, "neg")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+conj = _unary(jnp.conj, "conj")
+angle = _unary(jnp.angle, "angle")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+
+# --- binary -----------------------------------------------------------------
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+remainder = _binary(jnp.remainder, "remainder")
+mod = remainder
+__all__.append("mod")
+floor_mod = remainder
+__all__.append("floor_mod")
+pow_op = None
+
+
+@_export
+def pow(x, y, name=None):
+    return apply1(jnp.power, x, y, name="pow")
+
+
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(lambda a, b: jnp.sqrt(a * a + b * b), "hypot")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+
+
+@_export
+def divide_no_nan(x, y, name=None):
+    return apply1(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)),
+                  x, y, name="divide_no_nan")
+
+
+@_export
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """operators/scale_op parity."""
+    s = _unwrap(scale)
+
+    def _scale(a, sv):
+        out = a * sv + bias if bias_after_scale else (a + bias) * sv
+        return out.astype(a.dtype) if not jnp.issubdtype(a.dtype, jnp.floating) else out
+    out = apply1(_scale, x, s, name="scale")
+    if act is not None:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+@_export
+def clip(x, min=None, max=None, name=None):
+    lo = _unwrap(min) if min is not None else None
+    hi = _unwrap(max) if max is not None else None
+    return apply1(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+@_export
+def lerp(x, y, weight, name=None):
+    return apply1(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+
+
+@_export
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply1(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+                  name="addmm")
+
+
+@_export
+def multiplex(inputs, index, name=None):
+    def _mux(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+    return apply1(lambda idx, *ins: _mux(idx, *ins), index, *inputs,
+                  nondiff=(0,), name="multiplex")
+
+
+@_export
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply1(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+@_export
+def kron(x, y, name=None):
+    return apply1(jnp.kron, x, y, name="kron")
+
+
+@_export
+def inner(x, y, name=None):
+    return apply1(jnp.inner, x, y, name="inner")
+
+
+@_export
+def outer(x, y, name=None):
+    return apply1(jnp.outer, x, y, name="outer")
+
+
+@_export
+def cross(x, y, axis=None, name=None):
+    ax = axis if axis is not None else -1
+    return apply1(lambda a, b: jnp.cross(a, b, axis=ax), x, y, name="cross")
+
+
+@_export
+def dot(x, y, name=None):
+    def _dot(a, b):
+        if a.ndim == 1:
+            return jnp.sum(a * b)
+        return jnp.sum(a * b, axis=-1)
+    return apply1(_dot, x, y, name="dot")
+
+
+# --- reductions -------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(jfn, name):
+    def op(x, axis=None, keepdim=False, name=None):
+        ax = _norm_axis(axis)
+        return apply1(lambda a: jfn(a, axis=ax, keepdims=keepdim), x, name=name)
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+sum = _reduce(jnp.sum, "sum")
+prod = _reduce(jnp.prod, "prod")
+max = _reduce(jnp.max, "max")
+min = _reduce(jnp.min, "min")
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+mean = _reduce(jnp.mean, "mean")
+nanmean = _reduce(jnp.nanmean, "nanmean")
+nansum = _reduce(jnp.nansum, "nansum")
+logsumexp_raw = None
+
+
+@_export
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jax.scipy.special.logsumexp(a, axis=ax,
+                                                        keepdims=keepdim),
+                  x, name="logsumexp")
+
+
+@_export
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x, name="all")
+
+
+@_export
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x, name="any")
+
+
+@_export
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                  x, name="count_nonzero")
+
+
+@_export
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+
+    def _cumsum(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=dt)
+        return jnp.cumsum(a, axis=int(axis), dtype=dt)
+    return apply1(_cumsum, x, name="cumsum")
+
+
+@_export
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    return apply1(lambda a: jnp.cumprod(a, axis=dim, dtype=dt), x,
+                  name="cumprod")
+
+
+@_export
+def cummax(x, axis=None, dtype="int64", name=None):
+    """Returns (values, indices) like the reference cummax op."""
+    def _cm(a):
+        ax = axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        vals = jax.lax.cummax(a, axis=ax)
+        iota = jax.lax.broadcasted_iota(jnp.int64, a.shape, ax)
+        is_new_max = a >= vals
+        idx_candidates = jnp.where(is_new_max, iota, 0)
+        idx = jax.lax.cummax(idx_candidates, axis=ax)
+        return vals, idx
+    from paddle_tpu.core import apply
+    vals, idx = apply(_cm, x, name="cummax")
+    idx.stop_gradient = True
+    return vals, idx
+
+
+@_export
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _cm(a):
+        ax = axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        vals = jax.lax.cummin(a, axis=ax)
+        iota = jax.lax.broadcasted_iota(jnp.int64, a.shape, ax)
+        idx_candidates = jnp.where(a <= vals, iota, 0)
+        idx = jax.lax.cummax(idx_candidates, axis=ax)
+        return vals, idx
+    from paddle_tpu.core import apply
+    vals, idx = apply(_cm, x, name="cummin")
+    idx.stop_gradient = True
+    return vals, idx
+
+
+@_export
+def isfinite(x, name=None):
+    return apply1(jnp.isfinite, x, name="isfinite")
+
+
+@_export
+def isinf(x, name=None):
+    return apply1(jnp.isinf, x, name="isinf")
+
+
+@_export
+def isnan(x, name=None):
+    return apply1(jnp.isnan, x, name="isnan")
+
+
+@_export
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply1(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan), x, y,
+                  name="isclose")
+
+
+@_export
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply1(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan), x, y,
+                  name="allclose")
+
+
+@_export
+def equal_all(x, y, name=None):
+    return apply1(lambda a, b: jnp.array_equal(a, b), x, y, name="equal_all")
+
+
+@_export
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    p = _unwrap(prepend) if prepend is not None else None
+    ap = _unwrap(append) if append is not None else None
+    return apply1(lambda a: jnp.diff(a, n=n, axis=axis, prepend=p, append=ap),
+                  x, name="diff")
+
+
+@_export
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply1(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                      axis2=axis2), x, name="trace")
+
+
+@_export
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+@_export
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """operators/metrics/accuracy_op parity."""
+    def _acc(pred, lab):
+        topk_idx = jax.lax.top_k(pred, k)[1]
+        lab2 = lab.reshape(-1, 1)
+        correct_ = jnp.any(topk_idx == lab2, axis=1)
+        return jnp.mean(correct_.astype(jnp.float32))
+    return apply1(_acc, input, label, nondiff=(0, 1), name="accuracy")

@@ -1,0 +1,150 @@
+"""Search/sort ops (parity: python/paddle/tensor/search.py).
+
+argsort/top_k lower to XLA sort/top-k; data-dependent-shape ops
+(nonzero, masked_select) execute on host and are documented jit-incompatible
+(the reference similarly syncs for these).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply, apply1
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "searchsorted", "topk", "where",
+    "index_select", "nonzero", "masked_select", "kthvalue", "mode",
+    "index_sample",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _argmax(a):
+        out = jnp.argmax(a, axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.int64)
+    return apply1(_argmax, x, nondiff=(0,), name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _argmin(a):
+        out = jnp.argmin(a, axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.int64)
+    return apply1(_argmin, x, nondiff=(0,), name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def _argsort(a):
+        idx = jnp.argsort(a, axis=axis)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+    return apply1(_argsort, x, nondiff=(0,), name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def _sort(a):
+        out = jnp.sort(a, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+    return apply1(_sort, x, name="sort")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+
+    def _ss(seq, v):
+        out = jnp.searchsorted(seq, v, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply1(_ss, sorted_sequence, values, nondiff=(0, 1),
+                  name="searchsorted")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else axis
+
+    def _topk(a):
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(am, k)
+        else:
+            v, i = jax.lax.top_k(-am, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax).astype(jnp.int64)
+    vals, idx = apply(_topk, x, name="topk")
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply1(lambda c, a, b: jnp.where(c, a, b), condition, x, y,
+                  nondiff=(0,), name="where")
+
+
+def index_select(x, index, axis=0, name=None):
+    from paddle_tpu.tensor.manipulation import gather
+    return gather(x, index, axis=axis)
+
+
+def index_sample(x, index, name=None):
+    from paddle_tpu.tensor.manipulation import index_sample as _is
+    return _is(x, index)
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64)) for i in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def masked_select(x, mask, name=None):
+    arr = np.asarray(x._data)
+    m = np.asarray(mask._data)
+    return Tensor(arr[np.broadcast_to(m, arr.shape)])
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(a):
+        s = jnp.sort(a, axis=axis)
+        si = jnp.argsort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        i = jnp.take(si, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i.astype(jnp.int64)
+    v, i = apply(_kth, x, name="kthvalue")
+    i.stop_gradient = True
+    return v, i
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x._data)
+    arr_m = np.moveaxis(arr, axis, -1)
+    flat = arr_m.reshape(-1, arr_m.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for j, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        v = uniq[np.argmax(counts)]
+        vals[j] = v
+        idxs[j] = np.nonzero(row == v)[0][-1]
+    out_shape = arr_m.shape[:-1]
+    v = vals.reshape(out_shape)
+    i = idxs.reshape(out_shape)
+    if keepdim:
+        v = np.expand_dims(v, axis)
+        i = np.expand_dims(i, axis)
+    return Tensor(v), Tensor(i)

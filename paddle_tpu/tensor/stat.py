@@ -1,0 +1,65 @@
+"""Statistics ops (parity: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor, apply1
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "numel"]
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x,
+                  name="mean")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), x, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x,
+                  name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x,
+                  name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax,
+                                         keepdims=keepdim), x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply1(lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=ax,
+                                            keepdims=keepdim), x,
+                  name="nanquantile")
+
+
+def numel(x, name=None):
+    import numpy as np
+    return Tensor(np.int64(x.size))

@@ -1,0 +1,17 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's test environment philosophy (SURVEY.md §4): the
+single-machine multi-process simulation (test_dist_base.py) becomes a
+multi-device CPU mesh — 8 virtual devices stand in for a v5e-8.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,85 @@
+"""OpTest harness — the reference's highest-leverage test pattern
+(python/paddle/fluid/tests/unittests/op_test.py:255): declare an op, numpy
+inputs, expected numpy outputs; check outputs and check analytic gradients
+against numeric finite differences (get_numeric_gradient, op_test.py:110).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core import Tensor
+
+
+def check_output(fn: Callable, inputs: Sequence[np.ndarray],
+                 expected, atol=1e-5, rtol=1e-5, kwargs=None):
+    tensors = [paddle.to_tensor(i) if isinstance(i, np.ndarray) else i
+               for i in inputs]
+    out = fn(*tensors, **(kwargs or {}))
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    exps = expected if isinstance(expected, (list, tuple)) else [expected]
+    for o, e in zip(outs, exps):
+        got = o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+        np.testing.assert_allclose(got, e, atol=atol, rtol=rtol)
+
+
+def numeric_grad(fn: Callable, inputs: List[np.ndarray], wrt: int,
+                 delta=5e-3, kwargs=None) -> np.ndarray:
+    """Central finite differences of sum(fn) w.r.t. inputs[wrt]."""
+    kwargs = kwargs or {}
+
+    def f(*arrs):
+        tensors = [paddle.to_tensor(a) for a in arrs]
+        out = fn(*tensors, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return sum(float(o.numpy().astype(np.float64).sum()) for o in outs
+                   if isinstance(o, Tensor)
+                   and np.issubdtype(np.asarray(o.numpy()).dtype,
+                                     np.floating))
+
+    base = [a.copy() for a in inputs]
+    x = base[wrt]
+    grad = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = f(*base)
+        flat[i] = orig - delta
+        fm = f(*base)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * delta)
+    return grad.astype(x.dtype)
+
+
+def check_grad(fn: Callable, inputs: List[np.ndarray],
+               wrt: Sequence[int] = (0,), atol=1e-3, rtol=1e-3, delta=5e-3,
+               kwargs=None):
+    """Analytic (tape) gradient vs numeric finite differences — the
+    check_grad_with_place analogue (op_test.py:1380)."""
+    kwargs = kwargs or {}
+    tensors = []
+    for i, a in enumerate(inputs):
+        t = paddle.to_tensor(a)
+        if i in wrt:
+            t.stop_gradient = False
+        tensors.append(t)
+    out = fn(*tensors, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    float_outs = [o for o in outs if isinstance(o, Tensor)
+                  and np.issubdtype(np.asarray(o.numpy()).dtype,
+                                    np.floating)]
+    total = float_outs[0].sum()
+    for o in float_outs[1:]:
+        total = total + o.sum()
+    total.backward()
+    for i in wrt:
+        analytic = tensors[i].grad.numpy().astype(np.float64)
+        numeric = numeric_grad(fn, [a.copy() for a in inputs], i,
+                               delta=delta, kwargs=kwargs)
+        np.testing.assert_allclose(
+            analytic, numeric, atol=atol, rtol=rtol,
+            err_msg=f"gradient mismatch for input {i}")

@@ -1,0 +1,121 @@
+"""Autograd tape tests (mirrors unittests/test_imperative_basic.py +
+the OpTest numeric-grad tier)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    y.backward()
+    np.testing.assert_allclose(float(x.grad), 12.0, rtol=1e-6)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    y = (a + b).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5, 5])
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad does not pollute .grad
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    h = x.register_hook(lambda g: seen.append(g.numpy()) or g * 2)
+    (x * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    h.remove()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.array([[4.0, 1.0], [2.0, 3.0]], "float32"),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, 1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+
+@pytest.mark.parametrize("fn,inputs", [
+    (lambda x: paddle.tanh(x), [np.random.randn(3, 4).astype("float64")]),
+    (lambda x: paddle.exp(x), [np.random.randn(3, 4).astype("float64")]),
+    (lambda x: paddle.nn.functional.softmax(x),
+     [np.random.randn(2, 5).astype("float64")]),
+    (lambda x, y: paddle.matmul(x, y),
+     [np.random.randn(3, 4).astype("float64"),
+      np.random.randn(4, 2).astype("float64")]),
+    (lambda x: paddle.nn.functional.gelu(x),
+     [np.random.randn(3, 3).astype("float64")]),
+    (lambda x: paddle.mean(x, axis=1),
+     [np.random.randn(3, 4).astype("float64")]),
+])
+def test_numeric_grad(fn, inputs):
+    wrt = tuple(range(len(inputs)))
+    check_grad(fn, inputs, wrt=wrt, atol=1e-4, rtol=1e-4, delta=1e-4)
+
+
+def test_second_order_unsupported():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    with pytest.raises(NotImplementedError):
+        paddle.grad(y, x, create_graph=True)

@@ -1,0 +1,238 @@
+"""Layer tests (mirrors unittests/test_layers.py patterns)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    lin = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = lin(x)
+    assert out.shape == [2, 3]
+    np.testing.assert_allclose(
+        out.numpy(), x.numpy() @ lin.weight.numpy() + lin.bias.numpy(),
+        atol=1e-5)
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    assert conv(x).shape == [2, 8, 8, 8]
+    convs = nn.Conv2D(3, 8, 3, padding="SAME")
+    assert convs(x).shape == [2, 8, 16, 16]
+
+
+def test_conv2d_vs_numpy():
+    # 1x1 conv == matmul over channels
+    conv = nn.Conv2D(3, 5, 1, bias_attr=False)
+    x = paddle.randn([1, 3, 4, 4])
+    out = conv(x).numpy()
+    w = conv.weight.numpy()[:, :, 0, 0]  # (5, 3)
+    expected = np.einsum("oc,nchw->nohw", w, x.numpy())
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_conv_grad_flows():
+    conv = nn.Conv2D(2, 4, 3, padding=1)
+    x = paddle.randn([1, 2, 8, 8])
+    loss = conv(x).sum()
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert conv.bias.grad is not None
+    assert conv.weight.grad.shape == conv.weight.shape
+
+
+def test_conv2d_transpose():
+    convt = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1,
+                               output_padding=1)
+    x = paddle.randn([1, 4, 8, 8])
+    assert convt(x).shape == [1, 2, 16, 16]
+
+
+def test_pooling():
+    x = paddle.randn([2, 3, 8, 8])
+    assert F.max_pool2d(x, 2, 2).shape == [2, 3, 4, 4]
+    assert F.avg_pool2d(x, 2, 2).shape == [2, 3, 4, 4]
+    assert F.adaptive_avg_pool2d(x, 1).shape == [2, 3, 1, 1]
+    v = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = F.max_pool2d(paddle.to_tensor(v), 2, 2)
+    np.testing.assert_array_equal(out.numpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_batch_norm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.to_tensor(np.random.randn(8, 4, 5, 5).astype("float32") * 3 + 1)
+    bn.train()
+    out = bn(x)
+    # normalized output: per-channel ~0 mean, ~1 std
+    o = out.numpy()
+    assert abs(o.mean()) < 1e-4
+    assert abs(o.std() - 1) < 1e-2
+    # running stats moved toward batch stats
+    assert abs(bn._mean.numpy().mean() - 0.1 * 1) < 0.5
+    bn.eval()
+    out_eval = bn(x)
+    assert out_eval.shape == out.shape
+
+
+def test_layer_norm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([4, 8])
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+
+def test_group_norm():
+    gn = nn.GroupNorm(2, 4)
+    x = paddle.randn([2, 4, 6, 6])
+    assert gn(x).shape == [2, 4, 6, 6]
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[1, 2, 0]], dtype="int64"))
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_array_equal(out.numpy()[0, 2], np.zeros(4))
+    loss = out.sum()
+    loss.backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout():
+    drop = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    drop.train()
+    out = drop(x)
+    frac_zero = float((out.numpy() == 0).mean())
+    assert 0.4 < frac_zero < 0.6
+    # upscale: surviving values are 2.0
+    nz = out.numpy()[out.numpy() != 0]
+    np.testing.assert_allclose(nz, 2.0)
+    drop.eval()
+    np.testing.assert_array_equal(drop(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 1.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 1])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                               1 / (1 + np.exp([1, 0, -1])), rtol=1e-5)
+    np.testing.assert_allclose(F.leaky_relu(x).numpy(), [-0.01, 0, 1],
+                               rtol=1e-5)
+    sm = F.softmax(paddle.to_tensor([[1.0, 2.0, 3.0]]))
+    np.testing.assert_allclose(sm.numpy().sum(), 1.0, rtol=1e-6)
+
+
+def test_losses():
+    logits = paddle.to_tensor(np.random.randn(4, 5).astype("float32"))
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3], dtype="int64"))
+    ce = nn.CrossEntropyLoss()
+    loss = ce(logits, labels)
+    # reference value
+    z = logits.numpy()
+    logp = z - np.log(np.exp(z - z.max(1, keepdims=True)).sum(1, keepdims=True)) - z.max(1, keepdims=True)
+    expected = -logp[np.arange(4), [0, 1, 2, 3]].mean()
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-4)
+
+    mse = nn.MSELoss()
+    a, b = paddle.randn([3, 3]), paddle.randn([3, 3])
+    np.testing.assert_allclose(float(mse(a, b)),
+                               ((a.numpy() - b.numpy()) ** 2).mean(),
+                               rtol=1e-5)
+
+
+def test_sequential_and_state_dict():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert model(x).shape == [3, 2]
+    sd = model.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model2.set_state_dict(sd)
+    np.testing.assert_array_equal(model2(x).numpy(), model(x).numpy())
+
+
+def test_layer_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h1 = lin.register_forward_pre_hook(lambda l, i: calls.append("pre"))
+    h2 = lin.register_forward_post_hook(lambda l, i, o: calls.append("post"))
+    lin(paddle.randn([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+
+
+def test_multi_head_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 6, 16])
+    out = mha(q, q, q)
+    assert out.shape == [2, 6, 16]
+    loss = out.sum()
+    loss.backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    assert enc(x).shape == [2, 5, 16]
+    # clones must not share parameters
+    p0 = enc.layers[0].linear1.weight.numpy()
+    p1 = enc.layers[1].linear1.weight.numpy()
+    assert not np.allclose(p0, p1)
+
+
+def test_lstm():
+    lstm = nn.LSTM(input_size=4, hidden_size=8, num_layers=2)
+    x = paddle.randn([3, 7, 4])  # batch, seq, feat
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 7, 8]
+    assert h.shape == [2, 3, 8]
+    assert c.shape == [2, 3, 8]
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_bilstm():
+    lstm = nn.LSTM(input_size=4, hidden_size=8, direction="bidirect")
+    x = paddle.randn([2, 5, 4])
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 16]
+    assert h.shape == [2, 2, 8]
+
+
+def test_gru_cell_vs_layer():
+    gru = nn.GRU(input_size=3, hidden_size=5)
+    x = paddle.randn([2, 4, 3])
+    out, h = gru(x)
+    assert out.shape == [2, 4, 5]
+    assert h.shape == [1, 2, 5]
+
+
+def test_weight_norm():
+    from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+    lin = nn.Linear(3, 4)
+    weight_norm(lin, "weight")
+    assert hasattr(lin, "weight_g") and hasattr(lin, "weight_v")
+    out = lin(paddle.randn([2, 3]))
+    assert out.shape == [2, 4]
+    remove_weight_norm(lin, "weight")
+    out2 = lin(paddle.randn([2, 3]))
+    assert out2.shape == [2, 4]
+
+
+def test_clip_grad_by_global_norm():
+    lin = nn.Linear(3, 3)
+    (lin(paddle.ones([4, 3])) * 100).sum().backward()
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    pg = clip([(p, p.grad) for p in lin.parameters()])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in pg))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)

@@ -1,0 +1,145 @@
+"""Tensor facade + creation/math/manipulation op tests
+(mirrors unittests/test_math_op_patch.py + creation op tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    assert t.stop_gradient
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_int_dtype():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == paddle.int64
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    assert paddle.full([2], 7.0).numpy().tolist() == [7, 7]
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.eye(3).numpy().trace() == 3
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_math_op_patch():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose((1.0 + a).numpy(), [2, 3])
+    np.testing.assert_allclose((1.0 / a).numpy(), [1, 0.5])
+    assert bool((a < b).all())
+    assert (a == a).numpy().all()
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+    np.testing.assert_array_equal(x[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_array_equal(x[1:, :2].numpy(), [[4, 5], [8, 9]])
+    x[0] = 0.0
+    assert x[0].numpy().sum() == 0
+
+
+def test_manipulation():
+    x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+    assert paddle.reshape(x, [3, 2]).shape == [3, 2]
+    assert paddle.transpose(x, [1, 0]).shape == [3, 2]
+    assert paddle.concat([x, x], axis=0).shape == [4, 3]
+    assert paddle.stack([x, x], axis=0).shape == [2, 2, 3]
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1]
+    assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [2, 3]
+    assert paddle.flatten(x).shape == [6]
+    assert paddle.tile(x, [2, 1]).shape == [4, 3]
+    assert paddle.expand(paddle.to_tensor([[1.0]]), [3, 4]).shape == [3, 4]
+    np.testing.assert_array_equal(
+        paddle.flip(x, 0).numpy(), np.flipud(np.arange(6).reshape(2, 3)))
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12).reshape(4, 3).astype("float32"))
+    idx = paddle.to_tensor([0, 2])
+    g = paddle.gather(x, idx, axis=0)
+    np.testing.assert_array_equal(g.numpy(), [[0, 1, 2], [6, 7, 8]])
+    upd = paddle.to_tensor(np.ones((2, 3), "float32"))
+    s = paddle.scatter(x, idx, upd)
+    assert s.numpy()[0].sum() == 3
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+    assert float(paddle.sum(x)) == 15
+    assert float(paddle.mean(x)) == 2.5
+    assert float(paddle.max(x)) == 5
+    assert float(paddle.min(x)) == 0
+    assert paddle.sum(x, axis=0).shape == [3]
+    assert paddle.sum(x, axis=1, keepdim=True).shape == [2, 1]
+    np.testing.assert_allclose(paddle.cumsum(x, axis=1).numpy(),
+                               np.cumsum(np.arange(6).reshape(2, 3), 1))
+
+
+def test_matmul():
+    a = np.random.randn(3, 4).astype("float32")
+    b = np.random.randn(4, 5).astype("float32")
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, atol=1e-5)
+    out_t = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T),
+                          transpose_y=True)
+    np.testing.assert_allclose(out_t.numpy(), a @ b, atol=1e-5)
+
+
+def test_search_sort():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]])
+    assert paddle.argmax(x, axis=1).numpy().tolist() == [0, 0]
+    vals, idx = paddle.topk(x, 2, axis=1)
+    np.testing.assert_array_equal(vals.numpy(), [[3, 2], [6, 5]])
+    s = paddle.sort(x, axis=1)
+    np.testing.assert_array_equal(s.numpy(), [[1, 2, 3], [4, 5, 6]])
+    w = paddle.where(x > 2.0, x, paddle.zeros_like(x))
+    np.testing.assert_array_equal(w.numpy(), [[3, 0, 0], [6, 5, 4]])
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == paddle.int32
+    assert paddle.cast(x, "float64").dtype == paddle.float64
+
+
+def test_einsum():
+    a = np.random.randn(2, 3).astype("float32")
+    b = np.random.randn(3, 4).astype("float32")
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, atol=1e-5)
+
+
+def test_seed_reproducibility():
+    paddle.seed(42)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(42)
+    b = paddle.randn([4, 4]).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_save_load(tmp_path):
+    obj = {"w": paddle.randn([3, 3]), "step": 7,
+           "nested": {"b": paddle.ones([2])}}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_array_equal(loaded["w"].numpy(), obj["w"].numpy())
+    assert loaded["step"] == 7
+    np.testing.assert_array_equal(loaded["nested"]["b"].numpy(), [1, 1])
