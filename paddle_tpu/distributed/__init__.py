@@ -1,0 +1,30 @@
+"""paddle_tpu.distributed — paddle-parity distributed API over the
+TPU-native machinery in paddle_tpu.parallel.
+
+Parity: python/paddle/distributed/ (collective.py, parallel.py, fleet/,
+launch, spawn).  See paddle_tpu/parallel/__init__.py for the design map.
+"""
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast,
+    destroy_process_group, get_group, get_rank, get_world_size,
+    is_initialized, new_group, p2p_shift, recv, reduce, reduce_scatter,
+    scatter, send, split, wait)
+from paddle_tpu.distributed.parallel import (  # noqa: F401
+    DataParallel, ParallelEnv, init_parallel_env)
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed.tp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    mark_sharding)
+from paddle_tpu.distributed.spawn import spawn  # noqa: F401
+
+# shard_tensor-style helper (modern paddle name for sharding annotation)
+shard_tensor = mark_sharding
+
+__all__ = [
+    "init_parallel_env", "ParallelEnv", "DataParallel", "spawn",
+    "get_rank", "get_world_size", "is_initialized", "new_group", "get_group",
+    "destroy_process_group", "Group", "ReduceOp", "all_reduce", "all_gather",
+    "broadcast", "reduce", "scatter", "reduce_scatter", "alltoall",
+    "barrier", "send", "recv", "wait", "split", "fleet", "shard_tensor",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+]

@@ -1,0 +1,225 @@
+"""Fleet — the distributed-training facade.
+
+Parity: ``paddle.distributed.fleet`` (reference: python/paddle/distributed/
+fleet/base/fleet_base.py — Fleet :63, init :130, distributed_optimizer :610,
+minimize :1090).  The meta-optimizer Program rewrites become knob resolution
+on a sharded, pjit-compiled train step (see strategy_compiler.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet.strategy_compiler import (
+    CompiledStrategy, compile_strategy, maybe_swap_optimizer)
+from paddle_tpu.distributed.fleet.role_maker import (
+    PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker)
+from paddle_tpu.distributed.fleet.topology import (
+    CommunicateTopology, HybridCommunicateGroup)
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.parallel.mesh import set_mesh
+
+__all__ = ["init", "is_first_worker", "worker_index", "worker_num",
+           "worker_endpoints", "server_num", "server_index",
+           "server_endpoints", "is_server", "is_worker", "barrier_worker",
+           "distributed_optimizer", "distributed_model", "train_step",
+           "get_hybrid_communicate_group", "DistributedStrategy",
+           "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "HybridCommunicateGroup", "stop_worker", "init_worker",
+           "init_server", "run_server", "save_inference_model",
+           "save_persistables"]
+
+
+class _FleetState:
+    def __init__(self):
+        self.role_maker: Optional[RoleMakerBase] = None
+        self.strategy: Optional[DistributedStrategy] = None
+        self.compiled: Optional[CompiledStrategy] = None
+        self.user_optimizer = None
+        self.hcg: Optional[HybridCommunicateGroup] = None
+        self.initialized = False
+
+
+_state = _FleetState()
+
+
+def init(role_maker: Optional[RoleMakerBase] = None,
+         is_collective: bool = False,
+         strategy: Optional[DistributedStrategy] = None):
+    """fleet.init parity (fleet_base.py:130)."""
+    from paddle_tpu.distributed.parallel import init_parallel_env
+    _state.role_maker = role_maker or PaddleCloudRoleMaker(
+        is_collective=is_collective)
+    _state.strategy = strategy or DistributedStrategy()
+    _state.compiled = compile_strategy(_state.strategy)
+    set_mesh(_state.compiled.mesh)
+    _state.hcg = HybridCommunicateGroup(mesh=_state.compiled.mesh)
+    init_parallel_env(mesh_axes={
+        a: s for a, s in _state.compiled.mesh.shape.items()})
+    _state.initialized = True
+
+
+def _require_init():
+    if not _state.initialized:
+        init()
+
+
+def is_first_worker() -> bool:
+    _require_init()
+    return _state.role_maker.is_first_worker()
+
+
+def worker_index() -> int:
+    _require_init()
+    return _state.role_maker.worker_index()
+
+
+def worker_num() -> int:
+    _require_init()
+    return _state.role_maker.worker_num()
+
+
+def worker_endpoints(to_string=False):
+    _require_init()
+    eps = _state.role_maker.get_trainer_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def server_num() -> int:
+    _require_init()
+    return _state.role_maker.server_num()
+
+
+def server_index() -> int:
+    _require_init()
+    return _state.role_maker.server_index()
+
+
+def server_endpoints(to_string=False):
+    _require_init()
+    eps = _state.role_maker.get_pserver_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def is_server() -> bool:
+    _require_init()
+    return _state.role_maker.is_server()
+
+
+def is_worker() -> bool:
+    _require_init()
+    return _state.role_maker.is_worker()
+
+
+def barrier_worker():
+    _require_init()
+    _state.role_maker.barrier_worker()
+
+
+# PS lifecycle stubs (collective mode needs none of these; the PS-capability
+# path lives in paddle_tpu.distributed.ps)
+def init_worker():
+    pass
+
+
+def init_server(*args, **kwargs):
+    pass
+
+
+def run_server():
+    pass
+
+
+def stop_worker():
+    pass
+
+
+class DistributedOptimizer:
+    """Wrapper returned by fleet.distributed_optimizer: delegates the
+    Optimizer API, carries the strategy (reference: fleet_base.py:610 stores
+    user_defined_optimizer + strategy; minimize applies the chain)."""
+
+    def __init__(self, optimizer, strategy: DistributedStrategy,
+                 compiled: CompiledStrategy):
+        self._inner = maybe_swap_optimizer(optimizer, compiled)
+        self.user_defined_strategy = strategy
+        self._compiled = compiled
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def step(self):
+        return self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program, parameters,
+                                    no_grad_set)
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy]
+                          = None) -> DistributedOptimizer:
+    _require_init()
+    if strategy is not None:
+        _state.strategy = strategy
+        _state.compiled = compile_strategy(strategy)
+        set_mesh(_state.compiled.mesh)
+        _state.hcg = HybridCommunicateGroup(mesh=_state.compiled.mesh)
+    opt = DistributedOptimizer(optimizer, _state.strategy, _state.compiled)
+    _state.user_optimizer = opt
+    return opt
+
+
+def distributed_model(model: Layer):
+    """fleet.distributed_model parity: wraps for data parallelism (dygraph
+    fleet path, fleet_base.py distributed_model)."""
+    _require_init()
+    from paddle_tpu.distributed.parallel import DataParallel
+    return DataParallel(model)
+
+
+def train_step(model: Layer, loss_fn: Callable, optimizer=None,
+               **overrides):
+    """TPU-native: build the compiled hybrid-parallel train step from the
+    active strategy — the runtime equivalent of minimize()'s meta-optimizer
+    chain (fleet_base.py:1090)."""
+    _require_init()
+    opt = optimizer or (_state.user_optimizer._inner
+                        if _state.user_optimizer else None)
+    if opt is None:
+        raise ValueError("pass an optimizer or call "
+                         "fleet.distributed_optimizer first")
+    if hasattr(opt, "_inner"):
+        opt = opt._inner
+    return _state.compiled.train_step(model, loss_fn, opt, **overrides)
+
+
+def applied_meta_list():
+    """Compile-only introspection tier (reference tests:
+    test_fleet_*_meta_optimizer.py assert which meta-optimizers fired)."""
+    _require_init()
+    return list(_state.compiled.applied_meta_list)
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    _require_init()
+    return _state.hcg
+
+
+def save_inference_model(executor=None, dirname=None, *args, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save for inference export")
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      **kwargs):
+    raise NotImplementedError("use paddle_tpu.save(model.state_dict(), ...)")

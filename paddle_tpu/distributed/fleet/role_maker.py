@@ -1,0 +1,118 @@
+"""Role makers (parity: python/paddle/distributed/fleet/base/role_maker.py).
+
+The reference's role maker parses the PADDLE_* env protocol and runs a gloo
+rendezvous (role_maker.py:172 spawns an HTTP store).  TPU-native: roles come
+from the same env vars (so launch scripts port unchanged) or from
+jax.process_index(); rendezvous is jax.distributed — no store to run.
+Parameter-server roles are kept for the PS-capability surface
+(paddle_tpu.distributed.ps).
+"""
+from __future__ import annotations
+
+import os
+from enum import IntEnum
+from typing import List, Optional
+
+import jax
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role(IntEnum):
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints: List[str] = []
+        self._server_endpoints: List[str] = []
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return max(1, len(self._worker_endpoints))
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self) -> List[str]:
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self) -> List[str]:
+        return self._server_endpoints
+
+    def _barrier(self, comm_world=None):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("fleet_barrier")
+
+    barrier_worker = _barrier
+    barrier_all = _barrier
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-protocol role maker (reference: role_maker.py
+    PaddleCloudRoleMaker._collective_env / _ps_env; env names at
+    launch_utils.py:473-476)."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        if is_collective:
+            self._current_id = int(os.getenv(
+                "PADDLE_TRAINER_ID", str(jax.process_index())))
+            eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = eps.split(",") if eps else []
+            self._role = Role.WORKER
+        else:
+            training_role = os.getenv("TRAINING_ROLE", "TRAINER")
+            if training_role == "PSERVER":
+                self._role = Role.SERVER
+                self._current_id = int(os.getenv("PADDLE_PSERVER_ID", "0"))
+            else:
+                self._role = Role.WORKER
+                self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = eps.split(",") if eps else []
+            seps = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = seps.split(",") if seps else []
+
+    def worker_num(self) -> int:
+        n = os.getenv("PADDLE_TRAINERS_NUM")
+        if n:
+            return int(n)
+        return super().worker_num()
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit roles (reference: role_maker.py UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective: bool = False, current_id: int = 0,
+                 role: Role = Role.WORKER,
+                 worker_endpoints: Optional[List[str]] = None,
+                 server_endpoints: Optional[List[str]] = None, **kwargs):
+        RoleMakerBase.__init__(self)
+        self._is_collective = is_collective
+        self._current_id = current_id
+        self._role = role
+        self._worker_endpoints = worker_endpoints or []
+        self._server_endpoints = server_endpoints or []

@@ -1,0 +1,73 @@
+"""``python -m paddle_tpu.distributed.launch`` — job launcher.
+
+Parity: python/paddle/distributed/fleet/launch.py:223 (launch_collective —
+one subprocess per device with the PADDLE_TRAINER_* env protocol,
+launch_utils.py:449 start_local_trainers, :473-476 env names).
+
+TPU-native: on one host, a single SPMD process drives all chips, so the
+launcher execs the script once with the env protocol filled in.  For
+multi-host slices, pass ``--ips`` (comma list, parity with the reference) —
+each host runs this launcher; rank/world come from the position of this
+host's IP, and jax.distributed uses the first entry as coordinator (the
+analogue of the reference's TCP comm-id exchange).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import socket
+import sys
+
+__all__ = ["main"]
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips (reference --ips)")
+    p.add_argument("--gpus", "--xpus", "--devices", type=str, default=None,
+                   help="accepted for parity; chips are auto-discovered")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="forced to 1: one SPMD controller per host")
+    p.add_argument("--backend", type=str, default="xla")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _my_rank(ips):
+    hostname_ips = set()
+    try:
+        hostname_ips.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    hostname_ips.add("127.0.0.1")
+    hostname_ips.add("localhost")
+    for i, ip in enumerate(ips):
+        if ip in hostname_ips:
+            return i
+    return int(os.getenv("PADDLE_TRAINER_ID", "0"))
+
+
+def main():
+    args = _parse()
+    ips = [s.strip() for s in args.ips.split(",") if s.strip()]
+    rank = _my_rank(ips)
+    port = int(os.getenv("FLAGS_START_PORT", "6070"))
+    endpoints = [f"{ip}:{port}" for ip in ips]
+    env = {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(len(ips)),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank] if rank < len(endpoints)
+        else endpoints[0],
+    }
+    os.environ.update(env)
+    sys.argv = [args.training_script] + args.training_script_args
+    runpy.run_path(args.training_script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

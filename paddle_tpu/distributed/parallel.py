@@ -1,0 +1,179 @@
+"""Process/parallel environment + DataParallel.
+
+Parity targets:
+- ``init_parallel_env`` / ``ParallelEnv`` (reference: python/paddle/
+  distributed/parallel.py:57 — env parse + NCCLParallelContext::Init TCP
+  exchange of ncclUniqueId, imperative/nccl_context.cc).  TPU-native: the
+  multi-host bootstrap is ``jax.distributed.initialize`` (coordinator =
+  first PADDLE_TRAINER_ENDPOINTS entry); single-host multi-chip needs no
+  bootstrap at all — one controller drives all chips.
+- ``DataParallel`` (reference: python/paddle/fluid/dygraph/parallel.py:323 +
+  the C++ bucketing Reducer, imperative/reducer.cc).  The Reducer's whole
+  job — bucketed fused allreduce overlapped with backward, unused-param
+  bookkeeping — is done by XLA once the train step is compiled with the
+  batch sharded over ``dp``; this wrapper keeps the API (and performs the
+  initial parameter broadcast the reference does in _sync_params_buffers).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.parallel.mesh import get_mesh, make_mesh, set_mesh
+
+__all__ = ["init_parallel_env", "ParallelEnv", "DataParallel",
+           "get_rank", "get_world_size"]
+
+_initialized = [False]
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv (parallel.py:57) — reads the
+    PADDLE_* env protocol (launch_utils.py:473-476)."""
+
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID",
+                                   str(jax.process_index())))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM",
+                                         str(jax.process_count())))
+        endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = endpoints.split(",") if endpoints else []
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        self._device_id = int(os.getenv("FLAGS_selected_tpus",
+                                        os.getenv("FLAGS_selected_gpus", "0")
+                                        ).split(",")[0] or 0)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    # legacy aliases
+    local_rank = rank
+    nranks = world_size
+    dev_id = device_id
+
+
+def init_parallel_env(mesh_axes: Optional[dict] = None):
+    """Initialize the parallel environment.
+
+    Multi-host (PADDLE_TRAINERS_NUM > 1): bootstraps jax.distributed with
+    the first endpoint as coordinator — the analogue of the reference's TCP
+    ncclUniqueId exchange (gen_comm_id_helper.cc:126).  Then installs the
+    global device mesh (default: 1-D ``dp`` over all chips, the implicit
+    world ring).
+    """
+    env = ParallelEnv()
+    if _initialized[0]:
+        return env
+    if env.world_size > 1 and jax.process_count() == 1:
+        coordinator = (env.trainer_endpoints[0]
+                       if env.trainer_endpoints else None)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=env.world_size,
+                process_id=env.rank)
+        except RuntimeError as e:
+            if "already" in str(e).lower():
+                warnings.warn(f"jax.distributed already initialized: {e}")
+            else:
+                # a real rendezvous failure must abort, not silently fall
+                # back to an independent single-host job
+                raise
+    set_mesh(make_mesh(mesh_axes or {"dp": len(jax.devices())}))
+    _initialized[0] = True
+    return env
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel parity (fluid/dygraph/parallel.py:323).
+
+    Wraps a Layer for data-parallel training.  Under ``ShardedTrainStep``
+    (or hapi/fleet which build one) the global batch is split over the
+    ``dp`` mesh axis and XLA fuses + overlaps the gradient reduction —
+    the role of Reducer's FusedAllReduceSchedule (reducer.cc:785).
+    ``comm_buffer_size``/``last_comm_buffer_size`` are accepted for API
+    parity; XLA's own fusion makes bucket sizing moot.
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._sync_params_buffers()
+
+    def _sync_params_buffers(self):
+        """Broadcast rank-0 parameters to all processes (reference:
+        parallel.py:519 sync_params_buffers)."""
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+        is_src = jax.process_index() == 0
+        for _, p in self._layers.named_parameters():
+            p._data = jnp.asarray(multihost_utils.broadcast_one_to_all(
+                p._data, is_source=is_src))
+        for _, b in self._layers.named_buffers():
+            if b is not None:
+                b._data = jnp.asarray(multihost_utils.broadcast_one_to_all(
+                    b._data, is_source=is_src))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Grad-sync pause (parity: parallel.py no_sync). Sync happens in
+        the compiled step, so eager accumulation is naturally unsynced."""
+        yield
+
+    def scale_loss(self, loss):
+        return loss  # XLA psum/pmean handles scaling in the step
+
+    def apply_collective_grads(self):
+        pass
+
+    # delegate the full Layer surface to the wrapped module
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)

@@ -1,0 +1,36 @@
+"""paddle.distributed.spawn parity (reference: python/paddle/distributed/
+spawn.py:317 — forks one python process per GPU).
+
+TPU-native: a single controller drives every chip, so per-device processes
+are an anti-pattern — ``spawn`` runs ``func`` once with rank 0 and the full
+mesh installed, matching the SPMD execution the reference's N processes
+added up to.  Multi-host jobs launch one process per host via
+``python -m paddle_tpu.distributed.launch`` (see launch.py).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+__all__ = ["spawn"]
+
+
+def spawn(func, args=(), nprocs: Optional[int] = -1, join: bool = True,
+          daemon: bool = False, **options):
+    from paddle_tpu.distributed.parallel import init_parallel_env
+    import jax
+    n = len(jax.devices()) if nprocs in (-1, None) else nprocs
+    if n > 1:
+        warnings.warn(
+            "spawn(): single-controller SPMD drives all %d chips from one "
+            "process; running func once (shard with dp in the train step)"
+            % n)
+    init_parallel_env()
+    result = func(*args)
+
+    class _Context:
+        def join(self):
+            return True
+    ctx = _Context()
+    ctx.result = result
+    return ctx

@@ -1,0 +1,103 @@
+"""Tensor-model-parallel layers (parity: the layers built by
+python/paddle/distributed/collective.py:735 _parallel_linear /
+:769 _parallel_embedding, and paddle.distributed.fleet.meta_parallel's
+ColumnParallelLinear/RowParallelLinear).
+
+TPU-native: the reference wires c_split/c_allreduce/c_embedding ops around
+per-rank weight shards; here each layer is an ordinary dense layer whose
+parameters carry an ``mp`` DistAttr, plus an activation sharding constraint.
+Under the pjit'd train step XLA partitions the matmul over the ``mp`` axis
+and inserts the all-reduce exactly where the reference put c_allreduce_sum
+(after row-parallel matmul / parallel-embedding lookup).  Eager single-chip
+use degenerates to the plain layer — same numerics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from paddle_tpu.core import Tensor, apply1
+from paddle_tpu.nn.layer.common import Linear, Embedding
+from paddle_tpu.parallel.mesh import DistAttr, get_mesh
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "mark_sharding"]
+
+
+def mark_sharding(x, *spec):
+    """with_sharding_constraint over the active mesh; tolerates absent axes
+    (paddle.distributed.shard_tensor analogue)."""
+    from paddle_tpu.parallel.mesh import shard_spec
+    import jax
+    s = shard_spec(*spec)
+
+    def f(arr):
+        try:
+            return jax.lax.with_sharding_constraint(
+                arr, jax.sharding.NamedSharding(get_mesh(), s))
+        except Exception:
+            return arr
+    if isinstance(x, Tensor):
+        return apply1(f, x, name="mark_sharding")
+    return f(x)
+
+
+class ColumnParallelLinear(Linear):
+    """Y = X·W with W split column-wise over ``mp``; output stays sharded
+    unless gather_output (the reference then inserts c_concat)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, gather_output: bool = True, name=None,
+                 mp_axis: str = "mp"):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         bias_attr=bias_attr, name=name)
+        self.gather_output = gather_output
+        self.mp_axis = mp_axis
+        self.weight.dist_attr = DistAttr((None, mp_axis))
+        if self.bias is not None:
+            self.bias.dist_attr = DistAttr((mp_axis,))
+
+    def forward(self, x):
+        y = super().forward(x)
+        if not self.gather_output:
+            y = mark_sharding(y, *([None] * (len(y.shape) - 1)),
+                              self.mp_axis)
+        return y
+
+
+class RowParallelLinear(Linear):
+    """Y = X·W with W split row-wise over ``mp``; X arrives split on its
+    last dim (the output of a non-gathered column-parallel layer); XLA
+    emits the psum the reference expressed as c_allreduce_sum."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, input_is_parallel: bool = False, name=None,
+                 mp_axis: str = "mp"):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         bias_attr=bias_attr, name=name)
+        self.input_is_parallel = input_is_parallel
+        self.mp_axis = mp_axis
+        self.weight.dist_attr = DistAttr((mp_axis, None))
+        # bias replicated (added after the reduce, reference
+        # _parallel_linear bias path)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = mark_sharding(x, *([None] * (len(x.shape) - 1)),
+                              self.mp_axis)
+        return super().forward(x)
+
+
+class VocabParallelEmbedding(Embedding):
+    """Embedding with the vocab dim split over ``mp`` (reference:
+    _parallel_embedding + c_embedding op): each shard owns a vocab range;
+    XLA partitions the gather and reduces partial lookups."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None,
+                 mp_axis: str = "mp"):
+        super().__init__(num_embeddings, embedding_dim,
+                         padding_idx=padding_idx, sparse=sparse,
+                         weight_attr=weight_attr, name=name)
+        self.mp_axis = mp_axis
+        self.weight.dist_attr = DistAttr((mp_axis, None))

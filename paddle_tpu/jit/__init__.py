@@ -248,7 +248,8 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  amp_level: Optional[str] = None, amp_dtype="bfloat16",
-                 accumulate_steps: int = 1, donate: bool = True):
+                 accumulate_steps: int = 1, donate: bool = True,
+                 recompute: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -257,6 +258,7 @@ class TrainStep:
             "bfloat16", "bf16") else jnp.float16
         self.accumulate_steps = accumulate_steps
         self.donate = donate
+        self.recompute = recompute
         self._cache: Dict[tuple, Callable] = {}
         self._opt_states: Optional[dict] = None
 
@@ -291,6 +293,13 @@ class TrainStep:
                                    if b is not None}
             loss_arr = loss._data if isinstance(loss, Tensor) else loss
             return loss_arr.astype(jnp.float32), new_buffers
+
+        if self.recompute:
+            # Recompute meta-optimizer parity (reference:
+            # python/paddle/fluid/backward.py:729 checkpointed backward;
+            # fleet/meta_optimizers/recompute_optimizer.py): drop forward
+            # activations, rebuild them during the grad sweep.
+            loss_from = jax.checkpoint(loss_from, static_argnums=())
 
         def step(params, opt_states, buffers, key, lr, *inputs):
             micro = self.accumulate_steps

@@ -1,0 +1,30 @@
+"""paddle_tpu.parallel — the TPU-native parallelism machinery.
+
+This package is the single first-class replacement for the reference's
+entire distributed stack (SURVEY.md §2.4): NCCL rings/comm contexts
+(paddle/fluid/platform/collective_helper.h:67), the SSA-graph allreduce
+op-handles (paddle/fluid/framework/details/all_reduce_op_handle.cc:68), the
+dygraph Reducer (paddle/fluid/imperative/reducer.cc), and the fleet
+meta-optimizer graph rewrites (python/paddle/distributed/fleet/
+meta_optimizers/).  All of it collapses into three TPU-idioms:
+
+- a named ``jax.sharding.Mesh`` over ICI/DCN (``mesh.py``) in place of
+  ring ids + process groups;
+- GSPMD sharding specs on parameters/activations consumed by one pjit'd
+  training step (``sharded.py``) in place of allreduce op insertion — XLA
+  emits the collectives;
+- explicit ``shard_map`` + ``lax.ppermute`` programs for the schedules XLA
+  cannot infer: pipeline micro-batching (``pipeline.py``, parity:
+  paddle/fluid/framework/section_worker.cc:115) and ring attention
+  (``ring_attention.py``, the long-context capability the reference lacks,
+  SURVEY.md §5.7).
+
+``paddle_tpu.distributed`` re-exports the paddle-parity API surface on top.
+"""
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    DistAttr, HybridTopology, auto_mesh, get_mesh, set_mesh, make_mesh,
+    mesh_axis_size, shard_spec,
+)
+from paddle_tpu.parallel.sharded import ShardedTrainStep, shard_module  # noqa: F401
+from paddle_tpu.parallel.pipeline import pipeline_forward  # noqa: F401
+from paddle_tpu.parallel.ring_attention import ring_attention  # noqa: F401

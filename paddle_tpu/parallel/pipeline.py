@@ -1,0 +1,122 @@
+"""Pipeline parallelism — microbatch schedule as a differentiable collective
+program.
+
+Parity target: the reference's PipelineOptimizer + SectionWorker (reference:
+python/paddle/fluid/optimizer.py:3718 program-splitting,
+paddle/fluid/framework/section_worker.cc:98 — schedule_mode 0 = F-then-B,
+1 = 1F1B; P2P via send_v2/recv_v2 ops).  On TPU there are no per-device
+program counters or streams to schedule, so the schedule is expressed as a
+single SPMD program: a ``lax.scan`` over clock ticks inside ``shard_map``
+over the ``pp`` mesh axis, with ``lax.ppermute`` as the send/recv pair.
+``jax.grad`` through the scan replays the ticks in reverse — the backward
+pipeline (F-then-B order, the reference's schedule_mode 0) falls out of
+autodiff instead of being hand-scheduled; activation memory is bounded with
+``jax.checkpoint`` inside the stage function.
+
+Layout contract:
+- ``stacked_params``: pytree whose leaves have leading dim = number of
+  layers L, sharded over ``pp`` (each stage holds L/P consecutive layers).
+- ``stage_fn(local_params, x) -> x`` consumes its (L/P, ...) slice, must be
+  shape-preserving (embedding/head live outside the pipeline trunk).
+- ``x``: (B, ...) activations; batch may additionally be sharded over data
+  axes — each data-parallel group runs its own pipeline.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import get_mesh
+
+__all__ = ["pipeline_forward"]
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
+def _pvary(x, axis_name):
+    """Mark a replicated value as device-varying along ``axis_name`` (newer
+    jax tracks varying-manual-axes through shard_map scans)."""
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        pass
+    try:
+        return lax.pvary(x, (axis_name,))
+    except (AttributeError, TypeError):
+        return x
+
+
+def pipeline_forward(stage_fn: Callable, stacked_params, x,
+                     n_microbatches: int, mesh: Optional[Mesh] = None,
+                     pp_axis: str = "pp", data_axes=("dp",)):
+    """Run ``x`` through a pipelined layer stack; returns activations with
+    the same global shape as ``x``."""
+    mesh = mesh or get_mesh()
+    n_stages = mesh.shape.get(pp_axis, 1)
+
+    if n_stages <= 1:
+        # no pipeline axis: the trunk is just the stage function on the
+        # whole stack (scan over layers inside stage_fn)
+        return stage_fn(stacked_params, x)
+
+    data_axes = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
+    batch_spec = P(data_axes if data_axes else None)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(pp_axis), stacked_params)
+
+    fn = partial(_pipeline_body, stage_fn, n_stages, n_microbatches, pp_axis)
+    mapped = _shard_map(fn, mesh, in_specs=(param_specs, batch_spec),
+                        out_specs=batch_spec)
+    return mapped(stacked_params, x)
+
+
+def _pipeline_body(stage_fn, n_stages, n_micro, axis_name, local_params, x):
+    stage = lax.axis_index(axis_name)
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(
+            f"local batch {batch} not divisible by {n_micro} microbatches")
+    mb = batch // n_micro
+    mbs = x.reshape((n_micro, mb) + x.shape[1:])
+
+    shift_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        mb_idx = t - stage
+        clipped = jnp.clip(mb_idx, 0, n_micro - 1)
+        first_stage_in = lax.dynamic_index_in_dim(mbs, clipped, 0,
+                                                  keepdims=False)
+        inp = jnp.where(stage == 0, first_stage_in, state)
+        y = stage_fn(local_params, inp)
+        valid_out = (stage == n_stages - 1) & (mb_idx >= 0) & (
+            mb_idx < n_micro)
+        prev = lax.dynamic_index_in_dim(outputs, clipped, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid_out, y, prev), clipped, 0)
+        state = lax.ppermute(y, axis_name, shift_perm)
+        return (state, outputs), None
+
+    state0 = _pvary(jnp.zeros((mb,) + x.shape[1:], x.dtype), axis_name)
+    out0 = _pvary(jnp.zeros_like(mbs), axis_name)
+    (_, outputs), _ = lax.scan(tick, (state0, out0),
+                               jnp.arange(n_micro + n_stages - 1))
+    # result lives on the last stage; broadcast (masked psum) so every stage
+    # returns the same shard — out_specs treats pp as replicated
+    outputs = lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs.reshape((batch,) + x.shape[1:])

@@ -1,0 +1,112 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Capability the reference lacks (SURVEY.md §5.7: no ring attention, no
+context parallel; its longest-context path is plain full attention in
+python/paddle/nn/layer/transformer.py:115).  Built TPU-first: the sequence
+dim is sharded over the ``sp`` mesh axis; each device keeps its Q shard and
+rotates K/V shards around the ring with ``lax.ppermute``, accumulating
+online-softmax statistics (running max / denominator / numerator), so the
+full S×S score matrix never materializes and sequence length scales with
+the ring size.  Differentiable by construction (scan + ppermute transpose).
+
+Layout: (B, S, H, D), S sharded over ``sp``; causal masking uses global
+positions reconstructed from the ring step.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import get_mesh
+
+__all__ = ["ring_attention", "ring_attention_local"]
+
+
+from paddle_tpu.parallel.pipeline import _pvary, _shard_map
+
+
+def ring_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+                   mesh: Optional[Mesh] = None, sp_axis: str = "sp",
+                   data_axes=("dp",)):
+    """Attention over sequence-sharded q/k/v of global shape (B,S,H,D)."""
+    mesh = mesh or get_mesh()
+    n = mesh.shape.get(sp_axis, 1)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if n <= 1:
+        return _local_attention(q, k, v, causal, scale, q_offset=0,
+                                k_offset=0, global_s=q.shape[1])
+
+    data_axes = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
+    spec = P(data_axes if data_axes else None, sp_axis)
+    fn = partial(_ring_body, n, sp_axis, causal, scale, q.shape[1])
+    mapped = _shard_map(fn, mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
+    return mapped(q, k, v)
+
+
+def _ring_body(n, axis_name, causal, scale, global_s, q, k, v):
+    my = lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32) * scale
+    m0 = _pvary(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), axis_name)
+    l0 = _pvary(jnp.zeros(q.shape[:3], jnp.float32), axis_name)
+    acc0 = _pvary(jnp.zeros(q.shape, jnp.float32), axis_name)
+
+    q_pos = my * s_local + jnp.arange(s_local)
+
+    def step(carry, t):
+        k_c, v_c, m, l, acc = carry
+        src = (my - t) % n                      # owner of current k/v chunk
+        k_pos = src * s_local + jnp.arange(s_local)
+        s = jnp.einsum("bqhd,bkhd->bqkh", q32, k_c.astype(jnp.float32))
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]            # (Sq,Sk)
+            s = jnp.where(mask[None, :, :, None], s, -jnp.inf)
+        chunk_max = jnp.max(s, axis=2)                         # (B,Sq,H)
+        new_m = jnp.maximum(m, chunk_max)
+        # guard fully-masked rows (new_m = -inf) against NaN
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        p = jnp.exp(s - safe_m[:, :, None, :])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        correction = jnp.where(jnp.isneginf(m), 0.0,
+                               jnp.exp(m - safe_m))
+        l_new = l * correction + jnp.sum(p, axis=2)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bqkh,bkhd->bqhd", p, v_c.astype(jnp.float32))
+        k_next = lax.ppermute(k_c, axis_name, ring)
+        v_next = lax.ppermute(v_c, axis_name, ring)
+        return (k_next, v_next, new_m, l_new, acc_new), None
+
+    (k_f, v_f, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def _local_attention(q, k, v, causal, scale, q_offset, k_offset, global_s):
+    s = jnp.einsum("bqhd,bkhd->bqkh",
+                   q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        k_pos = k_offset + jnp.arange(k.shape[1])
+        mask = k_pos[None, :] <= q_pos[:, None]
+        s = jnp.where(mask[None, :, :, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=2)
+    out = jnp.einsum("bqkh,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ring_attention_local(q, k, v, causal=True, scale=None):
+    """Single-device reference implementation (used by tests)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _local_attention(q, k, v, causal, scale, 0, 0, q.shape[1])
