@@ -1,0 +1,75 @@
+"""Headline benchmark: BERT-base pretrain tokens/sec/chip, bf16 AMP.
+
+BASELINE.md config #3 ("BERT-base / ERNIE-1.0 pretrain, Fleet DP").  The
+reference publishes no in-repo numbers (SURVEY.md §6); the north-star is
+"within 1.2× V100 step time".  A V100 (fp16, seq-128, fused kernels) runs
+BERT-base pretrain at ≈25k tokens/s, so vs_baseline = value / 25_000 —
+>1.0 means faster than the V100 figure, >0.83 meets the 1.2× bound.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+V100_TOKENS_PER_SEC = 25_000.0
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import Bert, BertConfig, bert_pretrain_loss
+    from paddle_tpu.parallel import make_mesh, set_mesh
+
+    on_accel = paddle.is_compiled_with_tpu()
+    set_mesh(make_mesh({"dp": 1}, devices=jax.devices()[:1]))
+
+    if on_accel:
+        B, S = 64, 128
+        cfg = BertConfig(max_seq_len=S, remat=False)
+    else:  # CI smoke path
+        B, S = 8, 64
+        cfg = BertConfig(hidden_size=128, num_layers=2, num_heads=4,
+                         vocab_size=8192, max_seq_len=S, remat=False)
+
+    model = Bert(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    step = TrainStep(model, bert_pretrain_loss, opt, amp_level="O2",
+                     amp_dtype="bfloat16")
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
+                                        size=(B, S)).astype(np.int32))
+    mlm = paddle.to_tensor(np.where(rng.random((B, S)) < 0.15,
+                                    ids.numpy(), -100).astype(np.int32))
+    nsp = paddle.to_tensor(rng.integers(0, 2, size=(B,)).astype(np.int32))
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = step(ids, mlm, nsp)
+    loss.block_until_ready()
+
+    iters = 20 if on_accel else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, mlm, nsp)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * iters / dt
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / V100_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -356,6 +356,10 @@ def p2p_shift(tensor, offset: int = 1, group: Optional[Group] = None,
     send/recv calls add up to across ranks; expressed directly it is a
     single ``lax.ppermute``."""
     arr = _unwrap(tensor)
+    if not _in_trace(arr):
+        raise NotImplementedError(
+            "p2p_shift is a collective over a mesh axis and only works "
+            "inside a parallel region (shard_map/pjit trace)")
     axes = _axes_of(group)
     axes = axes if isinstance(axes, (tuple, list)) else (axes,)
     n = get_mesh().shape.get(axes[0], 1)
@@ -364,8 +368,7 @@ def p2p_shift(tensor, offset: int = 1, group: Optional[Group] = None,
     else:
         perm = [(i, i + offset) for i in range(n)
                 if 0 <= i + offset < n]
-    out = lax.ppermute(arr, axes[0], perm)
-    return _rewrap(tensor, out) if not _in_trace(arr) else out
+    return lax.ppermute(arr, axes[0], perm)
 
 
 def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op=True):

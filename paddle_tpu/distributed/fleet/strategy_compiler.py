@@ -58,11 +58,17 @@ def _mesh_axes_from(strategy: DistributedStrategy, n_devices: int) -> dict:
             dp = sc["dp_degree"]
     fixed = mp * pp * sh
     if dp == -1:
+        if n_devices % fixed:
+            raise ValueError(
+                f"hybrid degrees mp={mp}×pp={pp}×sharding={sh} do not "
+                f"divide {n_devices} devices")
         dp = max(1, n_devices // fixed)
-    if fixed * dp != n_devices:
-        # clamp for small test meshes: drop sharding first, then dp
-        sh = max(1, n_devices // (mp * pp))
-        dp = max(1, n_devices // (mp * pp * sh))
+    elif fixed * dp > n_devices:
+        raise ValueError(
+            f"hybrid degrees dp={dp}×mp={mp}×pp={pp}×sharding={sh} "
+            f"exceed {n_devices} devices")
+    # fixed*dp < n_devices runs a sub-mesh (make_mesh slices devices),
+    # matching the reference's ability to train on a rank subset
     axes = {}
     for name, size in (("pp", pp), ("dp", dp), ("sharding", sh),
                        ("mp", mp)):

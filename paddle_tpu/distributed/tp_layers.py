@@ -30,13 +30,21 @@ def mark_sharding(x, *spec):
     from paddle_tpu.parallel.mesh import shard_spec
     import jax
     s = shard_spec(*spec)
+    sharding = jax.sharding.NamedSharding(get_mesh(), s)  # bad specs raise
 
     def f(arr):
+        if len(s) > arr.ndim:
+            raise ValueError(
+                f"sharding spec {tuple(s)} has rank {len(s)} > array rank "
+                f"{arr.ndim}")
         try:
-            return jax.lax.with_sharding_constraint(
-                arr, jax.sharding.NamedSharding(get_mesh(), s))
-        except Exception:
-            return arr
+            return jax.lax.with_sharding_constraint(arr, sharding)
+        except ValueError as e:
+            # inside a fully-manual shard_map region constraints are
+            # meaningless — skip; anything else is a real user error
+            if "manual" in str(e).lower():
+                return arr
+            raise
     if isinstance(x, Tensor):
         return apply1(f, x, name="mark_sharding")
     return f(x)

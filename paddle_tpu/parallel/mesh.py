@@ -65,9 +65,11 @@ def auto_mesh(dp: int = -1, mp: int = 1, pp: int = 1, sharding: int = 1,
             axes[name] = size
     if not axes:
         axes = {"dp": -1}
-    if -1 not in axes.values() and math.prod(axes.values()) != len(
-            devices if devices is not None else jax.devices()):
-        axes["dp"] = axes.get("dp", 1) * 1  # keep explicit sizes; validate in make_mesh
+    n_dev = len(devices if devices is not None else jax.devices())
+    if -1 not in axes.values() and math.prod(axes.values()) != n_dev:
+        raise ValueError(
+            f"hybrid degrees {axes} do not cover {n_dev} devices; pass "
+            f"dp=-1 to infer the data-parallel degree")
     return make_mesh(axes, devices)
 
 
@@ -91,11 +93,9 @@ def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
     return mesh.shape.get(axis, 1)
 
 
-def shard_spec(*axes) -> PartitionSpec:
-    """PartitionSpec constructor that tolerates axes absent from the current
-    mesh (they become replicated), so model code can annotate for the full
-    hybrid layout and still run on a 1-D mesh."""
-    mesh = get_mesh()
+def _clean_axes(axes, mesh: Mesh) -> PartitionSpec:
+    """Drop axes absent from ``mesh`` (they become replicated), so code can
+    annotate for the full hybrid layout and still run on a smaller mesh."""
     cleaned = []
     for a in axes:
         if a is None:
@@ -108,6 +108,11 @@ def shard_spec(*axes) -> PartitionSpec:
     while cleaned and cleaned[-1] is None:
         cleaned.pop()
     return PartitionSpec(*cleaned)
+
+
+def shard_spec(*axes) -> PartitionSpec:
+    """Mesh-tolerant PartitionSpec over the active mesh."""
+    return _clean_axes(axes, get_mesh())
 
 
 class DistAttr:
@@ -128,16 +133,7 @@ class DistAttr:
 
     def sharding(self, mesh: Optional[Mesh] = None) -> NamedSharding:
         mesh = mesh or get_mesh()
-        cleaned = []
-        for a in self.spec:
-            if a is None:
-                cleaned.append(None)
-            elif isinstance(a, (tuple, list)):
-                keep = tuple(x for x in a if x in mesh.shape)
-                cleaned.append(keep if keep else None)
-            else:
-                cleaned.append(a if a in mesh.shape else None)
-        return NamedSharding(mesh, PartitionSpec(*cleaned))
+        return NamedSharding(mesh, _clean_axes(tuple(self.spec), mesh))
 
     def __repr__(self):
         return f"DistAttr({tuple(self.spec)})"

@@ -36,24 +36,39 @@ from paddle_tpu.parallel.mesh import get_mesh
 __all__ = ["pipeline_forward"]
 
 
-def _shard_map(f, mesh, in_specs, out_specs):
+def _shard_map(f, mesh, in_specs, out_specs, manual_axes=None):
+    """shard_map with optional partial-manual mode: axes in ``manual_axes``
+    are mapped explicitly, the rest stay 'auto' so GSPMD keeps partitioning
+    them inside the body (tensor parallelism composes under the pipeline)."""
     if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if manual_axes is not None:
+            kwargs["axis_names"] = set(manual_axes)
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs)
+                             out_specs=out_specs, **kwargs)
     from jax.experimental.shard_map import shard_map
+    kwargs = {}
+    if manual_axes is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - set(manual_axes)
     return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                     check_rep=False)
+                     check_rep=False, **kwargs)
 
 
-def _pvary(x, axis_name):
-    """Mark a replicated value as device-varying along ``axis_name`` (newer
+def _pvary(x, axis_names):
+    """Mark a replicated value as device-varying along ``axis_names`` (newer
     jax tracks varying-manual-axes through shard_map scans)."""
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    already = getattr(getattr(x, "aval", None), "vma", ())
+    axis_names = tuple(a for a in axis_names if a not in already)
+    if not axis_names:
+        return x
     try:
-        return lax.pcast(x, (axis_name,), to="varying")
+        return lax.pcast(x, axis_names, to="varying")
     except (AttributeError, TypeError):
         pass
     try:
-        return lax.pvary(x, (axis_name,))
+        return lax.pvary(x, axis_names)
     except (AttributeError, TypeError):
         return x
 
@@ -62,7 +77,10 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x,
                      n_microbatches: int, mesh: Optional[Mesh] = None,
                      pp_axis: str = "pp", data_axes=("dp",)):
     """Run ``x`` through a pipelined layer stack; returns activations with
-    the same global shape as ``x``."""
+    the same global shape as ``x``.  Mesh axes other than pp/data stay
+    GSPMD-auto inside the region (tensor parallelism composes); sequence
+    parallelism inside the pipeline is not supported — use ring attention
+    at the top level (pp==1) instead."""
     mesh = mesh or get_mesh()
     n_stages = mesh.shape.get(pp_axis, 1)
 
@@ -77,13 +95,16 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x,
     param_specs = jax.tree_util.tree_map(
         lambda _: P(pp_axis), stacked_params)
 
-    fn = partial(_pipeline_body, stage_fn, n_stages, n_microbatches, pp_axis)
+    manual = {pp_axis} | set(data_axes)
+    fn = partial(_pipeline_body, stage_fn, n_stages, n_microbatches, pp_axis,
+                 tuple(sorted(manual)))
     mapped = _shard_map(fn, mesh, in_specs=(param_specs, batch_spec),
-                        out_specs=batch_spec)
+                        out_specs=batch_spec, manual_axes=manual)
     return mapped(stacked_params, x)
 
 
-def _pipeline_body(stage_fn, n_stages, n_micro, axis_name, local_params, x):
+def _pipeline_body(stage_fn, n_stages, n_micro, axis_name, manual_axes,
+                   local_params, x):
     stage = lax.axis_index(axis_name)
     batch = x.shape[0]
     if batch % n_micro:
@@ -110,8 +131,8 @@ def _pipeline_body(stage_fn, n_stages, n_micro, axis_name, local_params, x):
         state = lax.ppermute(y, axis_name, shift_perm)
         return (state, outputs), None
 
-    state0 = _pvary(jnp.zeros((mb,) + x.shape[1:], x.dtype), axis_name)
-    out0 = _pvary(jnp.zeros_like(mbs), axis_name)
+    state0 = _pvary(jnp.zeros((mb,) + x.shape[1:], x.dtype), manual_axes)
+    out0 = _pvary(jnp.zeros_like(mbs), manual_axes)
     (_, outputs), _ = lax.scan(tick, (state0, out0),
                                jnp.arange(n_micro + n_stages - 1))
     # result lives on the last stage; broadcast (masked psum) so every stage

@@ -45,21 +45,23 @@ def ring_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
 
     data_axes = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
     spec = P(data_axes if data_axes else None, sp_axis)
-    fn = partial(_ring_body, n, sp_axis, causal, scale, q.shape[1])
+    manual = {sp_axis} | set(data_axes)
+    fn = partial(_ring_body, n, sp_axis, tuple(sorted(manual)), causal,
+                 scale, q.shape[1])
     mapped = _shard_map(fn, mesh, in_specs=(spec, spec, spec),
-                        out_specs=spec)
+                        out_specs=spec, manual_axes=manual)
     return mapped(q, k, v)
 
 
-def _ring_body(n, axis_name, causal, scale, global_s, q, k, v):
+def _ring_body(n, axis_name, manual_axes, causal, scale, global_s, q, k, v):
     my = lax.axis_index(axis_name)
     s_local = q.shape[1]
     ring = [(i, (i + 1) % n) for i in range(n)]
 
     q32 = q.astype(jnp.float32) * scale
-    m0 = _pvary(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), axis_name)
-    l0 = _pvary(jnp.zeros(q.shape[:3], jnp.float32), axis_name)
-    acc0 = _pvary(jnp.zeros(q.shape, jnp.float32), axis_name)
+    m0 = _pvary(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), manual_axes)
+    l0 = _pvary(jnp.zeros(q.shape[:3], jnp.float32), manual_axes)
+    acc0 = _pvary(jnp.zeros(q.shape, jnp.float32), manual_axes)
 
     q_pos = my * s_local + jnp.arange(s_local)
 
