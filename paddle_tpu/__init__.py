@@ -57,6 +57,9 @@ from paddle_tpu import jit  # noqa: E402,F401
 from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import parallel  # noqa: E402,F401
 from paddle_tpu import distributed  # noqa: E402,F401
+from paddle_tpu import vision  # noqa: E402,F401
+from paddle_tpu import text  # noqa: E402,F401
+from paddle_tpu import models  # noqa: E402,F401
 from paddle_tpu.distributed.parallel import DataParallel  # noqa: E402,F401
 from paddle_tpu.framework.io import save, load  # noqa: E402,F401
 from paddle_tpu.hapi.model import Model  # noqa: E402,F401

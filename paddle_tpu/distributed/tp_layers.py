@@ -27,24 +27,10 @@ __all__ = ["ColumnParallelLinear", "RowParallelLinear",
 def mark_sharding(x, *spec):
     """with_sharding_constraint over the active mesh; tolerates absent axes
     (paddle.distributed.shard_tensor analogue)."""
-    from paddle_tpu.parallel.mesh import shard_spec
-    import jax
-    s = shard_spec(*spec)
-    sharding = jax.sharding.NamedSharding(get_mesh(), s)  # bad specs raise
+    from paddle_tpu.parallel.mesh import constrain
 
     def f(arr):
-        if len(s) > arr.ndim:
-            raise ValueError(
-                f"sharding spec {tuple(s)} has rank {len(s)} > array rank "
-                f"{arr.ndim}")
-        try:
-            return jax.lax.with_sharding_constraint(arr, sharding)
-        except ValueError as e:
-            # inside a fully-manual shard_map region constraints are
-            # meaningless — skip; anything else is a real user error
-            if "manual" in str(e).lower():
-                return arr
-            raise
+        return constrain(arr, *spec)
     if isinstance(x, Tensor):
         return apply1(f, x, name="mark_sharding")
     return f(x)

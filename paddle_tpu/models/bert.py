@@ -139,13 +139,8 @@ def _ln(x, w, b, eps=1e-12):
 
 
 def _mark(x, *spec):
-    try:
-        from paddle_tpu.parallel.mesh import shard_spec
-        from jax.sharding import NamedSharding
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(get_mesh(), shard_spec(*spec)))
-    except Exception:
-        return x
+    from paddle_tpu.parallel.mesh import constrain
+    return constrain(x, *spec, strip=("sp",))
 
 
 def _bert_forward(cfg, has_tt, has_mask, wte, wpe, wtt, emb_ln_w, emb_ln_b,

@@ -147,14 +147,8 @@ def _mark(x, *spec):
     # boundary, and a with_sharding_constraint over sp in the backward pass
     # trips an XLA SPMD-partitioner check-failure (spmd_partitioner_util.h
     # IsScalarWithElementType) on CPU as of jax 0.9.
-    spec = tuple(None if s == "sp" else s for s in spec)
-    try:
-        from paddle_tpu.parallel.mesh import shard_spec
-        from jax.sharding import NamedSharding
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(get_mesh(), shard_spec(*spec)))
-    except Exception:
-        return x
+    from paddle_tpu.parallel.mesh import constrain
+    return constrain(x, *spec, strip=("sp",))
 
 
 def _attention(cfg: GPTConfig, q, k, v):

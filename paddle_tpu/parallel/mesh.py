@@ -65,11 +65,9 @@ def auto_mesh(dp: int = -1, mp: int = 1, pp: int = 1, sharding: int = 1,
             axes[name] = size
     if not axes:
         axes = {"dp": -1}
-    n_dev = len(devices if devices is not None else jax.devices())
-    if -1 not in axes.values() and math.prod(axes.values()) != n_dev:
-        raise ValueError(
-            f"hybrid degrees {axes} do not cover {n_dev} devices; pass "
-            f"dp=-1 to infer the data-parallel degree")
+    # explicit degrees smaller than the device count run a sub-mesh (same
+    # policy as fleet's strategy compiler); degrees exceeding it raise in
+    # make_mesh
     return make_mesh(axes, devices)
 
 
@@ -113,6 +111,29 @@ def _clean_axes(axes, mesh: Mesh) -> PartitionSpec:
 def shard_spec(*axes) -> PartitionSpec:
     """Mesh-tolerant PartitionSpec over the active mesh."""
     return _clean_axes(axes, get_mesh())
+
+
+def constrain(arr, *axes, strip=()):
+    """with_sharding_constraint on a raw array over the active mesh.
+
+    The single sharding-constraint helper used by models/tp layers. Axes
+    absent from the mesh (or listed in ``strip``) are replicated; inside a
+    fully-manual shard_map region the constraint is skipped (meaningless
+    there); any other failure is a real error and raises."""
+    import jax
+    axes = tuple(None if a in strip else a for a in axes)
+    spec = shard_spec(*axes)
+    if len(spec) > arr.ndim:
+        raise ValueError(
+            f"sharding spec {tuple(spec)} has rank {len(spec)} > array "
+            f"rank {arr.ndim}")
+    sharding = NamedSharding(get_mesh(), spec)
+    try:
+        return jax.lax.with_sharding_constraint(arr, sharding)
+    except ValueError as e:
+        if "manual" in str(e).lower():
+            return arr
+        raise
 
 
 class DistAttr:

@@ -1,0 +1,200 @@
+"""Text datasets (parity: python/paddle/text/datasets/*.py).
+
+Zero-egress: each dataset reads the reference's standard archive format
+from a local path (default: the reference's download-cache location
+~/.cache/paddle/dataset); ``FakeTextDataset`` supplies synthetic token
+streams for tests and benchmarks.
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st", "FakeTextDataset"]
+
+_CACHE = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def _need(path, name):
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"{name}: {path!r} not found and no network egress is "
+            f"available; place the archive there or use FakeTextDataset")
+    return path
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference: text/datasets/imdb.py; aclImdb_v1 tar)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        self.mode = mode
+        data_file = data_file or os.path.join(_CACHE, "imdb",
+                                              "aclImdb_v1.tar.gz")
+        _need(data_file, "Imdb")
+        # vocab is built over train+test (reference imdb.py _build_work_dict
+        # scans aclImdb/((train)|(test))/...), so both modes share ids
+        vocab_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        mode_pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq = {}
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                vm = vocab_pat.match(member.name)
+                if not vm:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").lower()
+                words = re.sub(r"[^a-z]+", " ", text).split()
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+                if mode_pat.match(member.name):
+                    docs.append(words)
+                    labels.append(0 if vm.group(2) == "pos" else 1)
+        kept = [w for w, c in sorted(freq.items(),
+                                     key=lambda kv: (-kv[1], kv[0]))
+                if c > cutoff]  # reference keeps freq > cutoff
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in d],
+                                np.int64) for d in docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram LM dataset (reference: text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 50):
+        data_file = data_file or os.path.join(
+            _CACHE, "imikolov", "simple-examples.tgz")
+        _need(data_file, "Imikolov")
+        member = {"train": "./simple-examples/data/ptb.train.txt",
+                  "test": "./simple-examples/data/ptb.valid.txt"}[mode]
+        freq = {}
+        with tarfile.open(data_file) as tf:
+            train = tf.extractfile(
+                "./simple-examples/data/ptb.train.txt").read().decode()
+            for w in train.split():
+                freq[w] = freq.get(w, 0) + 1
+            text = tf.extractfile(member).read().decode()
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c >= min_word_freq and w != "<unk>"]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        self.word_idx["<s>"] = len(self.word_idx)
+        self.word_idx["<e>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for line in text.split("\n"):
+            words = (["<s>"] + line.split() + ["<e>"])
+            ids = [self.word_idx.get(w, unk) for w in words]
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.data.append(np.asarray(ids[i:i + window_size],
+                                                np.int64))
+            else:
+                self.data.append(np.asarray(ids, np.int64))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference: text/datasets/uci_housing.py;
+    reads the standard housing.data whitespace table)."""
+
+    FEATURE_NUM = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
+        data_file = data_file or os.path.join(_CACHE, "uci_housing",
+                                              "housing.data")
+        _need(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats = raw[:, :-1]
+        mn, mx = feats.min(0), feats.max(0)
+        feats = (feats - feats.mean(0)) / np.maximum(mx - mn, 1e-6)
+        split = int(len(raw) * 0.8)
+        sl = slice(0, split) if mode == "train" else slice(split, None)
+        self.data = feats[sl]
+        self.targets = raw[sl, -1:].astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.targets[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    def __init__(self, data_file=None, mode="train", **kw):
+        data_file = data_file or os.path.join(_CACHE, "movielens",
+                                              "ml-1m.zip")
+        _need(data_file, "Movielens")
+        raise NotImplementedError("Movielens parsing: round-2 scope")
+
+
+class WMT14(Dataset):
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        data_file = data_file or os.path.join(
+            _CACHE, "wmt14", "wmt14.tgz")
+        _need(data_file, "WMT14")
+        raise NotImplementedError("WMT14 parsing: round-2 scope")
+
+
+class WMT16(WMT14):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        data_file = data_file or os.path.join(_CACHE, "wmt16", "wmt16.tar.gz")
+        _need(data_file, "WMT16")
+        raise NotImplementedError("WMT16 parsing: round-2 scope")
+
+
+class Conll05st(Dataset):
+    def __init__(self, data_file=None, **kw):
+        data_file = data_file or os.path.join(_CACHE, "conll05st",
+                                              "conll05st-tests.tar.gz")
+        _need(data_file, "Conll05st")
+        raise NotImplementedError("Conll05st parsing: round-2 scope")
+
+
+class FakeTextDataset(Dataset):
+    """Synthetic token-sequence dataset for LM tests/benchmarks."""
+
+    def __init__(self, num_samples=256, seq_len=128, vocab_size=1000,
+                 num_classes: Optional[int] = None, seed=0):
+        self.num_samples = num_samples
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.num_classes = num_classes
+        self._seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self._seed * 999_983 + idx)
+        ids = rng.integers(0, self.vocab_size,
+                           size=(self.seq_len,)).astype(np.int64)
+        if self.num_classes is not None:
+            return ids, np.int64(rng.integers(0, self.num_classes))
+        return ids
+
+    def __len__(self):
+        return self.num_samples

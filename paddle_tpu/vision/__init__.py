@@ -1,0 +1,18 @@
+"""paddle_tpu.vision — parity with python/paddle/vision/ (models lenet/
+resnet/vgg/mobilenetv1+2, datasets MNIST/CIFAR/..., transforms).
+"""
+from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import datasets  # noqa: F401
+from paddle_tpu.vision import transforms  # noqa: F401
+from paddle_tpu.vision import ops  # noqa: F401
+
+__all__ = ["models", "datasets", "transforms", "ops"]
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor", "numpy"):
+        raise ValueError(f"unsupported backend {backend}")
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,251 @@
+"""Vision datasets (parity: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100, Flowers, VOC2012 + python/paddle/dataset/
+download cache).
+
+Zero-egress environment: ``download=True`` cannot fetch; datasets read the
+standard file formats from ``image_path``/``data_file`` (or
+~/.cache/paddle/dataset like the reference's download cache), and
+``FakeData`` provides deterministic synthetic samples for tests/benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "FakeData", "DatasetFolder", "ImageFolder"]
+
+_CACHE = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def _no_download(name: str, path: str):
+    raise RuntimeError(
+        f"{name}: file {path!r} not found and this environment has no "
+        f"network egress; place the standard files there or use "
+        f"paddle_tpu.vision.datasets.FakeData")
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (reference: vision/datasets/mnist.py)."""
+
+    NAME = "mnist"
+    _IMAGE = {"train": "train-images-idx3-ubyte.gz",
+              "test": "t10k-images-idx3-ubyte.gz"}
+    _LABEL = {"train": "train-labels-idx1-ubyte.gz",
+              "test": "t10k-labels-idx1-ubyte.gz"}
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = True,
+                 backend: Optional[str] = None):
+        assert mode in ("train", "test")
+        base = os.path.join(_CACHE, self.NAME)
+        image_path = image_path or os.path.join(base, self._IMAGE[mode])
+        label_path = label_path or os.path.join(base, self._LABEL[mode])
+        if not os.path.exists(image_path):
+            _no_download(type(self).__name__, image_path)
+        if not os.path.exists(label_path):
+            _no_download(type(self).__name__, label_path)
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(
+            path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[:, :, None]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR python-pickle format (reference: vision/datasets/cifar.py)."""
+
+    _URL_FILE = "cifar-10-python.tar.gz"
+    _MEMBER_PREFIX = "cifar-10-batches-py"
+    _LABEL_KEY = b"labels"
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, download: bool = True,
+                 backend: Optional[str] = None):
+        assert mode in ("train", "test")
+        data_file = data_file or os.path.join(_CACHE, "cifar",
+                                              self._URL_FILE)
+        if not os.path.exists(data_file):
+            _no_download(type(self).__name__, data_file)
+        self.transform = transform
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                name = os.path.basename(member.name)
+                want = (name.startswith("data_batch") if mode == "train"
+                        else name == "test_batch")
+                if self._MEMBER_PREFIX == "cifar-100-python":
+                    want = name == ("train" if mode == "train" else "test")
+                if not want:
+                    continue
+                d = pickle.load(tf.extractfile(member), encoding="bytes")
+                images.append(d[b"data"])
+                labels.extend(d[self._LABEL_KEY])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC uint8
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _URL_FILE = "cifar-100-python.tar.gz"
+    _MEMBER_PREFIX = "cifar-100-python"
+    _LABEL_KEY = b"fine_labels"
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        data_file = data_file or os.path.join(_CACHE, "flowers", "102flowers.tgz")
+        if not os.path.exists(data_file):
+            _no_download("Flowers", data_file)
+        raise NotImplementedError(
+            "Flowers .tgz/.mat parsing needs scipy.io; convert locally or "
+            "use FakeData")
+
+
+class VOC2012(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        data_file = data_file or os.path.join(_CACHE, "voc2012",
+                                              "VOCtrainval_11-May-2012.tar")
+        if not os.path.exists(data_file):
+            _no_download("VOC2012", data_file)
+        raise NotImplementedError("VOC2012 parsing: round-2 scope")
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset (test/bench stand-in for the
+    download-cached datasets; the reference relies on real downloads)."""
+
+    def __init__(self, num_samples: int = 256, image_shape=(1, 28, 28),
+                 num_classes: int = 10, transform: Optional[Callable] = None,
+                 seed: int = 0, data_format="CHW"):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.data_format = data_format
+        self._seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self._seed * 1_000_003 + idx)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        label = np.int64(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image folder (reference:
+    vision/datasets/folder.py).  Loads .npy/.npz images natively; other
+    formats need a custom ``loader``."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or (".npy",)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                path = os.path.join(d, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        return np.load(path)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """Flat folder of images, no labels (reference: folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or (".npy",)
+        self.samples = []
+        for fname in sorted(os.listdir(root)):
+            path = os.path.join(root, fname)
+            ok = (is_valid_file(path) if is_valid_file
+                  else fname.lower().endswith(tuple(extensions)))
+            if ok and os.path.isfile(path):
+                self.samples.append(path)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,19 @@
+"""Vision model zoo (parity: python/paddle/vision/models/ — lenet.py,
+resnet.py, vgg.py, mobilenetv1.py, mobilenetv2.py).
+
+Same architectures and layer names so state_dicts line up; NCHW layout
+(paddle default).  ``pretrained=True`` is rejected — this environment has
+no network egress; load local weights via set_state_dict.
+"""
+from paddle_tpu.vision.models.lenet import LeNet  # noqa: F401
+from paddle_tpu.vision.models.resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152)
+from paddle_tpu.vision.models.vgg import (  # noqa: F401
+    VGG, vgg11, vgg13, vgg16, vgg19)
+from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2)
+
+__all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
+           "resnet101", "resnet152", "VGG", "vgg11", "vgg13", "vgg16",
+           "vgg19", "MobileNetV1", "MobileNetV2", "mobilenet_v1",
+           "mobilenet_v2"]

@@ -1,0 +1,250 @@
+"""Vision ops (parity subset of paddle/fluid/operators/detection/ — the
+reference has ~50 CV ops; these are the ones its model zoo + tests
+exercise most: box utils, NMS, RoI align/pool, yolo decode).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply1
+
+__all__ = ["nms", "box_iou", "roi_align", "roi_pool", "yolo_box",
+           "prior_box", "box_coder"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_iou(boxes1, boxes2):
+    """IoU matrix between (N,4) and (M,4) xyxy boxes."""
+    def f(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None] - inter,
+                                   1e-10)
+    return apply1(f, boxes1, boxes2, name="box_iou")
+
+
+def nms(boxes, scores=None, iou_threshold=0.3, top_k: int = -1):
+    """Greedy NMS (reference: operators/detection/nms_op /
+    multiclass_nms).  Host-side numpy (data-dependent output size cannot
+    live under jit; the reference's GPU kernel is also a serial loop)."""
+    b = np.asarray(_unwrap(boxes))
+    if scores is None:
+        s = np.arange(len(b))[::-1].astype(np.float32)
+    else:
+        s = np.asarray(_unwrap(scores))
+    order = np.argsort(-s)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if top_k > 0 and len(keep) >= top_k:
+            break
+        rest = order[1:]
+        if rest.size == 0:
+            break
+        lt = np.maximum(b[i, :2], b[rest, :2])
+        rb = np.minimum(b[i, 2:], b[rest, 2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        a_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        a_r = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+        iou = inter / np.maximum(a_i + a_r - inter, 1e-10)
+        order = rest[iou <= iou_threshold]
+    return Tensor(np.asarray(keep, np.int64))
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (reference: operators/roi_align_op). x: (N,C,H,W),
+    boxes: (R,4) xyxy in input scale, all sampled from image 0."""
+    if boxes_num is not None:
+        raise NotImplementedError(
+            "roi_align: per-image roi batching (boxes_num) not yet "
+            "supported — all rois sample image 0; pass boxes_num=None")
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(x, rois):
+        N, C, H, W = x.shape
+        R = rois.shape[0]
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        bh = (y2 - y1) / oh
+        bw = (x2 - x1) / ow
+        # one sample per bin centre (sampling_ratio=1 equivalent)
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5) * bh[:, None]  # (R,oh)
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5) * bw[:, None]  # (R,ow)
+
+        def bilinear(img, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            v00 = img[:, y0][:, :, x0]
+            v01 = img[:, y0][:, :, x1_]
+            v10 = img[:, y1_][:, :, x0]
+            v11 = img[:, y1_][:, :, x1_]
+            return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None]
+                    + v01 * (1 - wy)[None, :, None] * wx[None, None]
+                    + v10 * wy[None, :, None] * (1 - wx)[None, None]
+                    + v11 * wy[None, :, None] * wx[None, None])
+
+        def per_roi(r):
+            img = x[0]  # (C,H,W); multi-image via boxes_num: round-2
+            return bilinear(img, ys[r], xs[r])
+        return jax.vmap(per_roi)(jnp.arange(R))
+    return apply1(f, x, boxes, name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
+    if boxes_num is not None:
+        raise NotImplementedError(
+            "roi_pool: per-image roi batching (boxes_num) not yet "
+            "supported — all rois sample image 0; pass boxes_num=None")
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(x, rois):
+        N, C, H, W = x.shape
+
+        def per_roi(roi):
+            x1 = jnp.floor(roi[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.floor(roi[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.ceil(roi[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.ceil(roi[3] * spatial_scale).astype(jnp.int32)
+            hh = jnp.maximum(y2 - y1, 1)
+            ww = jnp.maximum(x2 - x1, 1)
+            ys = y1 + (jnp.arange(oh) * hh) // oh
+            xs = x1 + (jnp.arange(ow) * ww) // ow
+            ys = jnp.clip(ys, 0, H - 1)
+            xs = jnp.clip(xs, 0, W - 1)
+            img = x[0]
+            return img[:, ys][:, :, xs]
+        return jax.vmap(per_roi)(rois)
+    return apply1(f, x, boxes, name="roi_pool")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """Decode YOLO head (reference: operators/detection/yolo_box_op)."""
+    na = len(anchors) // 2
+
+    def f(x, img_size):
+        N, C, H, W = x.shape
+        x_ = x.reshape(N, na, 5 + class_num, H, W)
+        gx = (jnp.arange(W))[None, None, None, :]
+        gy = (jnp.arange(H))[None, None, :, None]
+        bx = (jax.nn.sigmoid(x_[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx) / W
+        by = (jax.nn.sigmoid(x_[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy) / H
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        bw = jnp.exp(x_[:, :, 2]) * aw / (W * downsample_ratio)
+        bh = jnp.exp(x_[:, :, 3]) * ah / (H * downsample_ratio)
+        conf = jax.nn.sigmoid(x_[:, :, 4])
+        probs = jax.nn.sigmoid(x_[:, :, 5:]) * conf[:, :, None]
+        imgh = img_size[:, 0].astype(jnp.float32)[:, None]
+        imgw = img_size[:, 1].astype(jnp.float32)[:, None]
+        flat = lambda a: a.reshape(N, -1)
+        x1 = (flat(bx) - flat(bw) / 2) * imgw
+        y1 = (flat(by) - flat(bh) / 2) * imgh
+        x2 = (flat(bx) + flat(bw) / 2) * imgw
+        y2 = (flat(by) + flat(bh) / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        mask = flat(conf) > conf_thresh
+        boxes = boxes * mask[..., None]
+        return boxes, scores
+    from paddle_tpu.core import apply
+    b, s = apply(f, x, img_size, name="yolo_box")
+    return b, s
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5):
+    """SSD prior boxes (reference: operators/detection/prior_box_op)."""
+    H, W = (input.shape[2], input.shape[3])
+    img_h, img_w = (image.shape[2], image.shape[3])
+    step_h = steps[1] or img_h / H
+    step_w = steps[0] or img_w / W
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            for k, ms in enumerate(min_sizes):
+                boxes.append([cx - ms / 2, cy - ms / 2, cx + ms / 2,
+                              cy + ms / 2])
+                if max_sizes:
+                    rs = (ms * max_sizes[k]) ** 0.5
+                    boxes.append([cx - rs / 2, cy - rs / 2, cx + rs / 2,
+                                  cy + rs / 2])
+                for a in ars:
+                    if a == 1.0:
+                        continue
+                    bw = ms * a ** 0.5 / 2
+                    bh = ms / a ** 0.5 / 2
+                    boxes.append([cx - bw, cy - bh, cx + bw, cy + bh])
+    arr = np.asarray(boxes, np.float32)
+    arr[:, 0::2] /= img_w
+    arr[:, 1::2] /= img_h
+    if clip:
+        arr = arr.clip(0, 1)
+    n = len(arr)
+    var = np.tile(np.asarray(variance, np.float32)[None], (n, 1))
+    return Tensor(arr.reshape(H, W, -1, 4)), Tensor(
+        var.reshape(H, W, -1, 4))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    """Encode/decode boxes vs priors (reference:
+    operators/detection/box_coder_op)."""
+    def f(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             jnp.log(tw / pw), jnp.log(th / ph)], 1)
+            return out / pbv
+        # decode
+        d = tb * pbv
+        dcx = d[:, 0] * pw + pcx
+        dcy = d[:, 1] * ph + pcy
+        dw = jnp.exp(d[:, 2]) * pw
+        dh = jnp.exp(d[:, 3]) * ph
+        return jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2, dcy + dh / 2], 1)
+    return apply1(f, prior_box, prior_box_var, target_box, name="box_coder")
