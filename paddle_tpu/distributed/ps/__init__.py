@@ -1,0 +1,227 @@
+"""Parameter-server capability, TPU-native.
+
+The reference ships two PS generations (SURVEY.md §2.4): brpc servers with
+sparse tables (paddle/fluid/distributed/service/brpc_ps_server.cc, tables in
+distributed/table/common_sparse_table.cc), async communicators
+(service/communicator.cc) and GEO-SGD delta sync, plus GPU-resident hash
+tables (framework/fleet/heter_ps/).  Capability = embeddings far larger
+than one device, updated sparsely, with async/geo consistency modes.
+
+TPU-native mapping, two tiers:
+
+- **Device tier — ``ShardedEmbedding``**: the table lives in HBM sharded
+  over a mesh axis (rows split).  XLA partitions the gather and the
+  scatter-add gradient; this is the SparseCore-style path and replaces the
+  GPU heter-PS (hashtable.h) for tables that fit the slice.
+- **Host tier — ``HostEmbeddingTable`` + ``DistributedEmbedding``**: the
+  table lives in host RAM (numpy, trillion-scale capable), rows are pulled
+  per batch to the device and gradient rows pushed back into a host-side
+  optimizer — the role of PullSparseVarsSync/PushSparseVarsAsync
+  (framework/fleet/fleet_wrapper.h:111).  ``AsyncCommunicator`` batches
+  pushes on a worker thread (service/communicator.cc semantics), and
+  ``geo`` mode accumulates deltas and folds them in every k steps
+  (sparse_geo_table.cc semantics), all in-process: multi-host RPC transport
+  is round-2 scope.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Parameter, Tensor, apply1
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.parallel.mesh import DistAttr
+
+__all__ = ["ShardedEmbedding", "HostEmbeddingTable", "DistributedEmbedding",
+           "AsyncCommunicator"]
+
+
+class ShardedEmbedding(Layer):
+    """Embedding with rows sharded over a mesh axis (device tier).
+
+    Unlike VocabParallelEmbedding (tp_layers.py, activation-parallel), this
+    is the *capacity* path: use axis "mp" (or a dedicated axis) purely to
+    fit a big table; gather/scatter stay XLA-partitioned."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 mesh_axis: str = "mp", sparse: bool = True,
+                 weight_attr=None, name=None, scale_grad_by_freq=False):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        std = 1.0 / max(1.0, embedding_dim ** 0.5)
+        init = np.random.default_rng(0).uniform(
+            -std, std, size=(num_embeddings, embedding_dim)).astype(
+                np.float32)
+        self.weight = Parameter(init, name=name or "sharded_embedding")
+        self.weight.dist_attr = DistAttr((mesh_axis, None))
+
+    def forward(self, x):
+        return apply1(lambda w, ids: w[ids], self.weight, x,
+                      name="sharded_embedding")
+
+
+class HostEmbeddingTable:
+    """Host-RAM sparse table with optimizer-on-push (host tier).
+
+    Parity: distributed/table/common_sparse_table.cc — rows created on
+    first touch, per-row optimizer state, save/load.  Supported optimizers:
+    'sgd', 'adagrad' (the reference's common choices for sparse slots)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 optimizer: str = "adagrad", learning_rate: float = 0.05,
+                 initializer_range: float = 0.05, seed: int = 0):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        rng = np.random.default_rng(seed)
+        self._table = rng.uniform(
+            -initializer_range, initializer_range,
+            size=(num_embeddings, embedding_dim)).astype(np.float32)
+        if optimizer == "adagrad":
+            self._g2 = np.zeros((num_embeddings,), np.float32)
+        elif optimizer != "sgd":
+            raise ValueError(f"unsupported table optimizer {optimizer!r}")
+        self._lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """PullSparse (fleet_wrapper.h:111): rows for this batch."""
+        with self._lock:
+            return self._table[ids]
+
+    def push(self, ids: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None):
+        """PushSparse: apply row gradients with the table optimizer.
+        Duplicate ids within a batch are accumulated first (the
+        GradientAccumulator's SelectedRows merge-add)."""
+        lr = self.learning_rate if lr is None else lr
+        flat_ids = ids.reshape(-1)
+        flat_g = grads.reshape(-1, self.embedding_dim)
+        uniq, inv = np.unique(flat_ids, return_inverse=True)
+        acc = np.zeros((len(uniq), self.embedding_dim), np.float32)
+        np.add.at(acc, inv, flat_g)
+        with self._lock:
+            if self.optimizer == "adagrad":
+                self._g2[uniq] += (acc ** 2).mean(axis=1)
+                denom = np.sqrt(self._g2[uniq])[:, None] + 1e-6
+                self._table[uniq] -= lr * acc / denom
+            else:
+                self._table[uniq] -= lr * acc
+
+    # save/load (reference: common_sparse_table save/load)
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        d = {"table": self._table, "optimizer": self.optimizer}
+        if self.optimizer == "adagrad":
+            d["g2"] = self._g2
+        return d
+
+    def set_state_dict(self, d):
+        self._table = np.asarray(d["table"], np.float32)
+        if self.optimizer == "adagrad" and "g2" in d:
+            self._g2 = np.asarray(d["g2"], np.float32)
+
+
+class AsyncCommunicator:
+    """Async push batching (parity: distributed/service/communicator.cc —
+    send queues + merge threads).  mode='async' applies pushes on a worker
+    thread; mode='geo' accumulates deltas and folds every k_steps (GEO-SGD,
+    sparse_geo_table.cc)."""
+
+    def __init__(self, table: HostEmbeddingTable, mode: str = "async",
+                 k_steps: int = 4, send_queue_size: int = 16):
+        assert mode in ("async", "geo", "sync")
+        self.table = table
+        self.mode = mode
+        self.k_steps = k_steps
+        self._q: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
+        self._geo_acc: Dict[int, np.ndarray] = {}
+        self._geo_count = 0
+        self._stop = threading.Event()
+        self._thread = None
+        if mode == "async":
+            self._thread = threading.Thread(target=self._worker,
+                                            daemon=True)
+            self._thread.start()
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                ids, grads = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self.table.push(ids, grads)
+            self._q.task_done()
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        if self.mode == "sync":
+            self.table.push(ids, grads)
+        elif self.mode == "async":
+            self._q.put((ids, grads))
+        else:  # geo: accumulate deltas, fold every k steps
+            flat_ids = ids.reshape(-1)
+            flat_g = grads.reshape(-1, self.table.embedding_dim)
+            for i, g in zip(flat_ids.tolist(), flat_g):
+                if i in self._geo_acc:
+                    self._geo_acc[i] = self._geo_acc[i] + g
+                else:
+                    self._geo_acc[i] = g.copy()
+            self._geo_count += 1
+            if self._geo_count >= self.k_steps:
+                self.flush()
+
+    def flush(self):
+        if self.mode == "async":
+            self._q.join()
+        elif self.mode == "geo" and self._geo_acc:
+            ids = np.asarray(list(self._geo_acc), np.int64)
+            grads = np.stack(list(self._geo_acc.values()))
+            self.table.push(ids, grads)
+            self._geo_acc.clear()
+            self._geo_count = 0
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+
+class DistributedEmbedding(Layer):
+    """Layer over a HostEmbeddingTable: forward pulls rows, backward pushes
+    gradient rows through the communicator (parity: the lookup-table op +
+    DownpourWorker pull/push cycle, device_worker.h:271)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 optimizer: str = "adagrad", learning_rate: float = 0.05,
+                 mode: str = "sync", k_steps: int = 4, seed: int = 0):
+        super().__init__()
+        self.table = HostEmbeddingTable(num_embeddings, embedding_dim,
+                                        optimizer, learning_rate, seed=seed)
+        self.communicator = AsyncCommunicator(self.table, mode=mode,
+                                              k_steps=k_steps)
+        self._embedding_dim = embedding_dim
+
+    def forward(self, x):
+        ids = np.asarray(x.numpy() if isinstance(x, Tensor) else x,
+                         np.int64)
+        rows = self.table.pull(ids)                   # host gather
+        out = Tensor(jnp.asarray(rows), stop_gradient=False)
+        out.is_leaf_ = True
+
+        comm = self.communicator
+
+        def push_hook(grad: Tensor):
+            comm.push(ids, np.asarray(grad.numpy(), np.float32))
+            return grad
+
+        out.register_hook(push_hook)
+        return out
+
+    def flush(self):
+        self.communicator.flush()

@@ -1,0 +1,77 @@
+"""Recommendation/rank models — Wide&Deep, DeepFM (BASELINE.md config #5:
+sparse-embedding PS path; the reference trains these through its
+parameter-server stack with distributed_lookup_table ops).
+
+Sparse features feed ``ShardedEmbedding`` (device tier, SURVEY §2.4 heter-PS
+analogue) so the embedding table shards over the mesh and gradient
+scatter-adds stay on-device; swap in ``DistributedEmbedding`` for host-RAM
+tables beyond HBM.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core import Tensor
+from paddle_tpu.distributed.ps import ShardedEmbedding
+
+__all__ = ["WideDeep", "DeepFM"]
+
+
+class WideDeep(nn.Layer):
+    """Wide (linear over sparse ids) + Deep (MLP over embeddings)."""
+
+    def __init__(self, num_features: int = 100_000, embedding_dim: int = 16,
+                 num_fields: int = 26, dense_dim: int = 13,
+                 hidden=(256, 128, 64)):
+        super().__init__()
+        self.num_fields = num_fields
+        self.embedding = ShardedEmbedding(num_features, embedding_dim)
+        self.wide = ShardedEmbedding(num_features, 1)
+        dims = [num_fields * embedding_dim + dense_dim, *hidden]
+        layers = []
+        for i in range(len(hidden)):
+            layers += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        layers += [nn.Linear(dims[-1], 1)]
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense_x):
+        """sparse_ids (B, F) int, dense_x (B, D) float -> logits (B, 1)."""
+        emb = self.embedding(sparse_ids)              # (B, F, E)
+        B = emb.shape[0]
+        deep_in = paddle.concat(
+            [paddle.reshape(emb, [B, -1]), dense_x], axis=1)
+        deep_out = self.deep(deep_in)                 # (B, 1)
+        wide_out = paddle.sum(self.wide(sparse_ids), axis=1)  # (B, 1)
+        return deep_out + wide_out
+
+
+class DeepFM(nn.Layer):
+    """Factorization machine + deep tower sharing one embedding table."""
+
+    def __init__(self, num_features: int = 100_000, embedding_dim: int = 16,
+                 num_fields: int = 26, dense_dim: int = 13,
+                 hidden=(256, 128)):
+        super().__init__()
+        self.num_fields = num_fields
+        self.embedding = ShardedEmbedding(num_features, embedding_dim)
+        self.first_order = ShardedEmbedding(num_features, 1)
+        dims = [num_fields * embedding_dim + dense_dim, *hidden]
+        layers = []
+        for i in range(len(hidden)):
+            layers += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        layers += [nn.Linear(dims[-1], 1)]
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense_x):
+        emb = self.embedding(sparse_ids)              # (B, F, E)
+        B = emb.shape[0]
+        # FM second order: 0.5 * ((Σv)² − Σv²)
+        sum_sq = paddle.square(paddle.sum(emb, axis=1))
+        sq_sum = paddle.sum(paddle.square(emb), axis=1)
+        fm2 = 0.5 * paddle.sum(sum_sq - sq_sum, axis=1, keepdim=True)
+        fm1 = paddle.sum(self.first_order(sparse_ids), axis=1)
+        deep_in = paddle.concat(
+            [paddle.reshape(emb, [B, -1]), dense_x], axis=1)
+        return fm1 + fm2 + self.deep(deep_in)

@@ -48,54 +48,65 @@ def supported(q_shape, k_shape, no_mask: bool) -> bool:
         # lane dim must tile; 64 is fine via packing but keep it simple
         if d % 128 != 0:
             return False
+    # the grid floors seq/block: a remainder would leave trailing queries
+    # unwritten and trailing keys ignored, so block divisibility is required
+    block_q = min(BLOCK_Q, sq)
+    block_k = min(BLOCK_K, sk)
+    if sq % block_q or sk % block_k:
+        return False
     return sq % _MIN_BLOCK == 0 and sk % _MIN_BLOCK == 0 and sq >= _MIN_BLOCK \
         and sk >= _MIN_BLOCK
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
-                seq_k, block_q):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                scale, causal, block_k, block_q, n_kb):
+    """Grid (bh, q_blocks, kv_blocks): the kv dimension is the innermost,
+    sequentially-executed grid axis, so (m, l, acc) survive in VMEM scratch
+    across kv steps — only one (block_q × block_k) tile is live at a time
+    and HBM traffic stays O(S·D) at any sequence length."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
     qi = pl.program_id(1)
+    kb = pl.program_id(2)
 
-    m0 = jnp.full((q.shape[0],), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((q.shape[0],), jnp.float32)
-    acc0 = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    n_kb = seq_k // block_k
+    # causal: kv blocks strictly above the diagonal contribute nothing
+    needed = True
+    if causal:
+        needed = kb * jnp.int32(block_k) < (qi + 1) * jnp.int32(block_q)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
-        s = q @ k.T                                   # (bq, bk)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale       # (bq, d)
+        k = k_ref[0].astype(jnp.float32)               # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = q @ k.T                                    # (bq, bk)
         if causal:
             q_idx = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             k_idx = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
             s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(jnp.isfinite(m_new)[:, None], p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        alpha = jnp.where(jnp.isfinite(m), alpha, 0.0)
-        l_new = alpha * l + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + p @ v
-        return m_new, l_new, acc_new
+        m_prev = m_scr[...]                            # (bq, 1)
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(jnp.isfinite(m_new), p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(jnp.isfinite(m_prev), alpha, 0.0)
+        m_scr[...] = m_new
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + p @ v
 
-    def run_all():
-        if causal:
-            # only kv blocks at or before this q block contribute
-            last = (qi + 1) * block_q
-            n_needed = pl.cdiv(last, block_k)
-            return jax.lax.fori_loop(0, n_needed, body, (m0, l0, acc0))
-        return jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
-
-    m, l, acc = run_all()
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0] = out.astype(o_ref.dtype)
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        out = acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = out.astype(o_ref.dtype)
 
 
 def _flash_fwd(q, k, v, scale, causal):
@@ -106,6 +117,7 @@ def _flash_fwd(q, k, v, scale, causal):
     sk = k.shape[1]
     block_q = min(BLOCK_Q, sq)
     block_k = min(BLOCK_K, sk)
+    n_kb = sk // block_k
 
     # fold batch and heads; put seq last-but-one for tiling
     qt = jnp.einsum("bshd->bhsd", q).reshape(b * h, sq, d)
@@ -113,18 +125,30 @@ def _flash_fwd(q, k, v, scale, causal):
     vt = jnp.einsum("bshd->bhsd", v).reshape(b * h, sk, d)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, seq_k=sk, block_q=block_q)
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-    )(qt, kt, vt)
+                               block_k=block_k, block_q=block_q, n_kb=n_kb)
+    # Mosaic rejects 64-bit types; the framework enables x64 globally, so
+    # pin 32-bit mode for the kernel trace (index maps would emit i64)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(b * h, sq // block_q, n_kb),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda bh, qi, kb: (bh, qi, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda bh, qi, kb: (bh, kb, 0)),
+                pl.BlockSpec((1, block_k, d),
+                             lambda bh, qi, kb: (bh, kb, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda bh, qi, kb: (bh, qi, 0)),
+            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+        )(qt, kt, vt)
     return jnp.einsum("bhsd->bshd", out.reshape(b, h, sq, d))
 
 

@@ -15,19 +15,14 @@ from typing import Callable, Optional
 import numpy as np
 
 from paddle_tpu.io import Dataset
+from paddle_tpu.io.dataset_cache import CACHE_ROOT as _CACHE, require_file
 
 __all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
            "Conll05st", "FakeTextDataset"]
 
-_CACHE = os.path.expanduser("~/.cache/paddle/dataset")
-
 
 def _need(path, name):
-    if not os.path.exists(path):
-        raise RuntimeError(
-            f"{name}: {path!r} not found and no network egress is "
-            f"available; place the archive there or use FakeTextDataset")
-    return path
+    return require_file(name, path)
 
 
 class Imdb(Dataset):

@@ -19,18 +19,14 @@ from typing import Callable, Optional
 import numpy as np
 
 from paddle_tpu.io import Dataset
+from paddle_tpu.io.dataset_cache import CACHE_ROOT as _CACHE, require_file
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
            "VOC2012", "FakeData", "DatasetFolder", "ImageFolder"]
 
-_CACHE = os.path.expanduser("~/.cache/paddle/dataset")
-
 
 def _no_download(name: str, path: str):
-    raise RuntimeError(
-        f"{name}: file {path!r} not found and this environment has no "
-        f"network egress; place the standard files there or use "
-        f"paddle_tpu.vision.datasets.FakeData")
+    require_file(name, path)
 
 
 class MNIST(Dataset):
@@ -93,8 +89,12 @@ class Cifar10(Dataset):
     """CIFAR python-pickle format (reference: vision/datasets/cifar.py)."""
 
     _URL_FILE = "cifar-10-python.tar.gz"
-    _MEMBER_PREFIX = "cifar-10-batches-py"
     _LABEL_KEY = b"labels"
+
+    @staticmethod
+    def _want_member(name: str, mode: str) -> bool:
+        return (name.startswith("data_batch") if mode == "train"
+                else name == "test_batch")
 
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
                  transform: Optional[Callable] = None, download: bool = True,
@@ -109,11 +109,7 @@ class Cifar10(Dataset):
         with tarfile.open(data_file, "r:*") as tf:
             for member in tf.getmembers():
                 name = os.path.basename(member.name)
-                want = (name.startswith("data_batch") if mode == "train"
-                        else name == "test_batch")
-                if self._MEMBER_PREFIX == "cifar-100-python":
-                    want = name == ("train" if mode == "train" else "test")
-                if not want:
+                if not self._want_member(name, mode):
                     continue
                 d = pickle.load(tf.extractfile(member), encoding="bytes")
                 images.append(d[b"data"])
@@ -133,8 +129,11 @@ class Cifar10(Dataset):
 
 class Cifar100(Cifar10):
     _URL_FILE = "cifar-100-python.tar.gz"
-    _MEMBER_PREFIX = "cifar-100-python"
     _LABEL_KEY = b"fine_labels"
+
+    @staticmethod
+    def _want_member(name: str, mode: str) -> bool:
+        return name == ("train" if mode == "train" else "test")
 
 
 class Flowers(Dataset):

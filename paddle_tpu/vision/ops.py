@@ -174,8 +174,11 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
             y2 = jnp.clip(y2, 0, imgh - 1)
         boxes = jnp.stack([x1, y1, x2, y2], -1)
         scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        # reference yolo_box_op.h zeroes BOTH boxes and scores below the
+        # confidence threshold
         mask = flat(conf) > conf_thresh
         boxes = boxes * mask[..., None]
+        scores = scores * mask[..., None]
         return boxes, scores
     from paddle_tpu.core import apply
     b, s = apply(f, x, img_size, name="yolo_box")

@@ -224,6 +224,10 @@ class RandomCrop(BaseTransform):
             img = pad(img, (0, 0, max(0, tw - w), max(0, th - h)),
                       self.fill, self.padding_mode)
             h, w = img.shape[:2]
+        if h < th or w < tw:
+            raise ValueError(
+                f"RandomCrop: image ({h}x{w}) smaller than crop "
+                f"({th}x{tw}); pass pad_if_needed=True")
         top = random.randint(0, max(0, h - th))
         left = random.randint(0, max(0, w - tw))
         return crop(img, top, left, th, tw)

@@ -1,0 +1,145 @@
+"""PS/embedding-capability tests (reference tiers: the_one_ps tests,
+common_sparse_table save/load, communicator async/geo semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.ps import (AsyncCommunicator,
+                                       DistributedEmbedding,
+                                       HostEmbeddingTable, ShardedEmbedding)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import DeepFM, WideDeep
+from paddle_tpu.parallel import ShardedTrainStep, make_mesh, set_mesh
+
+
+@pytest.fixture(autouse=True)
+def mesh():
+    set_mesh(make_mesh({"dp": 1}))
+    yield
+
+
+def test_host_table_pull_push_sgd():
+    t = HostEmbeddingTable(100, 4, optimizer="sgd", learning_rate=1.0,
+                           initializer_range=0.0)
+    ids = np.asarray([3, 5, 3])
+    grads = np.ones((3, 4), np.float32)
+    t.push(ids, grads)
+    # duplicate id 3 accumulates: row3 -= 2, row5 -= 1
+    np.testing.assert_allclose(t.pull(np.asarray([3]))[0], -2.0)
+    np.testing.assert_allclose(t.pull(np.asarray([5]))[0], -1.0)
+    np.testing.assert_allclose(t.pull(np.asarray([7]))[0], 0.0)
+
+
+def test_host_table_adagrad_and_state():
+    t = HostEmbeddingTable(10, 2, optimizer="adagrad", learning_rate=0.1)
+    ids = np.asarray([1, 2])
+    t.push(ids, np.ones((2, 2), np.float32))
+    sd = t.state_dict()
+    t2 = HostEmbeddingTable(10, 2, optimizer="adagrad")
+    t2.set_state_dict(sd)
+    np.testing.assert_allclose(t.pull(ids), t2.pull(ids))
+
+
+def test_async_communicator_applies_all():
+    t = HostEmbeddingTable(50, 2, optimizer="sgd", learning_rate=1.0,
+                           initializer_range=0.0)
+    comm = AsyncCommunicator(t, mode="async")
+    for _ in range(10):
+        comm.push(np.asarray([7]), np.ones((1, 2), np.float32))
+    comm.flush()
+    np.testing.assert_allclose(t.pull(np.asarray([7]))[0], -10.0)
+    comm.stop()
+
+
+def test_geo_communicator_folds_every_k():
+    t = HostEmbeddingTable(50, 2, optimizer="sgd", learning_rate=1.0,
+                           initializer_range=0.0)
+    comm = AsyncCommunicator(t, mode="geo", k_steps=3)
+    for _ in range(2):
+        comm.push(np.asarray([1]), np.ones((1, 2), np.float32))
+    # not folded yet
+    np.testing.assert_allclose(t.pull(np.asarray([1]))[0], 0.0)
+    comm.push(np.asarray([1]), np.ones((1, 2), np.float32))
+    np.testing.assert_allclose(t.pull(np.asarray([1]))[0], -3.0)
+
+
+def test_distributed_embedding_learns_eager():
+    paddle.seed(0)
+    emb = DistributedEmbedding(20, 4, optimizer="sgd", learning_rate=0.5)
+    head = nn.Linear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.5,
+                        parameters=head.parameters())
+    ids = np.asarray([[1], [2], [3], [4]])
+    target = paddle.to_tensor(
+        np.asarray([[1.0], [-1.0], [1.0], [-1.0]], np.float32))
+    losses = []
+    for _ in range(40):
+        rows = emb(paddle.to_tensor(ids))       # (4,1,4)
+        out = head(paddle.reshape(rows, [4, 4]))
+        loss = ((out - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_sharded_embedding_trains_on_mesh():
+    mesh = make_mesh({"dp": 2, "mp": 4})
+    set_mesh(mesh)
+    paddle.seed(1)
+
+    class Tiny(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = ShardedEmbedding(64, 8)
+            self.fc = nn.Linear(8, 2)
+
+        def forward(self, ids):
+            e = self.emb(ids)
+            return self.fc(paddle.mean(e, axis=1))
+
+    model = Tiny()
+    opt = optimizer.Adam(learning_rate=5e-2,
+                         parameters=model.parameters())
+
+    def loss_fn(m, ids, y):
+        return nn.CrossEntropyLoss()(m(ids), y)
+
+    step = ShardedTrainStep(model, loss_fn, opt, mesh=mesh)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, size=(16, 4)).astype(np.int32)
+    y = (ids.sum(1) % 2).astype(np.int64)
+    losses = [float(step(paddle.to_tensor(ids), paddle.to_tensor(y)))
+              for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("cls", [WideDeep, DeepFM])
+def test_rank_models_train(cls):
+    set_mesh(make_mesh({"dp": 4, "mp": 2}))
+    paddle.seed(2)
+    model = cls(num_features=1000, embedding_dim=8, num_fields=5,
+                dense_dim=3, hidden=(32,))
+    opt = optimizer.Adam(learning_rate=1e-2,
+                         parameters=model.parameters())
+    bce = nn.BCEWithLogitsLoss() if hasattr(nn, "BCEWithLogitsLoss") \
+        else None
+
+    def loss_fn(m, ids, dense, y):
+        logits = m(ids, dense)
+        if bce is not None:
+            return bce(logits, y)
+        import paddle_tpu.nn.functional as F
+        return F.binary_cross_entropy_with_logits(logits, y)
+
+    step = ShardedTrainStep(model, loss_fn, opt,
+                            mesh=make_mesh({"dp": 4, "mp": 2}))
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 1000, size=(16, 5)).astype(np.int32)
+    dense = rng.standard_normal((16, 3)).astype(np.float32)
+    y = (ids.sum(1) % 2).astype(np.float32)[:, None]
+    losses = [float(step(paddle.to_tensor(ids), paddle.to_tensor(dense),
+                         paddle.to_tensor(y))) for _ in range(10)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
