@@ -102,10 +102,10 @@ def _run_engine(root_tensors, root_grads, retain_graph=False,
                 "Trying to backward through the graph a second time, but the "
                 "saved intermediate results have already been freed. Specify "
                 "retain_graph=True on the first backward() call.")
-        if len(cots) == 1:
-            in_grads = node.vjp_fn(cots[0])
-        else:
+        if node.out_is_seq or len(cots) > 1:
             in_grads = node.vjp_fn(tuple(cots))
+        else:
+            in_grads = node.vjp_fn(cots[0])
         for t, g in zip(node.inputs, in_grads):
             if g is None:
                 continue

@@ -301,13 +301,17 @@ class TapeNode:
     """One recorded differentiable op (≈ imperative::GradOpNode,
     reference: paddle/fluid/imperative/layer.h + tracer.cc:205)."""
 
-    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "out_is_seq",
+                 "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, outputs, name=""):
+    def __init__(self, vjp_fn, inputs, outputs, name="", out_is_seq=False):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # list[Tensor] (differentiable inputs)
         self.outputs = outputs        # list[weakref to output Tensors]
         self.name = name
+        # the primal fn returned a tuple/list (vjp then expects the
+        # cotangent wrapped in the same structure, even for one output)
+        self.out_is_seq = out_is_seq
 
 
 def _is_float_dtype(d) -> bool:
@@ -629,7 +633,8 @@ def apply(fn: Callable, *args, name: str = "", nondiff: Sequence[int] = (),
     outs = _wrap_outputs(out, stop_gradient=False)
     node = TapeNode(vjp_fn, [args[i] for i in grad_pos],
                     [weakref.ref(t) for t in outs], name=name or getattr(
-                        fn, "__name__", "op"))
+                        fn, "__name__", "op"),
+                    out_is_seq=isinstance(out, (tuple, list)))
     for idx, t in enumerate(outs):
         t._node = node
         t._out_index = idx

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.core import Parameter, Tensor, apply, no_grad, is_grad_enabled
+from paddle_tpu.core import Parameter, Tensor, apply, no_grad
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.tensor.random import default_generator
 
@@ -89,9 +89,8 @@ class StaticFunction:
     def concrete_program(self):
         return None
 
-    def _build(self, sig, n_params, n_buffers, training, track_grad,
-               param_names, buffer_names, static_args, static_kwargs,
-               out_meta):
+    def _build(self, sig, n_params, n_buffers, param_names, buffer_names,
+               static_args, static_kwargs, out_meta):
         layer = self._layer
         fn = self._function
 
@@ -177,8 +176,8 @@ class StaticFunction:
         if entry is None:
             out_meta: list = []
             jitted = self._build(sig, len(named_params), len(named_buffers),
-                                 training, track, param_names, buffer_names,
-                                 static_args, kwargs, out_meta)
+                                 param_names, buffer_names, static_args,
+                                 kwargs, out_meta)
             entry = {"fn": jitted, "out_meta": out_meta}
             self._cache[sig] = entry
 

@@ -1,0 +1,158 @@
+"""jit.to_static / TrainStep / jit.save+load tests.
+
+The reference tests this surface heavily (test_jit_save_load.py,
+dygraph_to_static/test_*): forward parity eager-vs-captured, backward
+through the captured block, shape-keyed recompilation, save/load
+roundtrip.  Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:756.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestToStatic:
+    def test_forward_parity_vs_eager(self):
+        paddle.seed(0)
+        net = SmallNet()
+        x = paddle.to_tensor(
+            np.random.default_rng(1).normal(size=(3, 8)).astype(np.float32))
+        eager_out = _np(net(x))
+        static_net = jit.to_static(SmallNet())
+        static_net.set_state_dict(net.state_dict())
+        out = _np(static_net(x))
+        np.testing.assert_allclose(out, eager_out, rtol=1e-5, atol=1e-5)
+
+    def test_backward_through_capture(self):
+        paddle.seed(0)
+        net_e = SmallNet()
+        net_s = jit.to_static(SmallNet())
+        net_s.set_state_dict(net_e.state_dict())
+        x = paddle.to_tensor(
+            np.random.default_rng(2).normal(size=(4, 8)).astype(np.float32))
+
+        loss_e = net_e(x).sum()
+        loss_e.backward()
+        loss_s = net_s(x).sum()
+        loss_s.backward()
+
+        np.testing.assert_allclose(float(loss_s), float(loss_e),
+                                   rtol=1e-5, atol=1e-5)
+        ge = {n: _np(p.grad) for n, p in net_e.named_parameters()}
+        gs = {n: _np(p.grad) for n, p in net_s.named_parameters()}
+        assert set(ge) == set(gs)
+        for n in ge:
+            np.testing.assert_allclose(gs[n], ge[n], rtol=1e-5, atol=1e-5,
+                                       err_msg=n)
+
+    def test_recompile_on_new_shape(self):
+        net = jit.to_static(SmallNet())
+        x1 = paddle.to_tensor(np.zeros((2, 8), np.float32))
+        x2 = paddle.to_tensor(np.zeros((5, 8), np.float32))
+        net(x1)
+        sf = net.forward
+        n_after_first = len(sf._cache)
+        net(x1)
+        assert len(sf._cache) == n_after_first  # cache hit
+        net(x2)
+        assert len(sf._cache) == n_after_first + 1  # recompiled
+
+    def test_plain_function_capture(self):
+        @jit.to_static
+        def f(x, y):
+            return x * y + 2.0
+
+        a = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        b = paddle.to_tensor(np.ones(4, np.float32) * 3)
+        out = _np(f(a, b))
+        np.testing.assert_allclose(out, np.arange(4) * 3.0 + 2.0, rtol=1e-6)
+
+    def test_training_flag_in_cache_key(self):
+        class DropNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, x):
+                return F.dropout(self.fc(x), p=0.5,
+                                 training=self.training)
+
+        net = jit.to_static(DropNet())
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        net.train()
+        net(x)
+        net.eval()
+        out1 = _np(net(x))
+        out2 = _np(net(x))
+        np.testing.assert_allclose(out1, out2)  # eval: deterministic
+
+
+class TestSaveLoad:
+    def test_save_load_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        net = SmallNet()
+        net.eval()
+        x = paddle.to_tensor(
+            np.random.default_rng(3).normal(size=(2, 8)).astype(np.float32))
+        ref = _np(net(x))
+        path = str(tmp_path / "model")
+        spec = [paddle.static.InputSpec(shape=[2, 8], dtype="float32")] \
+            if hasattr(paddle.static, "InputSpec") else None
+        if spec is None:
+            pytest.skip("no InputSpec")
+        jit.save(net, path, input_spec=spec)
+        loaded = jit.load(path)
+        out = _np(loaded(x))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestTrainStep:
+    def test_trainstep_matches_eager_sgd(self):
+        paddle.seed(0)
+        net_e = SmallNet()
+        net_s = SmallNet()
+        net_s.set_state_dict(net_e.state_dict())
+        x = paddle.to_tensor(
+            np.random.default_rng(4).normal(size=(4, 8)).astype(np.float32))
+        y = paddle.to_tensor(
+            np.random.default_rng(5).normal(size=(4, 4)).astype(np.float32))
+
+        opt_e = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_e.parameters())
+        opt_s = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_s.parameters())
+
+        def loss_fn(model, xb, yb):
+            return ((model(xb) - yb) ** 2).mean()
+
+        step = jit.TrainStep(net_s, loss_fn, opt_s)
+        for _ in range(3):
+            loss_e = loss_fn(net_e, x, y)
+            loss_e.backward()
+            opt_e.step()
+            opt_e.clear_grad()
+            loss_s = step(x, y)
+            np.testing.assert_allclose(float(loss_s), float(loss_e),
+                                       rtol=1e-4, atol=1e-5)
+        for (n, pe), (_, ps) in zip(net_e.named_parameters(),
+                                    net_s.named_parameters()):
+            np.testing.assert_allclose(_np(ps), _np(pe),
+                                       rtol=1e-4, atol=1e-5, err_msg=n)
