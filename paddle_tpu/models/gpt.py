@@ -164,7 +164,8 @@ def _attention(cfg: GPTConfig, q, k, v):
     if cfg.use_flash_attention:
         try:
             from paddle_tpu.ops.pallas import flash_attention as _fa
-            if _fa.supported(tuple(q.shape), tuple(k.shape), True):
+            if _fa.supported(tuple(q.shape), tuple(k.shape), True,
+                             causal=True):
                 return _fa.flash_attention(q, k, v, causal=True, scale=scale)
         except Exception:
             pass

@@ -55,7 +55,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     try:
         from paddle_tpu.ops.pallas import flash_attention as _fa
         use_flash = _fa.supported(tuple(query.shape), tuple(key.shape),
-                                  attn_mask is None)
+                                  attn_mask is None, causal=is_causal)
     except Exception:
         use_flash = False
 

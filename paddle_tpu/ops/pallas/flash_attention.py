@@ -1,16 +1,25 @@
-"""Flash attention — Pallas TPU kernel.
+"""Flash attention — Pallas TPU kernels, forward AND backward.
 
 Replaces (and exceeds) the reference's fused attention inference kernels
 (paddle/fluid/operators/fused/multihead_matmul_op.cu,
 fused_embedding_eltwise_layernorm) with a training-capable blockwise
 online-softmax attention: the S×S score matrix never leaves VMEM, so HBM
-traffic is O(S·D) instead of O(S²).
+traffic is O(S·D) instead of O(S²) in BOTH directions.
 
-Forward = Pallas kernel over grid (batch*heads, q_blocks); the kv loop is a
-fori_loop inside the kernel with running (max, sum-exp, acc) state.
-Backward (round 1) = XLA recompute via jax.custom_vjp — numerically exact,
-keeps the forward's memory win at inference and trades backward memory for
-simplicity; a full Pallas backward kernel is the planned upgrade.
+Forward: grid (batch*heads, q_blocks, kv_blocks); the kv axis is the
+innermost, sequentially-executed grid axis, so running (max, sum-exp, acc)
+state lives in VMEM scratch.  The per-row logsumexp is written out as a
+residual for the backward.
+
+Backward: two kernels, both recomputing p-tiles from (q, k, lse):
+  - dq:     grid (bh, q_blocks, kv_blocks), dq accumulates in VMEM over kv.
+  - dk/dv:  grid (bh, kv_blocks, q_blocks), dk/dv accumulate over q.
+The softmax-jacobian row term delta = rowsum(dO * O) is an O(S·D) XLA
+precompute.  This is the standard FlashAttention-2 backward dataflow.
+
+Causal masking is END-ALIGNED (query i sees keys j with j <= i + sk - sq),
+matching the XLA fallback's ``tril(k=sk-sq)`` convention; ``supported()``
+rejects causal sq > sk, where end-alignment would leave fully-masked rows.
 
 Layout: (B, S, H, D) [paddle MultiHeadAttention layout].
 """
@@ -26,28 +35,29 @@ BLOCK_Q = 512
 BLOCK_K = 512
 _MIN_BLOCK = 128
 
+# tests flip this to run the kernels in interpreter mode on CPU
+_INTERPRET = False
+
 
 def _backend_is_tpu() -> bool:
-    try:
-        import jax.extend.backend as _b
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return jax.default_backend() in ("tpu", "axon")
+    return jax.default_backend() in ("tpu", "axon")
 
 
-def supported(q_shape, k_shape, no_mask: bool) -> bool:
+def supported(q_shape, k_shape, no_mask: bool, causal: bool = False) -> bool:
     if not no_mask:
         return False
-    if not _backend_is_tpu():
+    if not (_backend_is_tpu() or _INTERPRET):
         return False
     if len(q_shape) != 4 or len(k_shape) != 4:
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]
+    if causal and sq > sk:
+        # end-aligned causal with more queries than keys leaves rows with
+        # no visible key; semantics degenerate — use the XLA path
+        return False
     if d % 128 != 0 and d not in (64,):
-        # lane dim must tile; 64 is fine via packing but keep it simple
-        if d % 128 != 0:
-            return False
+        return False
     # the grid floors seq/block: a remainder would leave trailing queries
     # unwritten and trailing keys ignored, so block divisibility is required
     block_q = min(BLOCK_Q, sq)
@@ -58,12 +68,13 @@ def supported(q_shape, k_shape, no_mask: bool) -> bool:
         and sk >= _MIN_BLOCK
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, block_k, block_q, n_kb):
-    """Grid (bh, q_blocks, kv_blocks): the kv dimension is the innermost,
-    sequentially-executed grid axis, so (m, l, acc) survive in VMEM scratch
-    across kv steps — only one (block_q × block_k) tile is live at a time
-    and HBM traffic stays O(S·D) at any sequence length."""
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_k, block_q, n_kb, off):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -75,10 +86,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: kv blocks strictly above the diagonal contribute nothing
+    # causal (end-aligned): kv blocks strictly beyond the shifted diagonal
+    # contribute nothing
     needed = True
     if causal:
-        needed = kb * jnp.int32(block_k) < (qi + 1) * jnp.int32(block_q)
+        needed = kb * jnp.int32(block_k) < \
+            (qi + 1) * jnp.int32(block_q) + jnp.int32(off)
 
     @pl.when(needed)
     def _compute():
@@ -91,7 +104,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
                 jnp.int32, s.shape, 0)
             k_idx = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(q_idx >= k_idx, s, -jnp.inf)
+            s = jnp.where(q_idx + off >= k_idx, s, -jnp.inf)
         m_prev = m_scr[...]                            # (bq, 1)
         l_prev = l_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -105,11 +118,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(kb == n_kb - 1)
     def _finish():
-        out = acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+        l = l_scr[...]
+        out = acc_scr[...] / jnp.maximum(l, 1e-30)
         o_ref[0] = out.astype(o_ref.dtype)
+        # logsumexp residual; rows with zero mass get -inf (p rebuild → 0)
+        lse_ref[0] = m_scr[...] + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_fwd(q, k, v, scale, causal):
+    """Returns (out (B,S,H,D), lse (B*H, Sq, 1) float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -125,11 +142,12 @@ def _flash_fwd(q, k, v, scale, causal):
     vt = jnp.einsum("bshd->bhsd", v).reshape(b * h, sk, d)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, block_q=block_q, n_kb=n_kb)
+                               block_k=block_k, block_q=block_q, n_kb=n_kb,
+                               off=sk - sq)
     # Mosaic rejects 64-bit types; the framework enables x64 globally, so
     # pin 32-bit mode for the kernel trace (index maps would emit i64)
     with jax.enable_x64(False):
-        out = pl.pallas_call(
+        out, lse = pl.pallas_call(
             kernel,
             grid=(b * h, sq // block_q, n_kb),
             in_specs=[
@@ -140,16 +158,179 @@ def _flash_fwd(q, k, v, scale, causal):
                 pl.BlockSpec((1, block_k, d),
                              lambda bh, qi, kb: (bh, kb, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, d),
-                                   lambda bh, qi, kb: (bh, qi, 0)),
-            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            out_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda bh, qi, kb: (bh, qi, 0)),
+                pl.BlockSpec((1, block_q, 1),
+                             lambda bh, qi, kb: (bh, qi, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            ],
             scratch_shapes=[
                 pltpu.VMEM((block_q, 1), jnp.float32),
                 pltpu.VMEM((block_q, 1), jnp.float32),
                 pltpu.VMEM((block_q, d), jnp.float32),
             ],
+            interpret=_INTERPRET,
         )(qt, kt, vt)
-    return jnp.einsum("bhsd->bshd", out.reshape(b, h, sq, d))
+    return jnp.einsum("bhsd->bshd", out.reshape(b, h, sq, d)), lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off):
+    """Recompute the (bq, bk) probability tile from saved lse."""
+    s = (q @ k.T) * scale
+    if causal:
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_idx + off >= k_idx, s, -jnp.inf)
+    p = jnp.exp(s - lse)
+    return jnp.where(jnp.isfinite(s) & jnp.isfinite(lse), p, 0.0)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_scr, *, scale, causal, block_q, block_k, n_kb, off):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    needed = True
+    if causal:
+        needed = kb * jnp.int32(block_k) < \
+            (qi + 1) * jnp.int32(block_q) + jnp.int32(off)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                               # (bq, 1)
+        delta = delta_ref[0]
+        p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
+                       off)
+        dp = do @ v.T                                  # (bq, bk)
+        ds = p * (dp - delta)
+        acc_scr[...] += (ds @ k) * scale
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                    block_q, block_k, n_qb, off):
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    needed = True
+    if causal:
+        needed = kb * jnp.int32(block_k) < \
+            (qi + 1) * jnp.int32(block_q) + jnp.int32(off)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
+                       off)
+        dv_scr[...] += p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta)
+        dk_scr[...] += (ds.T @ q) * scale
+
+    @pl.when(qi == n_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale, causal):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(BLOCK_Q, sq)
+    block_k = min(BLOCK_K, sk)
+    n_qb = sq // block_q
+    n_kb = sk // block_k
+    off = sk - sq
+
+    qt = jnp.einsum("bshd->bhsd", q).reshape(b * h, sq, d)
+    kt = jnp.einsum("bshd->bhsd", k).reshape(b * h, sk, d)
+    vt = jnp.einsum("bshd->bhsd", v).reshape(b * h, sk, d)
+    dot = jnp.einsum("bshd->bhsd", do).reshape(b * h, sq, d)
+    # delta_i = sum_d dO_i · O_i  (softmax-jacobian row term), O(S·D)
+    delta = jnp.einsum("bshd,bshd->bsh", do.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    delta = jnp.einsum("bsh->bhs", delta).reshape(b * h, sq, 1)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, qi, kb: (bh, qi, 0))
+    # dkv grid order is (bh, kb, qi)
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda bh, kb, qi: (bh, qi, 0))
+    k_spec_t = pl.BlockSpec((1, block_k, d), lambda bh, kb, qi: (bh, kb, 0))
+    row_spec_t = pl.BlockSpec((1, block_q, 1),
+                              lambda bh, kb, qi: (bh, qi, 0))
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, n_kb=n_kb,
+                              off=off),
+            grid=(b * h, n_qb, n_kb),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=_INTERPRET,
+        )(qt, kt, vt, dot, lse, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k, n_qb=n_qb,
+                              off=off),
+            grid=(b * h, n_kb, n_qb),
+            in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
+                      row_spec_t],
+            out_specs=[k_spec_t, k_spec_t],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+            interpret=_INTERPRET,
+        )(qt, kt, vt, dot, lse, delta)
+
+    unfold = lambda x, s: jnp.einsum(
+        "bhsd->bshd", x.reshape(b, h, s, d))
+    return unfold(dq, sq), unfold(dk, sk), unfold(dv, sk)
 
 
 def _xla_reference(q, k, v, scale, causal):
@@ -170,24 +351,22 @@ def _xla_reference(q, k, v, scale, causal):
 def flash_attention(q, k, v, causal=False, scale=None):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_fwd(q, k, v, scale, causal)
+    out, _ = _flash_fwd(q, k, v, scale, causal)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, scale):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    out = _flash_fwd(q, k, v, scale, causal)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    # XLA recompute backward (exact): jax.vjp of the reference formula
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_reference(q_, k_, v_, scale,
-                                                       causal), q, k, v)
-    return vjp(g)
+    return _flash_bwd(q, k, v, o, lse, g, scale, causal)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
