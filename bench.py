@@ -1,12 +1,16 @@
-"""Headline benchmark: BERT-base pretrain tokens/sec/chip, bf16 AMP.
+"""Benchmarks for all five BASELINE.md configs — one JSON line each.
 
-BASELINE.md config #3 ("BERT-base / ERNIE-1.0 pretrain, Fleet DP").  The
-reference publishes no in-repo numbers (SURVEY.md §6); the north-star is
-"within 1.2× V100 step time".  A V100 (fp16, seq-128, fused kernels) runs
-BERT-base pretrain at ≈25k tokens/s, so vs_baseline = value / 25_000 —
->1.0 means faster than the V100 figure, >0.83 meets the 1.2× bound.
+The reference publishes no in-repo numbers (SURVEY.md §6); baselines are
+the driver-assigned north stars from BASELINE.json:
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+  #1 MNIST LeNet dygraph       — "e2e trains"; vs_baseline = 1 iff loss falls
+  #2 ResNet-50 bf16 AMP        — within 1.2× V100 (≈380 samples/s fp16)
+  #3 BERT-base pretrain, DP    — within 1.2× V100 (≈25k tokens/s fp16)
+  #4 GPT-2 345M fused kernels  — "e2e trains"; vs_baseline vs ≈6k tok/s V100
+  #5 Wide&Deep sparse embedding — "e2e trains"; vs_baseline = 1 iff loss falls
+
+Each line: {"metric", "value", "unit", "vs_baseline"}.  The driver records
+the output as BENCH_r{N}.json; keep every line parseable on its own.
 """
 from __future__ import annotations
 
@@ -15,60 +19,193 @@ import time
 
 import numpy as np
 
-V100_TOKENS_PER_SEC = 25_000.0
+V100_BERT_TOKENS_PER_SEC = 25_000.0
+V100_RESNET50_SAMPLES_PER_SEC = 380.0
+V100_GPT2_345M_TOKENS_PER_SEC = 6_000.0
 
 
-def main():
-    import jax
+def _timeit(step_fn, warmup, iters):
+    for _ in range(warmup):
+        out = step_fn()
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step_fn()
+    out.block_until_ready()
+    return time.perf_counter() - t0, out
+
+
+def _emit(metric, value, unit, vs_baseline):
+    print(json.dumps({"metric": metric, "value": round(float(value), 3),
+                      "unit": unit,
+                      "vs_baseline": round(float(vs_baseline), 3)}),
+          flush=True)
+
+
+def bench_bert(on_accel):
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import Bert, BertConfig, bert_pretrain_loss
-    from paddle_tpu.parallel import make_mesh, set_mesh
-
-    on_accel = paddle.is_compiled_with_tpu()
-    set_mesh(make_mesh({"dp": 1}, devices=jax.devices()[:1]))
 
     if on_accel:
         B, S = 64, 128
         cfg = BertConfig(max_seq_len=S, remat=False)
-    else:  # CI smoke path
+    else:
         B, S = 8, 64
         cfg = BertConfig(hidden_size=128, num_layers=2, num_heads=4,
                          vocab_size=8192, max_seq_len=S, remat=False)
-
     model = Bert(cfg)
-    opt = optimizer.AdamW(learning_rate=1e-4,
-                          parameters=model.parameters())
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
     step = TrainStep(model, bert_pretrain_loss, opt, amp_level="O2",
                      amp_dtype="bfloat16")
-
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
                                         size=(B, S)).astype(np.int32))
     mlm = paddle.to_tensor(np.where(rng.random((B, S)) < 0.15,
                                     ids.numpy(), -100).astype(np.int32))
     nsp = paddle.to_tensor(rng.integers(0, 2, size=(B,)).astype(np.int32))
-
-    # warmup (compile)
-    for _ in range(3):
-        loss = step(ids, mlm, nsp)
-    loss.block_until_ready()
-
     iters = 20 if on_accel else 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, mlm, nsp)
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
+    dt, _ = _timeit(lambda: step(ids, mlm, nsp), 3, iters)
+    tps = B * S * iters / dt
+    _emit("bert_base_pretrain_tokens_per_sec_per_chip", tps, "tokens/s",
+          tps / V100_BERT_TOKENS_PER_SEC)
 
-    tokens_per_sec = B * S * iters / dt
-    print(json.dumps({
-        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tokens_per_sec / V100_TOKENS_PER_SEC, 3),
-    }))
+
+def bench_resnet50(on_accel):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50, resnet18
+
+    if on_accel:
+        B, HW = 64, 224
+        model = resnet50(num_classes=1000)
+    else:
+        B, HW = 8, 64
+        model = resnet18(num_classes=10)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y).mean()
+
+    step = TrainStep(model, loss_fn, opt, amp_level="O2",
+                     amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((B, 3, HW, HW)).astype(np.float32))
+    n_cls = 1000 if on_accel else 10
+    y = paddle.to_tensor(rng.integers(0, n_cls, size=(B,)).astype(np.int64))
+    iters = 20 if on_accel else 3
+    dt, _ = _timeit(lambda: step(x, y), 3, iters)
+    sps = B * iters / dt
+    _emit("resnet50_train_samples_per_sec_per_chip_bf16", sps, "samples/s",
+          sps / V100_RESNET50_SAMPLES_PER_SEC)
+
+
+def bench_gpt2_345m(on_accel):
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import GPT, gpt2_345m, gpt_tiny, gpt_loss
+
+    if on_accel:
+        B, S = 4, 1024
+        cfg = gpt2_345m(remat=True, max_seq_len=S)
+    else:
+        B, S = 2, 128
+        cfg = gpt_tiny(num_layers=2, remat=True, max_seq_len=S)
+    model = GPT(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = TrainStep(model, gpt_loss, opt, amp_level="O2",
+                     amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
+                                        size=(B, S)).astype(np.int32))
+    iters = 10 if on_accel else 3
+    dt, _ = _timeit(lambda: step(ids, ids), 3, iters)
+    tps = B * S * iters / dt
+    _emit("gpt2_345m_train_tokens_per_sec_per_chip_bf16", tps, "tokens/s",
+          tps / V100_GPT2_345M_TOKENS_PER_SEC)
+
+
+def bench_widedeep(on_accel):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import WideDeep
+
+    if on_accel:
+        B, feats = 4096, 1_000_000
+    else:
+        B, feats = 256, 10_000
+    model = WideDeep(num_features=feats, embedding_dim=16, num_fields=26,
+                     dense_dim=13)
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    def loss_fn(m, ids, x, y):
+        return F.binary_cross_entropy_with_logits(m(ids, x), y).mean()
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, feats,
+                                        size=(B, 26)).astype(np.int32))
+    x = paddle.to_tensor(rng.standard_normal((B, 13)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 2, size=(B, 1)).astype(np.float32))
+    first = float(step(ids, x, y))
+    iters = 20 if on_accel else 3
+    dt, last = _timeit(lambda: step(ids, x, y), 2, iters)
+    eps = B * iters / dt
+    trains = float(last) < first
+    _emit("widedeep_sparse_train_examples_per_sec_per_chip", eps,
+          "examples/s", 1.0 if trains else 0.0)
+
+
+def bench_lenet(on_accel):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import LeNet
+
+    B = 256 if on_accel else 64
+    model = LeNet(num_classes=10)
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y).mean()
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((B, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, size=(B,)).astype(np.int64))
+    first = float(step(x, y))
+    iters = 50 if on_accel else 5
+    dt, last = _timeit(lambda: step(x, y), 2, iters)
+    sps = B * iters / dt
+    trains = float(last) < first
+    _emit("lenet_mnist_train_samples_per_sec", sps, "samples/s",
+          1.0 if trains else 0.0)
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.parallel import make_mesh, set_mesh
+
+    on_accel = paddle.is_compiled_with_tpu()
+    set_mesh(make_mesh({"dp": 1}, devices=jax.devices()[:1]))
+
+    for bench in (bench_bert, bench_resnet50, bench_gpt2_345m,
+                  bench_widedeep, bench_lenet):
+        try:
+            bench(on_accel)
+        except Exception as e:  # keep remaining configs measurable
+            _emit(bench.__name__ + "_FAILED", 0.0, repr(e)[:120], 0.0)
 
 
 if __name__ == "__main__":

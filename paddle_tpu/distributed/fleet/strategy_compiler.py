@@ -27,18 +27,40 @@ __all__ = ["compile_strategy", "CompiledStrategy"]
 class CompiledStrategy:
     def __init__(self, strategy: DistributedStrategy, mesh,
                  applied_meta_list: List[str], step_kwargs: dict,
-                 optimizer_swap: Optional[str]):
+                 optimizer_swap: Optional[str],
+                 skipped_meta_list: Optional[List[Tuple[str, str]]] = None):
         self.strategy = strategy
         self.mesh = mesh
         self.applied_meta_list = applied_meta_list
+        # (name, reason) strategies requested but deliberately not applied —
+        # the honest replacement for round 1's name-only entries
+        self.skipped_meta_list = skipped_meta_list or []
         self.step_kwargs = step_kwargs
         self.optimizer_swap = optimizer_swap  # 'lamb' | 'lars' | None
 
     def train_step(self, model, loss_fn, optimizer, **overrides):
-        from paddle_tpu.parallel.sharded import ShardedTrainStep
         optimizer = maybe_swap_optimizer(optimizer, self)
         kwargs = dict(self.step_kwargs)
         kwargs.update(overrides)
+        dp_meta_kw = {k: v for k, v in kwargs.items()
+                      if k in ("amp_level", "amp_dtype", "recompute")}
+        if "LocalSGDOptimizer" in self.applied_meta_list or \
+                "AdaptiveLocalSGDOptimizer" in self.applied_meta_list:
+            from paddle_tpu.parallel.dp_meta import LocalSGDTrainStep
+            adaptive = "AdaptiveLocalSGDOptimizer" in self.applied_meta_list
+            cfg = (self.strategy.adaptive_localsgd_configs if adaptive
+                   else self.strategy.localsgd_configs)
+            k = cfg.get("init_k_steps" if adaptive else "k_steps", 4)
+            return LocalSGDTrainStep(
+                model, loss_fn, optimizer, mesh=self.mesh,
+                k_steps=max(1, k), begin_step=cfg.get("begin_step", 1),
+                adaptive=adaptive, **dp_meta_kw)
+        if "FP16AllReduceOptimizer" in self.applied_meta_list:
+            from paddle_tpu.parallel.dp_meta import (
+                CompressedAllReduceTrainStep)
+            return CompressedAllReduceTrainStep(
+                model, loss_fn, optimizer, mesh=self.mesh, **dp_meta_kw)
+        from paddle_tpu.parallel.sharded import ShardedTrainStep
         return ShardedTrainStep(model, loss_fn, optimizer, mesh=self.mesh,
                                 **kwargs)
 
@@ -113,11 +135,23 @@ def compile_strategy(strategy: Optional[DistributedStrategy],
         kw["accumulate_steps"] = max(
             kw.get("accumulate_steps", 1),
             strategy.gradient_merge_configs.get("k_steps", 1))
-    if strategy.localsgd:
-        applied.append("LocalSGDOptimizer")
+    skipped: List[Tuple[str, str]] = []
+    pure_dp_conflicts = [m for m in applied if m in (
+        "ShardingOptimizer", "PipelineOptimizer", "GradientMergeOptimizer")]
+    if strategy.localsgd or strategy.adaptive_localsgd:
+        name = ("AdaptiveLocalSGDOptimizer" if strategy.adaptive_localsgd
+                else "LocalSGDOptimizer")
+        if pure_dp_conflicts:
+            raise ValueError(
+                f"{name} is a pure data-parallel strategy and cannot "
+                f"compose with {pure_dp_conflicts} (matches the reference "
+                f"meta-optimizer exclusion DAG)")
+        applied.append(name)
     if strategy.dgc:
-        applied.append("DGCOptimizer")  # top-k compression: XLA allreduce
-        # stays dense — DGC's bandwidth motivation doesn't apply on ICI
+        # top-k sparse allreduce: the bandwidth motivation doesn't apply on
+        # ICI and XLA's reduce stays dense — record as skipped, not applied
+        skipped.append(("DGCOptimizer",
+                        "n/a on ICI: XLA allreduce stays dense"))
     if strategy.lamb:
         applied.append("LambOptimizer")
         optimizer_swap = "lamb"
@@ -125,11 +159,24 @@ def compile_strategy(strategy: Optional[DistributedStrategy],
         applied.append("LarsOptimizer")
         optimizer_swap = "lars"
     if strategy.fp16_allreduce:
+        if strategy.localsgd or strategy.adaptive_localsgd:
+            raise ValueError(
+                "fp16_allreduce composes with gradient allreduce; LocalSGD "
+                "replaces it with parameter averaging — pick one")
+        if pure_dp_conflicts:
+            raise ValueError(
+                f"FP16AllReduceOptimizer is pure data-parallel and cannot "
+                f"compose with {pure_dp_conflicts}")
         applied.append("FP16AllReduceOptimizer")
-    if mesh.shape.get("dp", 1) > 1 or len(applied) == 0:
+    owns_dp_comm = any(m in applied for m in (
+        "LocalSGDOptimizer", "AdaptiveLocalSGDOptimizer",
+        "FP16AllReduceOptimizer"))
+    if (mesh.shape.get("dp", 1) > 1 and not owns_dp_comm) \
+            or len(applied) == 0:
         applied.append("GraphExecutionOptimizer")  # plain dp allreduce tier
 
-    return CompiledStrategy(strategy, mesh, applied, kw, optimizer_swap)
+    return CompiledStrategy(strategy, mesh, applied, kw, optimizer_swap,
+                            skipped_meta_list=skipped)
 
 
 def maybe_swap_optimizer(optimizer, compiled: CompiledStrategy):
@@ -144,12 +191,14 @@ def maybe_swap_optimizer(optimizer, compiled: CompiledStrategy):
             learning_rate=optimizer.get_lr(),
             lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
             parameters=optimizer._parameter_list)
-    if compiled.optimizer_swap == "lars" and hasattr(opt_mod, "LarsMomentum"):
+    if compiled.optimizer_swap == "lars" and not isinstance(
+            optimizer, opt_mod.LarsMomentum):
         cfg = compiled.strategy.lars_configs
-        if not isinstance(optimizer, opt_mod.LarsMomentum):
-            return opt_mod.LarsMomentum(
-                learning_rate=optimizer.get_lr(),
-                lars_coeff=cfg.get("lars_coeff", 0.001),
-                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
-                parameters=optimizer._parameter_list)
+        return opt_mod.LarsMomentum(
+            learning_rate=optimizer.get_lr(),
+            momentum=getattr(optimizer, "_momentum", 0.9),
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            epsilon=cfg.get("epsilon", 1e-9),
+            parameters=optimizer._parameter_list)
     return optimizer

@@ -9,6 +9,6 @@ fuse the whole update into the training step, and ``step()`` applies it
 eagerly for dygraph parity.
 """
 from paddle_tpu.optimizer.optimizer import (  # noqa: F401
-    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
-    RMSProp, Lamb)
+    Optimizer, SGD, Momentum, LarsMomentum, Adam, AdamW, Adamax, Adagrad,
+    Adadelta, RMSProp, Lamb)
 from paddle_tpu.optimizer import lr  # noqa: F401

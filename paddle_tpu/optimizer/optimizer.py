@@ -9,8 +9,8 @@ import numpy as np
 
 from paddle_tpu.core import Parameter, Tensor, no_grad
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+__all__ = ["Optimizer", "SGD", "Momentum", "LarsMomentum", "Adam", "AdamW",
+           "Adamax", "Adagrad", "Adadelta", "RMSProp", "Lamb"]
 
 
 def _as_float(v):
@@ -210,6 +210,88 @@ class Momentum(Optimizer):
         else:
             new_p = param - lr * v
         return new_p, {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """Layer-wise adaptive rate scaling + momentum (reference:
+    operators/optimizers/lars_momentum_op.cc; fleet meta-optimizer
+    fleet/meta_optimizers/lars_optimizer.py swaps it in for large-batch
+    training).
+
+    local_lr = lr * coeff * ||p|| / (||g|| + wd * ||p|| + eps)
+    v' = mu * v + local_lr * (g + wd * p);  p' = p - v'
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=1e-9, parameters=None,
+                 exclude_from_weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def init_state(self, value):
+        return {"velocity": jnp.zeros_like(value)}
+
+    def _wd_for(self, name: str) -> float:
+        if name and any(s in name for s in self._exclude):
+            return 0.0
+        return self._lars_weight_decay
+
+    def update(self, param, grad, state, lr, wd=None):
+        wd = self._lars_weight_decay if wd is None else wd
+        p_norm = jnp.sqrt(jnp.sum(param.astype(jnp.float32) ** 2))
+        g_norm = jnp.sqrt(jnp.sum(grad.astype(jnp.float32) ** 2))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm /
+            (g_norm + wd * p_norm + self._epsilon),
+            lr).astype(param.dtype)
+        v = self._momentum * state["velocity"] + local_lr * (
+            grad + wd * param)
+        return param - v, {"velocity": v}
+
+    @no_grad()
+    def step(self):
+        # override: route the per-param name through to honor
+        # exclude_from_weight_decay (reference lars_momentum_op honors it)
+        lr = self.get_lr()
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("Optimizer created without parameters")
+        grads_and_params = [(p, p._grad) for p in params
+                            if p._grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            grads_and_params = self._grad_clip(
+                [(p, g) for p, g in grads_and_params])
+        self._global_step += 1
+        for p, g in grads_and_params:
+            state = self._state_for(p)
+            p_lr = lr * getattr(p, "optimize_attr",
+                                {"learning_rate": 1.0})["learning_rate"]
+            garr = g._data if isinstance(g, Tensor) else g
+            new_p, new_state = self.update(p._data, garr, state, p_lr,
+                                           wd=self._wd_for(p.name))
+            p._data = new_p
+            state.update(new_state)
+
+    def functional_update(self, params: dict, grads: dict, states: dict,
+                          lr=None, step=None):
+        lr = self.get_lr() if lr is None else lr
+        new_params, new_states = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_states[name] = states.get(name, {})
+                continue
+            np_, ns = self.update(p, g, dict(states.get(name, {})), lr,
+                                  wd=self._wd_for(name))
+            new_params[name] = np_
+            new_states[name] = ns
+        return new_params, new_states
 
 
 class Adam(Optimizer):

@@ -1,0 +1,281 @@
+"""Honest meta-optimizer semantics (VERDICT round-1 item #5).
+
+Reference parity targets: fleet/meta_optimizers/localsgd_optimizer.py
+(k-step local updates + param averaging), lars_optimizer.py +
+operators/optimizers/lars_momentum_op.cc, fp16_allreduce_optimizer.py:146.
+The reference's compile-only tier asserts which meta-optimizers fired;
+here applied_meta_list must carry only semantics-bearing entries.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optimizer
+from paddle_tpu.distributed.fleet.strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet.strategy_compiler import (
+    compile_strategy, maybe_swap_optimizer)
+from paddle_tpu.parallel import make_mesh, set_mesh
+from paddle_tpu.parallel.dp_meta import (CompressedAllReduceTrainStep,
+                                         LocalSGDTrainStep)
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+
+
+def _loss_fn(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = (x @ rng.standard_normal((8, 1))).astype(np.float32)
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+@pytest.fixture
+def dp_mesh():
+    mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    set_mesh(mesh)
+    return mesh
+
+
+class TestLarsMomentum:
+    def test_update_scales_by_trust_ratio(self):
+        opt = optimizer.LarsMomentum(learning_rate=0.1, momentum=0.0,
+                                     lars_coeff=0.001,
+                                     lars_weight_decay=0.0)
+        p = jnp.full((4,), 2.0)
+        g = jnp.full((4,), 1.0)
+        new_p, st = opt.update(p, g, opt.init_state(p), 0.1)
+        # local_lr = 0.1 * 0.001 * ||p||/||g|| = 1e-4 * 2 = 2e-4
+        np.testing.assert_allclose(np.asarray(new_p), 2.0 - 2e-4 * 1.0,
+                                   rtol=1e-5)
+
+    def test_trajectory_differs_from_momentum(self):
+        m1, m2 = _mlp(0), _mlp(0)
+        x, y = _data()
+        o1 = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                parameters=m1.parameters())
+        o2 = optimizer.LarsMomentum(learning_rate=0.05, momentum=0.9,
+                                    parameters=m2.parameters())
+        for _ in range(3):
+            for m, o in ((m1, o1), (m2, o2)):
+                loss = _loss_fn(m, x, y)
+                loss.backward()
+                o.step()
+                o.clear_grad()
+        w1 = np.asarray(m1.parameters()[0].numpy())
+        w2 = np.asarray(m2.parameters()[0].numpy())
+        assert not np.allclose(w1, w2)
+
+    def test_strategy_swaps_in_lars(self):
+        strategy = DistributedStrategy()
+        strategy.lars = True
+        compiled = compile_strategy(strategy, devices=jax.devices()[:1])
+        assert "LarsOptimizer" in compiled.applied_meta_list
+        m = _mlp()
+        opt = optimizer.Momentum(learning_rate=0.1,
+                                 parameters=m.parameters())
+        swapped = maybe_swap_optimizer(opt, compiled)
+        assert isinstance(swapped, optimizer.LarsMomentum)
+
+
+class TestLocalSGD:
+    def test_loss_decreases_and_sync_happens(self, dp_mesh):
+        model = _mlp()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = LocalSGDTrainStep(model, _loss_fn, opt, mesh=dp_mesh,
+                                 k_steps=4)
+        x, y = _data(64)
+        losses = [float(step(x, y)) for _ in range(8)]
+        assert losses[-1] < losses[0]
+        # step 8 is a multiple of k=4 → params synchronized across replicas
+        stacked = step.replica_params()
+        for n, arr in stacked.items():
+            a = np.asarray(arr)
+            np.testing.assert_allclose(a, np.broadcast_to(a[:1], a.shape),
+                                       rtol=1e-6, atol=1e-6, err_msg=n)
+
+    def test_replicas_diverge_between_syncs(self, dp_mesh):
+        model = _mlp()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = LocalSGDTrainStep(model, _loss_fn, opt, mesh=dp_mesh,
+                                 k_steps=100)  # no sync within this test
+        x, y = _data(64)
+        for _ in range(2):
+            step(x, y)
+        stacked = step.replica_params()
+        diverged = any(
+            not np.allclose(np.asarray(a)[0], np.asarray(a)[1])
+            for a in stacked.values())
+        assert diverged  # different batch shards → different local params
+
+    def test_trajectory_differs_from_sync_dp(self, dp_mesh):
+        from paddle_tpu.parallel.sharded import ShardedTrainStep
+        m_local, m_sync = _mlp(0), _mlp(0)
+        x, y = _data(64)
+        o_local = optimizer.SGD(learning_rate=0.1,
+                                parameters=m_local.parameters())
+        o_sync = optimizer.SGD(learning_rate=0.1,
+                               parameters=m_sync.parameters())
+        local = LocalSGDTrainStep(m_local, _loss_fn, o_local, mesh=dp_mesh,
+                                  k_steps=4)
+        sync = ShardedTrainStep(m_sync, _loss_fn, o_sync, mesh=dp_mesh)
+        for _ in range(3):  # not a sync step yet → divergence visible
+            local(x, y)
+            sync(x, y)
+        local.sync_params()
+        w_local = np.asarray(m_local.parameters()[0].numpy())
+        w_sync = np.asarray(m_sync.parameters()[0].numpy())
+        assert not np.allclose(w_local, w_sync, atol=1e-7)
+
+    def test_sync_params_writes_back(self, dp_mesh):
+        model = _mlp()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = LocalSGDTrainStep(model, _loss_fn, opt, mesh=dp_mesh,
+                                 k_steps=3)
+        x, y = _data(64)
+        before = np.asarray(model.parameters()[0].numpy()).copy()
+        step(x, y)
+        step.sync_params()
+        after = np.asarray(model.parameters()[0].numpy())
+        assert not np.allclose(before, after)
+
+
+class TestCompressedAllReduce:
+    def test_matches_fp32_within_half_precision(self, dp_mesh):
+        from paddle_tpu.parallel.sharded import ShardedTrainStep
+        m_c, m_f = _mlp(0), _mlp(0)
+        x, y = _data(64)
+        o_c = optimizer.SGD(learning_rate=0.05, parameters=m_c.parameters())
+        o_f = optimizer.SGD(learning_rate=0.05, parameters=m_f.parameters())
+        comp = CompressedAllReduceTrainStep(m_c, _loss_fn, o_c,
+                                            mesh=dp_mesh,
+                                            compress_dtype="float16")
+        full = ShardedTrainStep(m_f, _loss_fn, o_f, mesh=dp_mesh)
+        for _ in range(3):
+            lc = float(comp(x, y))
+            lf = float(full(x, y))
+        assert abs(lc - lf) < 5e-3
+        for (n, pc), (_, pf) in zip(m_c.named_parameters(),
+                                    m_f.named_parameters()):
+            np.testing.assert_allclose(
+                np.asarray(pc.numpy()), np.asarray(pf.numpy()),
+                rtol=5e-3, atol=5e-4, err_msg=n)
+
+
+class TestCompilerHonesty:
+    def test_dgc_is_skipped_not_applied(self):
+        strategy = DistributedStrategy()
+        strategy.dgc = True
+        compiled = compile_strategy(strategy, devices=jax.devices()[:8])
+        assert "DGCOptimizer" not in compiled.applied_meta_list
+        assert any(n == "DGCOptimizer"
+                   for n, _ in compiled.skipped_meta_list)
+
+    def test_localsgd_produces_localsgd_step(self, dp_mesh):
+        strategy = DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+        compiled = compile_strategy(strategy, devices=jax.devices()[:8])
+        assert "LocalSGDOptimizer" in compiled.applied_meta_list
+        m = _mlp()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = compiled.train_step(m, _loss_fn, opt)
+        assert isinstance(step, LocalSGDTrainStep)
+        assert step.k_steps == 2
+
+    def test_fp16_allreduce_produces_compressed_step(self, dp_mesh):
+        strategy = DistributedStrategy()
+        strategy.fp16_allreduce = True
+        compiled = compile_strategy(strategy, devices=jax.devices()[:8])
+        assert "FP16AllReduceOptimizer" in compiled.applied_meta_list
+        m = _mlp()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = compiled.train_step(m, _loss_fn, opt)
+        assert isinstance(step, CompressedAllReduceTrainStep)
+
+    def test_conflicting_combos_raise(self):
+        s = DistributedStrategy()
+        s.localsgd = True
+        s.fp16_allreduce = True
+        with pytest.raises(ValueError):
+            compile_strategy(s, devices=jax.devices()[:8])
+
+        s2 = DistributedStrategy()
+        s2.localsgd = True
+        s2.sharding = True
+        s2.sharding_configs = {"sharding_degree": 2, "stage": 1}
+        with pytest.raises(ValueError):
+            compile_strategy(s2, devices=jax.devices()[:8])
+
+
+class TestReviewFixes:
+    def test_localsgd_warmup_is_synchronous_dp(self, dp_mesh):
+        model = _mlp()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = LocalSGDTrainStep(model, _loss_fn, opt, mesh=dp_mesh,
+                                 k_steps=4, begin_step=100)
+        x, y = _data(64)
+        for _ in range(3):
+            step(x, y)
+        # still in warmup (< begin_step): grads were averaged each step, so
+        # replicas must be identical with no param averaging having run
+        stacked = step.replica_params()
+        for n, arr in stacked.items():
+            a = np.asarray(arr)
+            np.testing.assert_allclose(a, np.broadcast_to(a[:1], a.shape),
+                                       rtol=1e-6, atol=1e-6, err_msg=n)
+
+    def test_lars_exclude_from_weight_decay(self):
+        opt = optimizer.LarsMomentum(learning_rate=0.1, momentum=0.0,
+                                     lars_coeff=0.001,
+                                     lars_weight_decay=0.5,
+                                     exclude_from_weight_decay=["bias"])
+        p = jnp.full((4,), 2.0)
+        g = jnp.zeros((4,))
+        # wd-excluded: zero grad + zero wd → param unchanged
+        new_p, _ = opt.update(p, g, opt.init_state(p), 0.1,
+                              wd=opt._wd_for("fc.bias"))
+        np.testing.assert_allclose(np.asarray(new_p), 2.0)
+        # not excluded: wd pulls the param down even with zero grad
+        new_p2, _ = opt.update(p, g, opt.init_state(p), 0.1,
+                               wd=opt._wd_for("fc.weight"))
+        assert float(new_p2[0]) < 2.0
+
+    def test_localsgd_composes_with_amp(self, dp_mesh):
+        strategy = DistributedStrategy()
+        strategy.localsgd = True
+        strategy.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+        strategy.amp = True
+        compiled = compile_strategy(strategy, devices=jax.devices()[:8])
+        m = _mlp()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = compiled.train_step(m, _loss_fn, opt)
+        assert step.amp_level in ("O1", "O2")
+        x, y = _data(64)
+        l0 = float(step(x, y))
+        l1 = float(step(x, y))
+        assert np.isfinite(l0) and np.isfinite(l1)
+
+    def test_no_graph_execution_entry_with_localsgd(self):
+        strategy = DistributedStrategy()
+        strategy.localsgd = True
+        compiled = compile_strategy(strategy, devices=jax.devices()[:8])
+        assert "GraphExecutionOptimizer" not in compiled.applied_meta_list
+
+    def test_gradient_merge_localsgd_conflict_raises(self):
+        s = DistributedStrategy()
+        s.localsgd = True
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 4}
+        with pytest.raises(ValueError):
+            compile_strategy(s, devices=jax.devices()[:8])
