@@ -26,5 +26,8 @@ from paddle_tpu.parallel.mesh import (  # noqa: F401
     mesh_axis_size, shard_spec,
 )
 from paddle_tpu.parallel.sharded import ShardedTrainStep, shard_module  # noqa: F401
-from paddle_tpu.parallel.pipeline import pipeline_forward  # noqa: F401
+from paddle_tpu.parallel.dp_meta import (  # noqa: F401
+    CompressedAllReduceTrainStep, LocalSGDTrainStep)
+from paddle_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline_train_1f1b, pipeline_forward)
 from paddle_tpu.parallel.ring_attention import ring_attention  # noqa: F401

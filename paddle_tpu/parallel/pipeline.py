@@ -33,7 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.parallel.mesh import get_mesh
 
-__all__ = ["pipeline_forward"]
+__all__ = ["pipeline_forward", "make_pipeline_train_1f1b"]
 
 
 def _shard_map(f, mesh, in_specs, out_specs, manual_axes=None):
@@ -56,21 +56,24 @@ def _shard_map(f, mesh, in_specs, out_specs, manual_axes=None):
 
 def _pvary(x, axis_names):
     """Mark a replicated value as device-varying along ``axis_names`` (newer
-    jax tracks varying-manual-axes through shard_map scans)."""
+    jax tracks varying-manual-axes through shard_map scans).  Axes are cast
+    one at a time — pcast rejects mixed varying/invarying axis sets."""
     if isinstance(axis_names, str):
         axis_names = (axis_names,)
-    already = getattr(getattr(x, "aval", None), "vma", ())
-    axis_names = tuple(a for a in axis_names if a not in already)
-    if not axis_names:
-        return x
-    try:
-        return lax.pcast(x, axis_names, to="varying")
-    except (AttributeError, TypeError):
-        pass
-    try:
-        return lax.pvary(x, axis_names)
-    except (AttributeError, TypeError):
-        return x
+    for a in axis_names:
+        already = getattr(getattr(x, "aval", None), "vma", ())
+        if a in already:
+            continue
+        try:
+            x = lax.pcast(x, (a,), to="varying")
+            continue
+        except (AttributeError, TypeError, ValueError):
+            pass
+        try:
+            x = lax.pvary(x, (a,))
+        except (AttributeError, TypeError, ValueError):
+            pass
+    return x
 
 
 def pipeline_forward(stage_fn: Callable, stacked_params, x,
@@ -141,3 +144,271 @@ def _pipeline_body(stage_fn, n_stages, n_micro, axis_name, manual_axes,
         jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
         axis_name)
     return outputs.reshape((batch,) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (schedule_mode 1)
+# ---------------------------------------------------------------------------
+
+
+def _f_sched(stage, t, n_stages, n_micro):
+    """1F1B forward timetable: stage s runs F(m) at t = s + m during warmup
+    (m < P-1-s) and at t = 2m + s in steady state.  Returns (m, valid)."""
+    warm_m = t - stage
+    warm_ok = (warm_m >= 0) & (warm_m < jnp.minimum(
+        n_stages - 1 - stage, n_micro))
+    rel = t - stage
+    steady_m = rel // 2
+    steady_ok = (rel >= 0) & (rel % 2 == 0) & \
+        (steady_m >= n_stages - 1 - stage) & (steady_m < n_micro)
+    m = jnp.where(warm_ok, warm_m, steady_m)
+    return m, warm_ok | steady_ok
+
+
+def _b_sched(stage, t, n_stages, n_micro):
+    """1F1B backward timetable: stage s runs B(m) at t = 2P-1-s+2m."""
+    rel = t - (2 * n_stages - 1 - stage)
+    m = rel // 2
+    ok = (rel >= 0) & (rel % 2 == 0) & (m < n_micro)
+    return m, ok
+
+
+def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
+                             n_microbatches: int,
+                             mesh: Optional[Mesh] = None,
+                             pp_axis: str = "pp", data_axes=("dp",)):
+    """Build a differentiable 1F1B pipelined loss (reference:
+    paddle/fluid/framework/section_worker.cc:115-160, schedule_mode 1).
+
+    Unlike ``pipeline_forward`` (F-then-B via autodiff, schedule_mode 0),
+    the backward here is hand-interleaved with the forward on a clock
+    schedule, so each stage keeps at most P (= pp degree) live microbatch
+    activations instead of M — activation memory is O(P·mb), independent
+    of the microbatch count.  The loss/head must live on the LAST stage
+    (that is what makes interleaving possible), so the head is a separate
+    callable rather than running outside the trunk.
+
+    Args:
+      stage_fn(local_params, x) -> y        shape-preserving trunk stage.
+      head_loss_fn(head_params, y, labels) -> scalar mean loss of one
+        microbatch (runs only on the last stage at B-time).
+      n_microbatches: M, microbatches per local (per-dp-group) batch.
+
+    Returns ``loss_fn(stacked_params, head_params, x, labels) -> scalar``
+    wrapped in a custom_vjp whose gradients were computed *during* the
+    schedule (self-computed-gradient pattern), so it composes with
+    ``jax.grad`` of the surrounding training step.  Tensor parallelism
+    inside the stages is not supported (the per-tick ops run under
+    runtime conds that must stay collective-free); compose 1F1B with
+    dp/sharding only — matching the reference's PipelineOptimizer scope.
+    """
+    mesh = mesh or get_mesh()
+    P_ = mesh.shape.get(pp_axis, 1)
+    M = n_microbatches
+    data = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
+    dp_size = 1
+    for a in data:
+        dp_size *= mesh.shape[a]
+    batch_spec = P(data if data else None)
+
+    def _microbatch_loss(head_params, y, labels):
+        """mean over M of per-microbatch head loss (the quantity the
+        schedule accumulates), from full-batch activations."""
+        mb = y.shape[0] // M
+        ys = y.reshape((M, mb) + y.shape[1:])
+        ls = labels.reshape((M, mb) + labels.shape[1:])
+        per = jax.vmap(lambda yi, li: head_loss_fn(head_params, yi, li))(
+            ys, ls)
+        return jnp.mean(per.astype(jnp.float32))
+
+    if P_ <= 1:
+        # no pipeline axis: plain differentiable composition (mirrors
+        # pipeline_forward's single-stage fallback)
+        def dense(stacked_params, head_params, x, labels):
+            y = stage_fn(stacked_params, x)
+            return _microbatch_loss(head_params, y, labels)
+        return dense
+
+    @jax.jit
+    def _impl(stacked_params, head_params, x, labels):
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(pp_axis), stacked_params)
+        repl = jax.tree_util.tree_map(lambda _: P(), head_params)
+
+        def body(local_params, head_p, xb, yb):
+            stage = lax.axis_index(pp_axis)
+            batch = xb.shape[0]
+            mb = batch // M
+            axes = (pp_axis,) + data
+            vary = lambda t: jax.tree_util.tree_map(
+                lambda a: _pvary(a, axes), t)
+            # promote every input to fully-varying on the manual axes:
+            # differentiating w.r.t. a replicated (invarying) value makes
+            # jax insert an implicit psum for the cotangent INSIDE the
+            # runtime conds below — a collective only some devices would
+            # execute, which deadlocks the ring.  Varying inputs keep all
+            # collectives at the (unconditional) tick boundary.
+            local_params = vary(local_params)
+            head_p = vary(head_p)
+            mbs = vary(xb.reshape((M, mb) + xb.shape[1:]))
+            lbs = vary(yb.reshape((M, mb) + yb.shape[1:]))
+
+            fwd_perm = [(i, i + 1) for i in range(P_ - 1)]
+            bwd_perm = [(i + 1, i) for i in range(P_ - 1)]
+            act_shape = (mb,) + xb.shape[1:]
+
+            dparams0 = jax.tree_util.tree_map(jnp.zeros_like, local_params)
+            dhead0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p), head_p)
+
+            def tick(carry, t):
+                buf, fwd_in, bwd_in, dparams, dhead, dx_all, loss_acc = carry
+                mF, doF = _f_sched(stage, t, P_, M)
+                mB, doB = _b_sched(stage, t, P_, M)
+                m_recv, ok_recv = _f_sched(stage - 1, t - 1, P_, M)
+
+                # 1. land incoming activation (stage 0 sources from x at
+                #    its own F tick; others from the fwd ppermute carry)
+                is0 = stage == 0
+                slot = jnp.where(is0, mF % P_, m_recv % P_)
+                val = jnp.where(is0,
+                                lax.dynamic_index_in_dim(
+                                    mbs, jnp.clip(mF, 0, M - 1), 0, False),
+                                fwd_in)
+                ok_land = jnp.where(is0, doF, ok_recv & (stage > 0))
+                buf = lax.cond(
+                    ok_land,
+                    lambda b: lax.dynamic_update_index_in_dim(
+                        b, val, slot, 0),
+                    lambda b: b, buf)
+
+                # 2. forward op
+                def run_f(_):
+                    inp = lax.dynamic_index_in_dim(buf, mF % P_, 0, False)
+                    return stage_fn(local_params, inp)
+                y = lax.cond(doF, run_f,
+                             lambda _: vary(jnp.zeros(act_shape, xb.dtype)),
+                             0)
+
+                # 3. backward op (vjp with recomputed stage forward; last
+                #    stage instead differentiates stage+head+loss)
+                lab_mb = lax.dynamic_index_in_dim(
+                    lbs, jnp.clip(mB, 0, M - 1), 0, False)
+
+                def run_b(_):
+                    inp = lax.dynamic_index_in_dim(buf, mB % P_, 0, False)
+
+                    def b_last(_):
+                        def last_fn(p, hp, i):
+                            # f32 boundary: keeps the vjp seed and the cond
+                            # zero-branches dtype-consistent for bf16 heads
+                            return head_loss_fn(
+                                hp, stage_fn(p, i), lab_mb).astype(
+                                    jnp.float32)
+                        loss_m, vjp = jax.vjp(last_fn, local_params,
+                                              head_p, inp)
+                        dp, dhp, dinp = vjp(
+                            vary(jnp.ones((), jnp.float32)))
+                        return dp, dhp, dinp, loss_m
+
+                    def b_mid(_):
+                        _, vjp = jax.vjp(
+                            lambda p, i: stage_fn(p, i), local_params, inp)
+                        dp, dinp = vjp(bwd_in)
+                        return (vary(dp), vary(dhead0), dinp,
+                                vary(jnp.zeros((), jnp.float32)))
+
+                    return lax.cond(stage == P_ - 1,
+                                    lambda u: vary(b_last(u)),
+                                    b_mid, 0)
+
+                def no_b(_):
+                    return vary((dparams0, dhead0,
+                                 jnp.zeros(act_shape, xb.dtype),
+                                 jnp.zeros((), jnp.float32)))
+
+                dp_t, dhp_t, dinp, loss_m = lax.cond(doB, run_b, no_b, 0)
+                dparams = jax.tree_util.tree_map(jnp.add, dparams, dp_t)
+                dhead = jax.tree_util.tree_map(jnp.add, dhead, dhp_t)
+                loss_acc = loss_acc + loss_m
+                # stage 0's input-cotangent feeds the (outside) embedding
+                dx_all = lax.cond(
+                    doB & (stage == 0),
+                    lambda b: lax.dynamic_update_index_in_dim(
+                        b, dinp, jnp.clip(mB, 0, M - 1), 0),
+                    lambda b: b, dx_all)
+
+                # 4. ring sends — unconditional, outside every cond
+                fwd_next = lax.ppermute(y, pp_axis, fwd_perm)
+                bwd_next = lax.ppermute(dinp, pp_axis, bwd_perm)
+                return (buf, fwd_next, bwd_next, dparams, dhead, dx_all,
+                        loss_acc), None
+
+            n_ticks = 2 * (M + P_ - 1)
+            zero_act = jnp.zeros(act_shape, xb.dtype)
+            carry0 = (
+                vary(jnp.zeros((P_,) + act_shape, xb.dtype)),
+                vary(zero_act),
+                vary(zero_act),
+                vary(dparams0),
+                vary(dhead0),
+                vary(jnp.zeros((M,) + act_shape, xb.dtype)),
+                vary(jnp.zeros((), jnp.float32)),
+            )
+            (_, _, _, dparams, dhead, dx_all, loss_acc), _ = lax.scan(
+                tick, carry0, jnp.arange(n_ticks))
+
+            # loss lives on the last stage; grads of head only there too —
+            # broadcast over pp, average over data axes
+            loss = lax.psum(loss_acc, pp_axis) / M
+            dhead = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, pp_axis), dhead)
+            # dx was only written on stage 0 (zeros elsewhere): the psum
+            # both collects it and proves pp-replication for the out_spec
+            dx = lax.psum(dx_all.reshape((batch,) + xb.shape[1:]), pp_axis)
+            # dx stays per-dp-shard (no pmean), so fold the 1/dp factor of
+            # the dp-mean loss in here explicitly
+            dx = dx / dp_size
+            scale = 1.0 / M
+            dparams = jax.tree_util.tree_map(lambda g: g * scale, dparams)
+            dhead = jax.tree_util.tree_map(lambda g: g * scale, dhead)
+            dx = dx * scale
+            for a in data:
+                loss = lax.pmean(loss, a)
+                dparams = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, a), dparams)
+                dhead = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, a), dhead)
+            return loss, dparams, dhead, dx
+
+        manual = {pp_axis} | set(data)
+        mapped = _shard_map(
+            body, mesh,
+            in_specs=(param_specs, repl, batch_spec, batch_spec),
+            out_specs=(P(), param_specs, repl, batch_spec),
+            manual_axes=manual)
+        return mapped(stacked_params, head_params, x, labels)
+
+    @jax.custom_vjp
+    def loss_1f1b(stacked_params, head_params, x, labels):
+        # eval-only primal: F-only pipeline + head — the full interleaved
+        # schedule (with its recompute-backward) runs only under jax.grad
+        y = pipeline_forward(stage_fn, stacked_params, x, M, mesh=mesh,
+                             pp_axis=pp_axis, data_axes=data_axes)
+        return _microbatch_loss(head_params, y, labels)
+
+    def fwd(stacked_params, head_params, x, labels):
+        loss, dparams, dhead, dx = _impl(stacked_params, head_params, x,
+                                         labels)
+        return loss, (dparams, dhead, dx, labels)
+
+    def bwd(res, g):
+        import numpy as _np
+        dparams, dhead, dx, labels = res
+        scale_t = lambda t: jax.tree_util.tree_map(lambda a: a * g, t)
+        dlabels = jax.tree_util.tree_map(
+            lambda l: _np.zeros(l.shape, jax.dtypes.float0), labels)
+        return scale_t(dparams), scale_t(dhead), dx * g, dlabels
+
+    loss_1f1b.defvjp(fwd, bwd)
+    return loss_1f1b
