@@ -8,3 +8,6 @@ from paddle_tpu.nn.functional.norm import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.pooling import *  # noqa: F401,F403
 
 from paddle_tpu.tensor.manipulation import one_hot  # noqa: F401
+from paddle_tpu.tensor.sequence import (  # noqa: F401
+    embedding_bag, sequence_mask, sequence_pad, sequence_unpad,
+    sequence_pool, sequence_softmax, sequence_reverse, segment_softmax)

@@ -201,6 +201,8 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
     inside the stages is not supported (the per-tick ops run under
     runtime conds that must stay collective-free); compose 1F1B with
     dp/sharding only — matching the reference's PipelineOptimizer scope.
+    ``labels`` are feed data and are never differentiated through; their
+    cotangent is zero by construction.
     """
     mesh = mesh or get_mesh()
     P_ = mesh.shape.get(pp_axis, 1)
@@ -212,11 +214,18 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
     batch_spec = P(data if data else None)
 
     def _microbatch_loss(head_params, y, labels):
-        """mean over M of per-microbatch head loss (the quantity the
-        schedule accumulates), from full-batch activations."""
-        mb = y.shape[0] // M
-        ys = y.reshape((M, mb) + y.shape[1:])
-        ls = labels.reshape((M, mb) + labels.shape[1:])
+        """mean over dp_size*M of per-microbatch head loss — the exact
+        quantity the schedule accumulates (each dp shard cuts its LOCAL
+        batch into M microbatches), so eval-mode loss matches train-mode
+        loss even for losses that couple elements within a microbatch."""
+        groups = dp_size * M
+        if y.shape[0] % groups:
+            raise ValueError(
+                f"global batch {y.shape[0]} not divisible by dp_size*"
+                f"n_microbatches = {dp_size}*{M}")
+        mb = y.shape[0] // groups
+        ys = y.reshape((groups, mb) + y.shape[1:])
+        ls = labels.reshape((groups, mb) + labels.shape[1:])
         per = jax.vmap(lambda yi, li: head_loss_fn(head_params, yi, li))(
             ys, ls)
         return jnp.mean(per.astype(jnp.float32))
@@ -393,11 +402,19 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
     def loss_1f1b(stacked_params, head_params, x, labels):
         # eval-only primal: F-only pipeline + head — the full interleaved
         # schedule (with its recompute-backward) runs only under jax.grad
+        if x.shape[0] % (dp_size * M):
+            raise ValueError(
+                f"global batch {x.shape[0]} not divisible by dp_size*"
+                f"n_microbatches = {dp_size}*{M}")
         y = pipeline_forward(stage_fn, stacked_params, x, M, mesh=mesh,
                              pp_axis=pp_axis, data_axes=data_axes)
         return _microbatch_loss(head_params, y, labels)
 
     def fwd(stacked_params, head_params, x, labels):
+        if x.shape[0] % (dp_size * M):
+            raise ValueError(
+                f"global batch {x.shape[0]} not divisible by dp_size*"
+                f"n_microbatches = {dp_size}*{M}")
         loss, dparams, dhead, dx = _impl(stacked_params, head_params, x,
                                          labels)
         return loss, (dparams, dhead, dx, labels)
@@ -406,8 +423,15 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
         import numpy as _np
         dparams, dhead, dx, labels = res
         scale_t = lambda t: jax.tree_util.tree_map(lambda a: a * g, t)
+        # labels are feed data, never differentiated through (matching the
+        # reference PipelineOptimizer, where labels enter via feed ops):
+        # integer leaves get float0 (jax's "no tangent space" marker);
+        # inexact leaves get real zeros so downstream dtype logic holds.
         dlabels = jax.tree_util.tree_map(
-            lambda l: _np.zeros(l.shape, jax.dtypes.float0), labels)
+            lambda l: (jnp.zeros_like(l)
+                       if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+                       else _np.zeros(l.shape, jax.dtypes.float0)),
+            labels)
         return scale_t(dparams), scale_t(dhead), dx * g, dlabels
 
     loss_1f1b.defvjp(fwd, bwd)

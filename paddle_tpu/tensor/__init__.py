@@ -13,7 +13,7 @@ import numpy as np
 from paddle_tpu.core import Tensor, apply1, convert_dtype
 
 from paddle_tpu.tensor import creation, linalg, logic, manipulation, math
-from paddle_tpu.tensor import random, search, stat
+from paddle_tpu.tensor import random, search, sequence, stat
 from paddle_tpu.tensor.creation import *  # noqa: F401,F403
 from paddle_tpu.tensor.linalg import *  # noqa: F401,F403
 from paddle_tpu.tensor.logic import *  # noqa: F401,F403
@@ -21,6 +21,7 @@ from paddle_tpu.tensor.manipulation import *  # noqa: F401,F403
 from paddle_tpu.tensor.math import *  # noqa: F401,F403
 from paddle_tpu.tensor.random import *  # noqa: F401,F403
 from paddle_tpu.tensor.search import *  # noqa: F401,F403
+from paddle_tpu.tensor.sequence import *  # noqa: F401,F403
 from paddle_tpu.tensor.stat import (mean, std, var, median, nanmedian,  # noqa: F401
                                     quantile, nanquantile)
 

@@ -1,0 +1,419 @@
+"""Ragged/sequence subsystem — the TPU-native replacement for LoDTensor.
+
+The reference makes raggedness a *tensor property*: LoD offset tables ride
+along every tensor (paddle/fluid/framework/lod_tensor.h:114) and ~20
+operators in paddle/fluid/operators/sequence_ops/ consume them
+(sequence_pool_op.h, sequence_pad_op.h, sequence_mask_op.h,
+sequence_softmax_op.h, sequence_reverse_op.h, sequence_expand_op.h, ...),
+plus the fused sparse path fused_embedding_seq_pool_op.h.
+
+XLA wants static shapes, so here raggedness is *explicit data*, not a
+hidden tensor attribute.  Two interchangeable encodings:
+
+  * padded-dense  — ``(data [B, maxlen, ...], lengths [B])``.  Canonical
+    on-device form: every op is a masked dense op that the MXU/VPU can
+    tile, and ``maxlen`` is a static shape so everything jits.
+  * flat-segmented — ``(values [total, ...], segment_ids [total])``.  For
+    segment reductions / embedding-bag, via ``jax.ops.segment_*`` (which
+    lower to one-hot matmuls or sorted scatters XLA handles well).
+
+Conversions: :func:`sequence_pad` / :func:`sequence_unpad` /
+:func:`lengths_to_segment_ids`.  ``sequence_unpad`` has a data-dependent
+output shape and is therefore eager-only; inside ``jit`` stay in padded
+form (that is the point of the design).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply1
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad",
+    "lengths_to_segment_ids", "segment_ids_to_lengths",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "segment_softmax",
+    "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_first_step", "sequence_last_step", "sequence_concat",
+    "sequence_expand_as", "sequence_enumerate",
+    "embedding_bag",
+]
+
+
+def _as_int(a):
+    return a.astype(jnp.int32)
+
+
+def _static_int(v, name):
+    if isinstance(v, Tensor):
+        v = int(v.numpy())
+    if v is None:
+        raise ValueError(f"{name} must be a static python int on TPU "
+                         "(shapes under jit cannot be data-dependent)")
+    return int(v)
+
+
+# ---------------------------------------------------------------------------
+# masks + encoding conversions
+# ---------------------------------------------------------------------------
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: operators/sequence_ops/sequence_mask_op.h (MaskFunctor):
+    mask[i, j] = j < x[i].  ``maxlen`` must be static under jit; eagerly it
+    defaults to ``max(x)``."""
+    if maxlen is None:
+        maxlen = int(np.max(np.asarray(
+            x.numpy() if isinstance(x, Tensor) else x)))
+    maxlen = _static_int(maxlen, "maxlen")
+
+    def _mask(lengths):
+        pos = jnp.arange(maxlen, dtype=jnp.int32)
+        return (pos[None, :] < _as_int(lengths)[..., None]).astype(dtype)
+    return apply1(_mask, x, nondiff=(0,), name="sequence_mask")
+
+
+def lengths_to_segment_ids(lengths, name=None):
+    """[3, 1, 2] -> [0, 0, 0, 1, 2, 2] (flat, eager) — the LoD offset table
+    → segment-id bridge.  Eager-only: output length is sum(lengths)."""
+    lens = np.asarray(lengths.numpy() if isinstance(lengths, Tensor)
+                      else lengths).astype(np.int64)
+    return Tensor(jnp.asarray(np.repeat(np.arange(lens.size), lens)),
+                  stop_gradient=True)
+
+
+def segment_ids_to_lengths(segment_ids, num_segments, name=None):
+    num_segments = _static_int(num_segments, "num_segments")
+
+    def _run(sids):
+        return jax.ops.segment_sum(jnp.ones_like(sids, dtype=jnp.int64),
+                                   _as_int(sids), num_segments=num_segments)
+    return apply1(_run, segment_ids, nondiff=(0,), name="segment_ids_to_lengths")
+
+
+def sequence_pad(x, pad_value, lengths, maxlen=None, name=None):
+    """flat values [total, ...] + lengths [B] -> (padded [B, maxlen, ...],
+    lengths).  reference: operators/sequence_ops/sequence_pad_op.h, with the
+    LoD argument made explicit.  Eager-friendly scatter; also jittable since
+    ``total`` and ``maxlen`` are static at trace time."""
+    if maxlen is None:
+        maxlen = int(np.max(np.asarray(
+            lengths.numpy() if isinstance(lengths, Tensor) else lengths)))
+    maxlen = _static_int(maxlen, "maxlen")
+
+    def _pad(values, lens):
+        lens = _as_int(lens)
+        b = lens.shape[0]
+        starts = jnp.cumsum(lens) - lens                       # [B]
+        # row/col of every flat element in the padded output
+        seg = jnp.repeat(jnp.arange(b, dtype=jnp.int32), lens,
+                         total_repeat_length=values.shape[0])
+        pos = jnp.arange(values.shape[0], dtype=jnp.int32) - starts[seg]
+        out = jnp.full((b, maxlen) + values.shape[1:], pad_value,
+                       dtype=values.dtype)
+        return out.at[seg, pos].set(values)
+    padded = apply1(_pad, x, lengths, nondiff=(1,), name="sequence_pad")
+    return padded, lengths
+
+
+def sequence_unpad(x, length, name=None):
+    """padded [B, maxlen, ...] + lengths [B] -> flat [total, ...]
+    (reference: operators/sequence_ops/sequence_unpad_op.h).  Eager-only:
+    ``total`` is data-dependent."""
+    lens = np.asarray(length.numpy() if isinstance(length, Tensor)
+                      else length).astype(np.int64)
+    total = int(lens.sum())
+    seg = np.repeat(np.arange(lens.size), lens)
+    starts = np.cumsum(lens) - lens
+    pos = np.arange(total) - starts[seg]
+
+    def _unpad(padded):
+        return padded[jnp.asarray(seg), jnp.asarray(pos)]
+    return apply1(_unpad, x, name="sequence_unpad")
+
+
+# ---------------------------------------------------------------------------
+# segment reductions (flat-segmented encoding)
+# ---------------------------------------------------------------------------
+
+def _infer_num_segments(segment_ids, num_segments):
+    if num_segments is not None:
+        return num_segments
+    return int(np.max(np.asarray(
+        segment_ids.numpy() if isinstance(segment_ids, Tensor)
+        else segment_ids))) + 1
+
+
+def _segment_reduce(kind, data, segment_ids, num_segments, name):
+    num_segments = _static_int(num_segments, "num_segments")
+    ops = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+
+    def _run(vals, sids):
+        sids = _as_int(sids)
+        if kind == "mean":
+            s = jax.ops.segment_sum(vals, sids, num_segments=num_segments)
+            n = jax.ops.segment_sum(jnp.ones((vals.shape[0],), vals.dtype),
+                                    sids, num_segments=num_segments)
+            shape = (num_segments,) + (1,) * (vals.ndim - 1)
+            return s / jnp.maximum(n, 1.0).reshape(shape)
+        out = ops[kind](vals, sids, num_segments=num_segments)
+        if kind in ("max", "min"):
+            # empty segments come back ±inf; zero them like the reference's
+            # sequence_pool (sequence_pool_op.h pads empty seqs with 0)
+            n = jax.ops.segment_sum(jnp.ones((vals.shape[0],)), sids,
+                                    num_segments=num_segments)
+            shape = (num_segments,) + (1,) * (vals.ndim - 1)
+            out = jnp.where(n.reshape(shape) > 0, out,
+                            jnp.zeros_like(out))
+        return out
+    return apply1(_run, data, segment_ids, nondiff=(1,), name=name)
+
+
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    """reference role: operators/segment_pool_op (SUM) — flat values grouped
+    by segment id, summed.  Differentiable in ``data``."""
+    return _segment_reduce("sum", data, segment_ids,
+                           _infer_num_segments(segment_ids, num_segments),
+                           "segment_sum")
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    return _segment_reduce("mean", data, segment_ids,
+                           _infer_num_segments(segment_ids, num_segments),
+                           "segment_mean")
+
+
+def segment_max(data, segment_ids, num_segments=None, name=None):
+    return _segment_reduce("max", data, segment_ids,
+                           _infer_num_segments(segment_ids, num_segments),
+                           "segment_max")
+
+
+def segment_min(data, segment_ids, num_segments=None, name=None):
+    return _segment_reduce("min", data, segment_ids,
+                           _infer_num_segments(segment_ids, num_segments),
+                           "segment_min")
+
+
+def segment_softmax(data, segment_ids, num_segments=None, name=None):
+    """Softmax within each segment of a flat tensor (the sequence_softmax
+    role — sequence_softmax_op.h — on the flat-segmented encoding)."""
+    num_segments = _static_int(
+        _infer_num_segments(segment_ids, num_segments), "num_segments")
+
+    def _run(vals, sids):
+        sids = _as_int(sids)
+        mx = jax.ops.segment_max(vals, sids, num_segments=num_segments)
+        mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+        e = jnp.exp(vals - mx[sids])
+        z = jax.ops.segment_sum(e, sids, num_segments=num_segments)
+        return e / z[sids]
+    return apply1(_run, data, segment_ids, nondiff=(1,),
+                  name="segment_softmax")
+
+
+# ---------------------------------------------------------------------------
+# padded-dense sequence ops
+# ---------------------------------------------------------------------------
+
+def _time_mask(lens, t, extra_dims):
+    m = jnp.arange(t, dtype=jnp.int32)[None, :] < _as_int(lens)[:, None]
+    return m.reshape(m.shape + (1,) * extra_dims)
+
+
+def sequence_pool(x, pool_type, lengths, pad_value=0.0, name=None):
+    """Masked pooling over the time axis of padded [B, T, ...] input.
+    pool_types: average/sum/sqrt/max/min/first/last
+    (reference: operators/sequence_ops/sequence_pool_op.h + math/sequence_pooling.cc)."""
+    pool_type = pool_type.lower()
+
+    def _run(a, lens):
+        lens = _as_int(lens)
+        t = a.shape[1]
+        m = _time_mask(lens, t, a.ndim - 2)
+        empty = (lens == 0).reshape((-1,) + (1,) * (a.ndim - 2))
+        if pool_type in ("average", "mean", "sum", "sqrt"):
+            s = jnp.sum(jnp.where(m, a, 0.0), axis=1)
+            if pool_type == "sum":
+                out = s
+            else:
+                denom = jnp.maximum(lens, 1).astype(a.dtype)
+                denom = denom.reshape((-1,) + (1,) * (a.ndim - 2))
+                out = s / (jnp.sqrt(denom) if pool_type == "sqrt" else denom)
+        elif pool_type == "max":
+            out = jnp.max(jnp.where(m, a, -jnp.inf), axis=1)
+            out = jnp.where(empty, 0.0, out)
+        elif pool_type == "min":
+            out = jnp.min(jnp.where(m, a, jnp.inf), axis=1)
+            out = jnp.where(empty, 0.0, out)
+        elif pool_type == "first":
+            out = a[:, 0]
+        elif pool_type == "last":
+            idx = jnp.maximum(lens - 1, 0)
+            out = jnp.take_along_axis(
+                a, idx.reshape((-1, 1) + (1,) * (a.ndim - 2)), axis=1
+            ).squeeze(1)
+        else:
+            raise ValueError(f"unknown pool_type {pool_type}")
+        if pool_type in ("first", "last"):
+            out = jnp.where(empty, pad_value, out)
+        elif pad_value:
+            out = jnp.where(empty, pad_value, out)
+        return out
+    return apply1(_run, x, lengths, nondiff=(1,), name="sequence_pool")
+
+
+def sequence_first_step(x, lengths, name=None):
+    return sequence_pool(x, "first", lengths)
+
+
+def sequence_last_step(x, lengths, name=None):
+    return sequence_pool(x, "last", lengths)
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Masked softmax along time for padded [B, T] / [B, T, ...] input
+    (reference: operators/sequence_ops/sequence_softmax_op.h)."""
+    def _run(a, lens):
+        m = _time_mask(lens, a.shape[1], a.ndim - 2)
+        z = jnp.where(m, a, -jnp.inf)
+        z = z - jnp.max(jnp.where(m, a, -jnp.inf), axis=1, keepdims=True)
+        e = jnp.where(m, jnp.exp(z), 0.0)
+        denom = jnp.sum(e, axis=1, keepdims=True)
+        return e / jnp.maximum(denom, 1e-30)
+    return apply1(_run, x, lengths, nondiff=(1,), name="sequence_softmax")
+
+
+def sequence_reverse(x, lengths, name=None):
+    """Reverse each row's first lengths[i] steps, padding stays in place
+    (reference: operators/sequence_ops/sequence_reverse_op.h)."""
+    def _run(a, lens):
+        lens = _as_int(lens)
+        t = a.shape[1]
+        pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+        src = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(
+            a, src.reshape(src.shape + (1,) * (a.ndim - 2)), axis=1)
+    return apply1(_run, x, lengths, nondiff=(1,), name="sequence_reverse")
+
+
+def sequence_concat(inputs, lengths_list, name=None):
+    """Concatenate sequences row-wise in time: row i of the output is
+    x1[i,:l1[i]] ++ x2[i,:l2[i]] ++ ...  (reference:
+    operators/sequence_ops/sequence_concat_op.h).  Returns (padded, lengths)."""
+    lens_np = [np.asarray(l.numpy() if isinstance(l, Tensor) else l)
+               .astype(np.int64) for l in lengths_list]
+    total = sum(lens_np)
+    maxlen = int(total.max())
+
+    def _run(*arrs):
+        n = len(inputs)
+        xs, lens = arrs[:n], [_as_int(l) for l in arrs[n:]]
+        b = xs[0].shape[0]
+        # one scratch column at index `maxlen` absorbs masked-out writes
+        out = jnp.zeros((b, maxlen + 1) + xs[0].shape[2:], xs[0].dtype)
+        offset = jnp.zeros((b,), jnp.int32)
+        for a, l in zip(xs, lens):
+            t = a.shape[1]
+            pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+            valid = pos < l[:, None]
+            dst = jnp.where(valid, offset[:, None] + pos, maxlen)
+            rows = jnp.broadcast_to(
+                jnp.arange(b, dtype=jnp.int32)[:, None], dst.shape)
+            out = out.at[rows, dst].set(
+                jnp.where(valid.reshape(valid.shape + (1,) * (a.ndim - 2)),
+                          a, out[rows, dst]))
+            offset = offset + l
+        return out[:, :maxlen]
+    padded = apply1(_run, *inputs, *lengths_list,
+                    nondiff=tuple(range(len(inputs),
+                                        len(inputs) + len(lengths_list))),
+                    name="sequence_concat")
+    return padded, Tensor(jnp.asarray(total), stop_gradient=True)
+
+
+def sequence_expand_as(x, lengths, name=None):
+    """Expand row i of x [B, ...] to lengths[i] flat copies — the
+    sequence_expand_as_op.h role on the flat-segmented encoding.  Eager-only
+    output length."""
+    seg = lengths_to_segment_ids(lengths)
+
+    def _run(a, sids):
+        return jnp.take(a, _as_int(sids), axis=0)
+    return apply1(_run, x, seg, nondiff=(1,), name="sequence_expand_as")
+
+
+def sequence_enumerate(x, win_size, pad_value=0, lengths=None, name=None):
+    """All win_size-grams per row of padded int ids [B, T] -> [B, T, win]
+    (reference: operators/sequence_ops/sequence_enumerate_op.h), with
+    positions past the row's length (or the tensor edge) set to pad_value."""
+    win_size = int(win_size)
+
+    def _run(ids, *rest):
+        t = ids.shape[1]
+        pos = jnp.arange(t, dtype=jnp.int32)[None, :, None] + \
+            jnp.arange(win_size, dtype=jnp.int32)[None, None, :]
+        limit = (rest[0].astype(jnp.int32)[:, None, None] if rest
+                 else jnp.full((ids.shape[0], 1, 1), t, jnp.int32))
+        valid = pos < jnp.minimum(limit, t)
+        gathered = jnp.take_along_axis(
+            ids[:, :, None], jnp.clip(pos, 0, t - 1), axis=1)
+        return jnp.where(valid, gathered, pad_value)
+    args = (x,) if lengths is None else (x, lengths)
+    return apply1(_run, *args, nondiff=tuple(range(len(args))),
+                  name="sequence_enumerate")
+
+
+# ---------------------------------------------------------------------------
+# embedding-bag (the fused_embedding_seq_pool role)
+# ---------------------------------------------------------------------------
+
+def embedding_bag(input, weight, lengths=None, mode="sum", padding_idx=None,
+                  name=None):
+    """Lookup + pooled reduction in one fused op — the role of
+    operators/fused/fused_embedding_seq_pool_op.h (lookup + sequence_pool
+    without materialising the [total, D] intermediate twice).
+
+    Padded form: ``input`` [B, T] int ids + ``lengths`` [B] -> [B, D].
+    Flat form  : ``input`` [total] ids with ``lengths`` as segment_ids of
+    the same length -> [num_segments, D].
+
+    On TPU, XLA fuses gather→masked-sum into a single pass over HBM; the
+    sparse-gradient side of the reference op maps to the embedding-table
+    subsystem (paddle_tpu.distributed.ps), not SelectedRows.
+    """
+    mode = mode.lower()
+    if mode not in ("sum", "mean", "max"):
+        raise ValueError(f"embedding_bag mode must be sum/mean/max, "
+                         f"got {mode!r}")
+    if input.ndim == 2:
+        if lengths is None:
+            lens = np.full((int(input.shape[0]),), int(input.shape[1]),
+                           np.int64)
+            lengths = Tensor(jnp.asarray(lens), stop_gradient=True)
+
+        def _run(ids, w, lens):
+            ids = _as_int(ids)
+            e = jnp.take(w, ids, axis=0)                     # [B, T, D]
+            m = _time_mask(lens, ids.shape[1], 1)
+            if padding_idx is not None:
+                m = m & (ids != padding_idx)[..., None]
+            if mode == "max":
+                out = jnp.max(jnp.where(m, e, -jnp.inf), axis=1)
+                return jnp.where(jnp.isfinite(out), out, 0.0)
+            s = jnp.sum(jnp.where(m, e, 0.0), axis=1)
+            if mode == "sum":
+                return s
+            n = jnp.sum(m.astype(e.dtype), axis=1)
+            return s / jnp.maximum(n, 1.0)
+        return apply1(_run, input, weight, lengths, nondiff=(0, 2),
+                      name="embedding_bag")
+    # flat-segmented
+    if lengths is None:
+        raise ValueError("flat embedding_bag needs segment_ids in `lengths`")
+    emb = apply1(lambda ids, w: jnp.take(w, _as_int(ids), axis=0),
+                 input, weight, nondiff=(0,), name="embedding_bag.lookup")
+    red = {"sum": segment_sum, "mean": segment_mean, "max": segment_max}[mode]
+    return red(emb, lengths)

@@ -1,0 +1,277 @@
+"""Ragged/sequence subsystem tests — the LoD-op tier of the reference suite
+(python/paddle/fluid/tests/unittests/test_sequence_*.py, test_seq_pool.py,
+test_fused_embedding_seq_pool_op.py), on the explicit (values, lengths /
+segment_ids) encodings of paddle_tpu.tensor.sequence."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output
+
+RNG = np.random.default_rng(7)
+
+
+def _lens(b=5, t=7):
+    return np.array([t, 1, 3, 0, 5][:b][:b], dtype=np.int64)[:b]
+
+
+class TestSequenceMask:
+    def test_values(self):
+        lens = np.array([3, 0, 5], np.int64)
+        out = paddle.sequence_mask(paddle.to_tensor(lens), maxlen=6,
+                                   dtype="float32").numpy()
+        exp = (np.arange(6)[None, :] < lens[:, None]).astype(np.float32)
+        np.testing.assert_array_equal(out, exp)
+
+    def test_default_maxlen(self):
+        lens = np.array([2, 4], np.int64)
+        assert paddle.sequence_mask(paddle.to_tensor(lens)).shape[1] == 4
+
+
+class TestPadUnpad:
+    def test_roundtrip(self):
+        lens = np.array([3, 1, 4], np.int64)
+        flat = RNG.standard_normal((8, 2)).astype(np.float32)
+        padded, L = paddle.sequence_pad(paddle.to_tensor(flat), 9.0,
+                                        paddle.to_tensor(lens))
+        assert padded.shape == [3, 4, 2]
+        p = padded.numpy()
+        np.testing.assert_allclose(p[0, :3], flat[:3], rtol=1e-6)
+        np.testing.assert_allclose(p[1, :1], flat[3:4], rtol=1e-6)
+        np.testing.assert_allclose(p[2, :4], flat[4:], rtol=1e-6)
+        assert (p[0, 3] == 9.0).all() and (p[1, 1:] == 9.0).all()
+        back = paddle.sequence_unpad(padded, L)
+        np.testing.assert_allclose(back.numpy(), flat, rtol=1e-6)
+
+    def test_pad_grad(self):
+        lens = np.array([2, 3], np.int64)
+        flat = RNG.standard_normal((5, 2)).astype(np.float64)
+        check_grad(lambda x: paddle.sequence_pad(
+            x, 0.0, paddle.to_tensor(lens))[0], [flat])
+
+    def test_unpad_grad(self):
+        lens = np.array([2, 3], np.int64)
+        padded = RNG.standard_normal((2, 3, 2)).astype(np.float64)
+        check_grad(lambda x: paddle.sequence_unpad(
+            x, paddle.to_tensor(lens)), [padded])
+
+
+class TestSegmentOps:
+    def _data(self):
+        sids = np.array([0, 0, 1, 1, 1, 3], np.int64)  # segment 2 empty
+        vals = RNG.standard_normal((6, 3)).astype(np.float64)
+        return vals, sids
+
+    def test_sum_mean_max_min(self):
+        vals, sids = self._data()
+        s = paddle.segment_sum(paddle.to_tensor(vals), paddle.to_tensor(sids),
+                               num_segments=4).numpy()
+        np.testing.assert_allclose(s[0], vals[:2].sum(0), rtol=1e-6)
+        np.testing.assert_allclose(s[1], vals[2:5].sum(0), rtol=1e-6)
+        np.testing.assert_allclose(s[2], 0.0)
+        np.testing.assert_allclose(s[3], vals[5], rtol=1e-6)
+        m = paddle.segment_mean(paddle.to_tensor(vals),
+                                paddle.to_tensor(sids),
+                                num_segments=4).numpy()
+        np.testing.assert_allclose(m[1], vals[2:5].mean(0), rtol=1e-6)
+        mx = paddle.segment_max(paddle.to_tensor(vals),
+                                paddle.to_tensor(sids),
+                                num_segments=4).numpy()
+        np.testing.assert_allclose(mx[1], vals[2:5].max(0), rtol=1e-6)
+        np.testing.assert_allclose(mx[2], 0.0)  # empty segment zeroed
+        mn = paddle.segment_min(paddle.to_tensor(vals),
+                                paddle.to_tensor(sids),
+                                num_segments=4).numpy()
+        np.testing.assert_allclose(mn[1], vals[2:5].min(0), rtol=1e-6)
+
+    @pytest.mark.parametrize("op", ["segment_sum", "segment_mean",
+                                    "segment_max"])
+    def test_grads(self, op):
+        vals, sids = self._data()
+        fn = getattr(paddle, op)
+        check_grad(lambda x: fn(x, paddle.to_tensor(sids), num_segments=4),
+                   [vals])
+
+    def test_segment_softmax(self):
+        vals = np.array([1.0, 2.0, 3.0, 10.0], np.float64)
+        sids = np.array([0, 0, 0, 1], np.int64)
+        out = paddle.segment_softmax(paddle.to_tensor(vals),
+                                     paddle.to_tensor(sids),
+                                     num_segments=2).numpy()
+        e = np.exp(vals[:3] - vals[:3].max())
+        np.testing.assert_allclose(out[:3], e / e.sum(), rtol=1e-6)
+        np.testing.assert_allclose(out[3], 1.0, rtol=1e-6)
+        check_grad(lambda x: paddle.segment_softmax(
+            x, paddle.to_tensor(sids), num_segments=2),
+            [RNG.standard_normal(4)])
+
+
+class TestSequencePool:
+    def _padded(self):
+        lens = np.array([3, 1, 0], np.int64)
+        x = RNG.standard_normal((3, 4, 2)).astype(np.float64)
+        return x, lens
+
+    @pytest.mark.parametrize("ptype,ref", [
+        ("sum", lambda x, l: x[:l].sum(0) if l else np.zeros(x.shape[1:])),
+        ("average", lambda x, l: x[:l].mean(0) if l else
+         np.zeros(x.shape[1:])),
+        ("sqrt", lambda x, l: x[:l].sum(0) / np.sqrt(l) if l else
+         np.zeros(x.shape[1:])),
+        ("max", lambda x, l: x[:l].max(0) if l else np.zeros(x.shape[1:])),
+        ("first", lambda x, l: x[0] if l else np.zeros(x.shape[1:])),
+        ("last", lambda x, l: x[l - 1] if l else np.zeros(x.shape[1:])),
+    ])
+    def test_types(self, ptype, ref):
+        x, lens = self._padded()
+        out = paddle.sequence_pool(paddle.to_tensor(x), ptype,
+                                   paddle.to_tensor(lens)).numpy()
+        for i, l in enumerate(lens):
+            np.testing.assert_allclose(out[i], ref(x[i], int(l)), rtol=1e-6,
+                                       atol=1e-12)
+
+    @pytest.mark.parametrize("ptype", ["sum", "average", "sqrt", "max"])
+    def test_grads(self, ptype):
+        x, lens = self._padded()
+        check_grad(lambda a: paddle.sequence_pool(
+            a, ptype, paddle.to_tensor(lens)), [x])
+
+
+class TestSequenceSoftmaxReverse:
+    def test_softmax(self):
+        lens = np.array([2, 4], np.int64)
+        x = RNG.standard_normal((2, 4)).astype(np.float64)
+        out = paddle.sequence_softmax(paddle.to_tensor(x),
+                                      paddle.to_tensor(lens)).numpy()
+        e0 = np.exp(x[0, :2] - x[0, :2].max())
+        np.testing.assert_allclose(out[0, :2], e0 / e0.sum(), rtol=1e-6)
+        np.testing.assert_allclose(out[0, 2:], 0.0)
+        np.testing.assert_allclose(out.sum(1), [1.0, 1.0], rtol=1e-6)
+        check_grad(lambda a: paddle.sequence_softmax(
+            a, paddle.to_tensor(lens)), [x])
+
+    def test_reverse(self):
+        lens = np.array([3, 1], np.int64)
+        x = np.arange(8, dtype=np.float64).reshape(2, 4)
+        out = paddle.sequence_reverse(paddle.to_tensor(x),
+                                      paddle.to_tensor(lens)).numpy()
+        np.testing.assert_array_equal(out[0], [2, 1, 0, 3])
+        np.testing.assert_array_equal(out[1], [4, 5, 6, 7])
+        check_grad(lambda a: paddle.sequence_reverse(
+            a, paddle.to_tensor(lens)), [x])
+
+
+class TestSequenceConcatExpandEnumerate:
+    def test_concat(self):
+        l1, l2 = np.array([2, 1], np.int64), np.array([1, 2], np.int64)
+        x1 = np.arange(6, dtype=np.float32).reshape(2, 3)
+        x2 = 10 + np.arange(4, dtype=np.float32).reshape(2, 2)
+        out, lens = paddle.sequence_concat(
+            [paddle.to_tensor(x1), paddle.to_tensor(x2)],
+            [paddle.to_tensor(l1), paddle.to_tensor(l2)])
+        np.testing.assert_array_equal(lens.numpy(), [3, 3])
+        np.testing.assert_allclose(out.numpy()[0], [0, 1, 10])
+        np.testing.assert_allclose(out.numpy()[1], [3, 12, 13])
+
+    def test_expand_as(self):
+        lens = np.array([2, 0, 3], np.int64)
+        x = np.array([[1.0], [2.0], [3.0]], np.float32)
+        out = paddle.sequence_expand_as(paddle.to_tensor(x),
+                                        paddle.to_tensor(lens)).numpy()
+        np.testing.assert_allclose(out[:, 0], [1, 1, 3, 3, 3])
+
+    def test_enumerate(self):
+        ids = np.array([[1, 2, 3, 4]], np.int64)
+        lens = np.array([3], np.int64)
+        out = paddle.sequence_enumerate(paddle.to_tensor(ids), 2,
+                                        pad_value=0,
+                                        lengths=paddle.to_tensor(lens))
+        np.testing.assert_array_equal(
+            out.numpy()[0], [[1, 2], [2, 3], [3, 0], [0, 0]])
+
+
+class TestEmbeddingBag:
+    def test_padded_modes(self):
+        w = RNG.standard_normal((10, 4)).astype(np.float64)
+        ids = np.array([[1, 2, 3], [4, 0, 0]], np.int64)
+        lens = np.array([3, 1], np.int64)
+        for mode, ref in [("sum", w[[1, 2, 3]].sum(0)),
+                          ("mean", w[[1, 2, 3]].mean(0)),
+                          ("max", w[[1, 2, 3]].max(0))]:
+            out = F.embedding_bag(paddle.to_tensor(ids), paddle.to_tensor(w),
+                                  paddle.to_tensor(lens), mode=mode).numpy()
+            np.testing.assert_allclose(out[0], ref, rtol=1e-6)
+        out = F.embedding_bag(paddle.to_tensor(ids), paddle.to_tensor(w),
+                              paddle.to_tensor(lens), mode="sum").numpy()
+        np.testing.assert_allclose(out[1], w[4], rtol=1e-6)
+
+    def test_padding_idx(self):
+        w = RNG.standard_normal((5, 2)).astype(np.float64)
+        ids = np.array([[1, 0, 2]], np.int64)
+        out = F.embedding_bag(paddle.to_tensor(ids), paddle.to_tensor(w),
+                              mode="sum", padding_idx=0).numpy()
+        np.testing.assert_allclose(out[0], w[1] + w[2], rtol=1e-6)
+
+    def test_flat_form(self):
+        w = RNG.standard_normal((10, 4)).astype(np.float64)
+        ids = np.array([1, 2, 3, 4], np.int64)
+        sids = np.array([0, 0, 0, 1], np.int64)
+        out = F.embedding_bag(paddle.to_tensor(ids), paddle.to_tensor(w),
+                              paddle.to_tensor(sids), mode="mean").numpy()
+        np.testing.assert_allclose(out[0], w[[1, 2, 3]].mean(0), rtol=1e-6)
+
+    def test_grad_wrt_weight(self):
+        w = RNG.standard_normal((6, 3)).astype(np.float64)
+        ids = np.array([[1, 2], [3, 3]], np.int64)
+        lens = np.array([2, 2], np.int64)
+        check_grad(lambda wt: F.embedding_bag(
+            paddle.to_tensor(ids), wt, paddle.to_tensor(lens), mode="mean"),
+            [w])
+
+
+class TestVarLenClassifierE2E:
+    """The reference trains an IMDB bow/conv classifier over LoD batches
+    (python/paddle/fluid/tests/book/test_understand_sentiment.py).  Same
+    model shape here — embedding_bag(mean) + fc — trained on synthetic
+    variable-length token sequences (the aclImdb tarball is not available
+    offline; paddle_tpu.text.Imdb loads it when present)."""
+
+    def test_trains(self):
+        import paddle_tpu.nn as nn
+
+        vocab, dim, b, t = 50, 16, 16, 12
+        rng = np.random.default_rng(0)
+
+        class BowClassifier(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(vocab, dim)
+                self.fc = nn.Linear(dim, 2)
+
+            def forward(self, ids, lens):
+                pooled = F.embedding_bag(ids, self.emb.weight, lens,
+                                         mode="mean")
+                return self.fc(pooled)
+
+        model = BowClassifier()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=model.parameters())
+        losses = []
+        for step in range(30):
+            lens = rng.integers(1, t + 1, size=b)
+            # class-0 docs draw tokens from the low half of the vocab
+            labels = rng.integers(0, 2, size=b)
+            ids = np.zeros((b, t), np.int64)
+            for i in range(b):
+                lo, hi = (0, vocab // 2) if labels[i] == 0 else \
+                    (vocab // 2, vocab)
+                ids[i, :lens[i]] = rng.integers(lo, hi, size=lens[i])
+            logits = model(paddle.to_tensor(ids),
+                           paddle.to_tensor(lens.astype(np.int64)))
+            loss = F.cross_entropy(logits, paddle.to_tensor(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.5, losses
