@@ -587,6 +587,28 @@ def _is_tracer(x) -> bool:
 _amp_hook = [None]
 
 
+def _nan_inf_guard(name: str, out):
+    """FLAGS_check_nan_inf watcher (reference:
+    framework/details/nan_inf_utils.h:28 CheckOpHasNanOrInf, called from
+    the executors after every op).  Here it rides the eager tracer entry
+    point instead; tracer (in-jit) values are skipped — the jitted tier is
+    swept per-step by TrainStep."""
+    from paddle_tpu.framework.flags import flag
+    if not flag("check_nan_inf"):
+        return
+    arrs = out if isinstance(out, (tuple, list)) else [out]
+    for i, a in enumerate(arrs):
+        data = a._data if isinstance(a, Tensor) else a
+        if isinstance(data, jax.core.Tracer):
+            continue
+        if hasattr(data, "dtype") and jnp.issubdtype(data.dtype,
+                                                     jnp.inexact):
+            if not bool(jnp.isfinite(data).all()):
+                raise FloatingPointError(
+                    f"Operator {name or 'op'} output {i} contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf is set)")
+
+
 def apply(fn: Callable, *args, name: str = "", nondiff: Sequence[int] = (),
           **kwargs):
     """Run a pure-jax ``fn`` over a mix of Tensors/arrays/python values.
@@ -619,6 +641,7 @@ def apply(fn: Callable, *args, name: str = "", nondiff: Sequence[int] = (),
 
     if not track:
         out = fn(*frozen, **kwargs)
+        _nan_inf_guard(name or getattr(fn, "__name__", "op"), out)
         return _wrap_outputs(out, stop_gradient=True)
 
     grad_arrays = [args[i]._data for i in grad_pos]
@@ -630,6 +653,7 @@ def apply(fn: Callable, *args, name: str = "", nondiff: Sequence[int] = (),
         return fn(*full, **kwargs)
 
     out, vjp_fn = jax.vjp(pure, *grad_arrays)
+    _nan_inf_guard(name or getattr(fn, "__name__", "op"), out)
     outs = _wrap_outputs(out, stop_gradient=False)
     node = TapeNode(vjp_fn, [args[i] for i in grad_pos],
                     [weakref.ref(t) for t in outs], name=name or getattr(

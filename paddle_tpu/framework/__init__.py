@@ -6,6 +6,7 @@ system (replacing gflags + env bootstrap, reference: platform/flags.cc,
 pybind/global_value_getter_setter.cc:330) and serialization.
 """
 from paddle_tpu.framework import flags  # noqa: F401
+from paddle_tpu.framework import monitor  # noqa: F401
 from paddle_tpu.framework.io import save, load  # noqa: F401
 from paddle_tpu.tensor.random import (  # noqa: F401
     seed, get_rng_state, set_rng_state, default_generator, Generator)

@@ -1,0 +1,95 @@
+"""Monitor counters — parity with the reference's StatRegistry
+(paddle/fluid/platform/monitor.h:77, STAT_ADD/STAT_SUB macros at
+monitor.h:135-141 and the python surface in fluid/core stats).
+
+Process-wide named int/float counters that subsystems bump cheaply and
+operators/loggers read for observability (the reference uses them for
+e.g. STAT_gpu_mem, sparse table hit rates).  Thread-safe.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+Number = Union[int, float]
+
+
+class _Stat:
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str, value: Number = 0):
+        self.name = name
+        self.value = value
+        self._lock = threading.Lock()
+
+    def increase(self, v: Number = 1):
+        with self._lock:
+            self.value += v
+
+    def decrease(self, v: Number = 1):
+        with self._lock:
+            self.value -= v
+
+    def reset(self):
+        with self._lock:
+            self.value = 0
+
+
+class StatRegistry:
+    """monitor.h:77 StatRegistry<T>, without the int/float template split —
+    python numbers unify both instantiations."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def get(self, name: str) -> _Stat:
+        with self._lock:
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = _Stat(name)
+            return s
+
+    def stats(self) -> Dict[str, Number]:
+        with self._lock:
+            return {n: s.value for n, s in self._stats.items()}
+
+    def reset_all(self):
+        with self._lock:
+            for s in self._stats.values():
+                s.reset()
+
+
+def stat_add(name: str, value: Number = 1):
+    """STAT_ADD / STAT_INT_ADD / STAT_FLOAT_ADD (monitor.h:135,140)."""
+    StatRegistry.instance().get(name).increase(value)
+
+
+def stat_sub(name: str, value: Number = 1):
+    StatRegistry.instance().get(name).decrease(value)
+
+
+def get_stat(name: str) -> Number:
+    return StatRegistry.instance().get(name).value
+
+
+def reset_stat(name: str):
+    StatRegistry.instance().get(name).reset()
+
+
+def all_stats() -> Dict[str, Number]:
+    return StatRegistry.instance().stats()
+
+
+def reset_all_stats():
+    StatRegistry.instance().reset_all()

@@ -352,8 +352,19 @@ class TrainStep:
             self._cache[sig] = fn
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())
-        new_params, self._opt_states, new_buffers, loss = fn(
-            params, self._opt_states, buffers, key, lr, *arrs)
+        from paddle_tpu.profiler import RecordEvent
+        with RecordEvent("TrainStep"):
+            new_params, self._opt_states, new_buffers, loss = fn(
+                params, self._opt_states, buffers, key, lr, *arrs)
+        from paddle_tpu.framework.flags import flag
+        if flag("check_nan_inf"):
+            # per-step sweep of the jitted tier (the eager per-op guard in
+            # core.apply cannot see inside the fused step) — nan_inf_utils
+            # role at step granularity; one scalar device->host sync.
+            if not bool(jnp.isfinite(loss)):
+                raise FloatingPointError(
+                    "TrainStep produced a non-finite loss "
+                    "(FLAGS_check_nan_inf is set)")
         for n, p in named_params.items():
             p._data = new_params[n]
         for n, b in named_buffers.items():

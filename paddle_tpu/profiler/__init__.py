@@ -1,0 +1,198 @@
+"""paddle_tpu.profiler — host + device profiling.
+
+Parity targets in the reference:
+  * RecordEvent host spans       — platform/profiler.h:127 (RecordEvent),
+    python surface fluid/profiler.py record_event
+  * start/stop/reset_profiler    — fluid/profiler.py:109-253
+  * profiler() context manager   — fluid/profiler.py:255
+  * CUPTI device tracing         — platform/device_tracer.cc:57
+  * chrome-trace timeline        — tools/timeline.py
+
+TPU mapping: device-side tracing is jax.profiler (XLA's profiler — the
+CUPTI analogue), which captures per-op device timelines viewable in
+TensorBoard/Perfetto.  Host spans are RecordEvent context managers that
+both (a) feed an in-process aggregate table (calls/total/min/max/ave —
+the Profiling Report) and (b) emit jax.profiler.TraceAnnotation scopes so
+the same names show up inside the device trace.  ``export_chrome_tracing``
+writes the host spans in chrome://tracing JSON (timeline.py's role).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["RecordEvent", "record_event", "start_profiler", "stop_profiler",
+           "reset_profiler", "profiler", "export_chrome_tracing",
+           "is_profiling"]
+
+_state = {
+    "on": False,
+    "device": False,        # jax.profiler trace running
+    "trace_dir": None,
+}
+_lock = threading.Lock()
+_events: Dict[str, List[float]] = {}          # name -> list of durations (s)
+_spans: List[tuple] = []                      # (name, tid, t0, t1)
+_t_start = [0.0]
+
+
+def is_profiling() -> bool:
+    return _state["on"]
+
+
+class RecordEvent:
+    """Named host span (platform/profiler.h:127).  Usable as a context
+    manager or decorator.  Always emits a jax TraceAnnotation (so names
+    appear in device traces even outside start/stop_profiler); aggregates
+    host wall time only while profiling is on."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if _state["device"] or _state["on"]:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        if _state["on"]:
+            with _lock:
+                _events.setdefault(self.name, []).append(t1 - self._t0)
+                _spans.append((self.name, threading.get_ident(),
+                               self._t0, t1))
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """fluid/profiler.py record_event parity (contextmanager form)."""
+    with RecordEvent(name):
+        yield
+
+
+def reset_profiler():
+    """fluid/profiler.py:109."""
+    with _lock:
+        _events.clear()
+        _spans.clear()
+    _t_start[0] = time.perf_counter()
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   trace_dir: Optional[str] = None):
+    """fluid/profiler.py:131.  state: 'CPU' = host spans only;
+    'GPU'/'TPU'/'All' = also start the XLA device trace (written under
+    ``trace_dir``, default /tmp/paddle_tpu_profile, TensorBoard format)."""
+    if state not in ("CPU", "GPU", "TPU", "All"):
+        raise ValueError("state must be 'CPU', 'GPU', 'TPU' or 'All'")
+    if tracer_option not in ("Default", "OpDetail", "AllOpDetail"):
+        raise ValueError("tracer_option must be 'Default', 'OpDetail' "
+                         "or 'AllOpDetail'")
+    reset_profiler()
+    _state["on"] = True
+    if state != "CPU":
+        import jax
+        d = trace_dir or "/tmp/paddle_tpu_profile"
+        os.makedirs(d, exist_ok=True)
+        try:
+            jax.profiler.start_trace(d)
+            _state["device"] = True
+            _state["trace_dir"] = d
+        except Exception:                      # already tracing, or no device
+            _state["device"] = False
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: str = "/tmp/profile"):
+    """fluid/profiler.py:198 — stop, print the Profiling Report, and (if a
+    device trace was running) finalize it; host spans also go to
+    ``profile_path`` as chrome-trace JSON (timeline.py role)."""
+    if not _state["on"]:
+        return
+    if _state["device"]:
+        import jax
+        jax.profiler.stop_trace()
+        _state["device"] = False
+    _state["on"] = False
+    export_chrome_tracing(profile_path)
+    _print_report(sorted_key)
+
+
+def _print_report(sorted_key):
+    if sorted_key not in (None, "calls", "total", "max", "min", "ave"):
+        raise ValueError("sorted_key must be one of None/'calls'/'total'/"
+                         "'max'/'min'/'ave'")
+    with _lock:
+        rows = []
+        grand = 0.0
+        for name, durs in _events.items():
+            tot = sum(durs)
+            grand += tot
+            rows.append((name, len(durs), tot * 1e3, min(durs) * 1e3,
+                         max(durs) * 1e3, tot / len(durs) * 1e3))
+    keyi = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5}
+    if sorted_key:
+        rows.sort(key=lambda r: r[keyi[sorted_key]], reverse=True)
+    print("------------------------->     Profiling Report     "
+          "<-------------------------\n")
+    print("Place: TPU\nTime unit: ms\nSorted by {} in descending order in "
+          "the same thread\n".format(sorted_key or "first end time"))
+    hdr = f"{'Event':<32}{'Calls':>8}{'Total':>12}{'Min.':>10}" \
+          f"{'Max.':>10}{'Ave.':>10}{'Ratio.':>10}"
+    print(hdr)
+    for name, calls, tot, mn, mx, ave in rows:
+        ratio = tot / (grand * 1e3) if grand else 0.0
+        print(f"{name:<32}{calls:>8}{tot:>12.4f}{mn:>10.4f}{mx:>10.4f}"
+              f"{ave:>10.4f}{ratio:>10.6f}")
+    if _state["trace_dir"]:
+        print(f"\nDevice trace (TensorBoard/XProf): {_state['trace_dir']}")
+
+
+def export_chrome_tracing(path: str = "/tmp/profile"):
+    """Write host RecordEvent spans as chrome://tracing JSON — the
+    tools/timeline.py role (its _chrome_trace_format output)."""
+    with _lock:
+        spans = list(_spans)
+    t0 = _t_start[0]
+    events = [{"name": name, "ph": "X", "pid": 0, "tid": tid,
+               "ts": (a - t0) * 1e6, "dur": (b - a) * 1e6,
+               "cat": "host"} for name, tid, a, b in spans]
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: str = "/tmp/profile",
+             tracer_option: str = "Default"):
+    """fluid/profiler.py:255 context-manager parity."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,126 @@
+"""Observability tier: profiler (fluid/profiler.py + tools/timeline.py
+roles), monitor counters (platform/monitor.h), NaN/Inf watcher
+(framework/details/nan_inf_utils.h via FLAGS_check_nan_inf)."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import monitor
+
+
+class TestMonitor:
+    def test_counters(self):
+        monitor.reset_all_stats()
+        monitor.stat_add("STAT_test_samples", 5)
+        monitor.stat_add("STAT_test_samples", 3)
+        monitor.stat_sub("STAT_test_samples", 2)
+        assert monitor.get_stat("STAT_test_samples") == 6
+        monitor.stat_add("STAT_test_time", 0.5)
+        assert monitor.all_stats()["STAT_test_time"] == 0.5
+        monitor.reset_stat("STAT_test_samples")
+        assert monitor.get_stat("STAT_test_samples") == 0
+
+
+class TestProfiler:
+    def test_record_event_aggregation(self, capsys, tmp_path):
+        prof = paddle.profiler
+        path = str(tmp_path / "chrome_trace.json")
+        prof.start_profiler("CPU")
+        for _ in range(3):
+            with prof.RecordEvent("my_span"):
+                time.sleep(0.002)
+        with prof.record_event("other"):
+            pass
+        prof.stop_profiler(sorted_key="total", profile_path=path)
+        out = capsys.readouterr().out
+        assert "Profiling Report" in out
+        assert "my_span" in out and "other" in out
+        # chrome trace written with one event per span
+        with open(path) as f:
+            trace = json.load(f)
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert names.count("my_span") == 3
+        assert all(e["ph"] == "X" for e in trace["traceEvents"])
+
+    def test_context_manager_and_decorator(self, capsys, tmp_path):
+        prof = paddle.profiler
+
+        @prof.RecordEvent("decorated")
+        def work():
+            return 1 + 1
+
+        with prof.profiler("CPU", "calls",
+                           str(tmp_path / "t.json")):
+            assert work() == 2
+        assert "decorated" in capsys.readouterr().out
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            paddle.profiler.start_profiler("XPU")
+        paddle.profiler.start_profiler("CPU")
+        with pytest.raises(ValueError):
+            paddle.profiler.stop_profiler(sorted_key="bogus")
+        paddle.profiler._state["on"] = False
+
+    def test_trainstep_emits_span(self, tmp_path):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit import TrainStep
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        step = TrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(8, 2).astype("float32"))
+        path = str(tmp_path / "ts.json")
+        paddle.profiler.start_profiler("CPU")
+        step(x, y)
+        paddle.profiler.stop_profiler(profile_path=path)
+        with open(path) as f:
+            names = [e["name"] for e in json.load(f)["traceEvents"]]
+        assert "TrainStep" in names
+
+
+class TestNanInfWatcher:
+    def setup_method(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+
+    def teardown_method(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_eager_op_raises(self):
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError, match="NaN/Inf"):
+            _ = paddle.log(x) / x          # log(0) = -inf
+
+    def test_eager_clean_passes(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        _ = (x * 2 + 1).numpy()
+
+    def test_tracked_op_raises(self):
+        x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        x.stop_gradient = False
+        with pytest.raises(FloatingPointError, match="NaN/Inf"):
+            _ = paddle.log(x)
+
+    def test_trainstep_sweep(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit import TrainStep
+        net = nn.Linear(2, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        step = TrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt)
+        bad = paddle.to_tensor(
+            np.array([[np.inf, 1.0]], np.float32))
+        y = paddle.to_tensor(np.array([[1.0]], np.float32))
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            step(bad, y)
+
+    def test_flag_off_no_raise(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        out = paddle.log(x)
+        assert np.isinf(out.numpy()).all()
