@@ -16,6 +16,7 @@ from paddle_tpu.distributed.tp_layers import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     mark_sharding)
 from paddle_tpu.distributed.spawn import spawn  # noqa: F401
+from paddle_tpu.distributed import checkpoint  # noqa: F401
 
 # shard_tensor-style helper (modern paddle name for sharding annotation)
 shard_tensor = mark_sharding

@@ -4,7 +4,7 @@ Reference: python/paddle/framework/io.py:351 (save), :515 (load) — pickle of
 nested state dicts with a tensor protocol.  Here tensors serialise as numpy
 arrays inside a pickle; ``.pdparams``/``.pdopt`` conventions are preserved so
 reference-style checkpointing code runs unchanged.  Sharded/distributed
-checkpointing lives in paddle_tpu.distributed.checkpoint (orbax-style).
+checkpointing lives in paddle_tpu.distributed.checkpoint (per-shard files).
 """
 from __future__ import annotations
 
