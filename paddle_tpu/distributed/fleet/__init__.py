@@ -118,22 +118,69 @@ def barrier_worker():
     _state.role_maker.barrier_worker()
 
 
-# PS lifecycle stubs (collective mode needs none of these; the PS-capability
-# path lives in paddle_tpu.distributed.ps)
+# PS lifecycle (fleet_base.py init_worker/init_server/run_server/stop_worker
+# → brpc service in the reference; → paddle_tpu.distributed.ps.service here).
+# Collective mode needs none of these.
 def init_worker():
-    pass
+    """Connect this trainer to the PS shards named in
+    PADDLE_PSERVERS_IP_PORT_LIST and start heartbeating.  The PsClient is
+    exposed as fleet.ps_client(); build RemoteEmbeddingTable on top."""
+    _require_init()
+    from paddle_tpu.distributed.ps.service import PsClient
+    eps = _state.role_maker.get_pserver_endpoints()
+    if not eps:
+        raise RuntimeError("init_worker: PADDLE_PSERVERS_IP_PORT_LIST empty")
+    _state.ps_client = PsClient(
+        eps, worker_id=f"trainer-{_state.role_maker.worker_index()}")
+    _state.ps_client.start_heartbeat()
 
 
-def init_server(*args, **kwargs):
-    pass
+def ps_client():
+    _require_init()
+    c = getattr(_state, "ps_client", None)
+    if c is None:
+        raise RuntimeError("call fleet.init_worker() first")
+    return c
+
+
+def init_server(tables=None, **kwargs):
+    """Build this rank's PS shard.  ``tables``: {name: HostEmbeddingTable}
+    or {name: (rows, dim[, optimizer, lr])} specs."""
+    _require_init()
+    from paddle_tpu.distributed.ps import HostEmbeddingTable
+    from paddle_tpu.distributed.ps.service import PsServer
+    built = {}
+    for name, t in (tables or {}).items():
+        if isinstance(t, HostEmbeddingTable):
+            built[name] = t
+        else:
+            built[name] = HostEmbeddingTable(*t)
+    eps = _state.role_maker.get_pserver_endpoints()
+    idx = _state.role_maker.server_index() if hasattr(
+        _state.role_maker, "server_index") else 0
+    host, port = (eps[idx].rsplit(":", 1) if eps else ("127.0.0.1", "0"))
+    _state.ps_server = PsServer(
+        built, host=host, port=int(port),
+        n_workers=_state.role_maker.worker_num(), **kwargs)
+    return _state.ps_server
 
 
 def run_server():
-    pass
+    """Blocking serve loop (fleet_base.py run_server); returns when all
+    workers have said bye (n_workers) or shutdown is requested."""
+    _require_init()
+    srv = getattr(_state, "ps_server", None)
+    if srv is None:
+        raise RuntimeError("call fleet.init_server() first")
+    srv.serve_forever()
 
 
 def stop_worker():
-    pass
+    _require_init()
+    c = getattr(_state, "ps_client", None)
+    if c is not None:
+        c.bye()
+        _state.ps_client = None
 
 
 class DistributedOptimizer:

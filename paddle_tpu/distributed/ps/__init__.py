@@ -199,10 +199,14 @@ class DistributedEmbedding(Layer):
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
                  optimizer: str = "adagrad", learning_rate: float = 0.05,
-                 mode: str = "sync", k_steps: int = 4, seed: int = 0):
+                 mode: str = "sync", k_steps: int = 4, seed: int = 0,
+                 table=None):
         super().__init__()
-        self.table = HostEmbeddingTable(num_embeddings, embedding_dim,
-                                        optimizer, learning_rate, seed=seed)
+        # ``table`` may be a RemoteEmbeddingTable (ps.service) — then pulls
+        # and pushes travel the multi-host PS transport instead of local RAM
+        self.table = table if table is not None else HostEmbeddingTable(
+            num_embeddings, embedding_dim, optimizer, learning_rate,
+            seed=seed)
         self.communicator = AsyncCommunicator(self.table, mode=mode,
                                               k_steps=k_steps)
         self._embedding_dim = embedding_dim

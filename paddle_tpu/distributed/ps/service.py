@@ -1,0 +1,437 @@
+"""Multi-host parameter-server transport.
+
+Reference: the brpc PS generation —
+  * paddle/fluid/distributed/service/brpc_ps_server.cc (RPC server:
+    pull_sparse/push_sparse/save/load/stop handlers)
+  * service/brpc_ps_client.cc (row→shard routing, request fan-out)
+  * service/communicator.cc (client-side batching; the in-process
+    AsyncCommunicator here plugs straight on top of RemoteEmbeddingTable)
+  * operators/distributed/heart_beat_monitor.cc (worker liveness)
+
+TPU-native scope: the *dense* path needs no PS at all (XLA collectives
+over ICI/DCN own it), so this service carries only the host-tier sparse
+tables (HostEmbeddingTable) that exceed HBM.  Transport is a
+length-prefixed binary protocol over TCP — a JSON header plus raw
+numpy buffers; no pickle on the wire, so a malicious peer can at worst
+corrupt table values, not execute code.  Rows are sharded over servers
+by ``id % n_servers`` (brpc_ps_client.cc's key-mod routing).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.distributed.ps import HostEmbeddingTable
+
+__all__ = ["PsServer", "PsClient", "RemoteEmbeddingTable",
+           "HeartBeatMonitor", "serve"]
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+def _recvall(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _send_msg(sock: socket.socket, header: dict,
+              bufs: Sequence[np.ndarray] = ()):
+    meta = dict(header)
+    meta["__bufs__"] = [{"shape": list(b.shape), "dtype": str(b.dtype)}
+                        for b in bufs]
+    hb = json.dumps(meta).encode()
+    out = [struct.pack("<I", len(hb)), hb]
+    for b in bufs:
+        data = np.ascontiguousarray(b).tobytes()
+        out.append(struct.pack("<Q", len(data)))
+        out.append(data)
+    sock.sendall(b"".join(out))
+
+
+def _recv_msg(sock: socket.socket):
+    (hlen,) = struct.unpack("<I", _recvall(sock, 4))
+    header = json.loads(_recvall(sock, hlen))
+    bufs = []
+    for spec in header.pop("__bufs__", []):
+        (blen,) = struct.unpack("<Q", _recvall(sock, 8))
+        raw = _recvall(sock, blen)
+        bufs.append(np.frombuffer(raw, dtype=np.dtype(spec["dtype"]))
+                    .reshape(spec["shape"]).copy())
+    return header, bufs
+
+
+# ---------------------------------------------------------------------------
+# heartbeat (heart_beat_monitor.cc)
+# ---------------------------------------------------------------------------
+
+class HeartBeatMonitor:
+    """Tracks last-beat time per worker; a worker silent for longer than
+    ``timeout`` is reported dead (heart_beat_monitor.cc:56 LostWorkerMonitor
+    loop, with the thread made optional)."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+        self._beats: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.on_dead = None            # callback(worker_id)
+        self._reported: set = set()
+
+    def beat(self, worker: str):
+        with self._lock:
+            self._beats[worker] = time.monotonic()
+            self._reported.discard(worker)
+
+    def workers(self) -> Dict[str, float]:
+        now = time.monotonic()
+        with self._lock:
+            return {w: now - t for w, t in self._beats.items()}
+
+    def dead_workers(self) -> List[str]:
+        return [w for w, age in self.workers().items()
+                if age > self.timeout]
+
+    def _loop(self, interval: float):
+        while not self._stop.wait(interval):
+            for w in self.dead_workers():
+                if w not in self._reported:
+                    self._reported.add(w)
+                    if self.on_dead is not None:
+                        self.on_dead(w)
+
+    def start(self, interval: float = 1.0):
+        self._thread = threading.Thread(target=self._loop, args=(interval,),
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: "PsServer" = self.server.ps          # type: ignore
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                header, bufs = _recv_msg(sock)
+            except (ConnectionError, OSError):
+                return
+            try:
+                reply, rbufs = srv._dispatch(header, bufs)
+            except Exception as e:                # noqa: BLE001
+                reply, rbufs = {"ok": False, "error": repr(e)}, []
+            try:
+                _send_msg(sock, reply, rbufs)
+            except OSError:
+                return
+            if header.get("op") in ("bye", "shutdown"):
+                return
+
+
+class _TcpServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PsServer:
+    """One PS shard: serves pull/push/heartbeat/state for its tables
+    (brpc_ps_server.cc handler table, minus the brpc dependency)."""
+
+    def __init__(self, tables: Dict[str, HostEmbeddingTable],
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout: float = 30.0,
+                 n_workers: Optional[int] = None):
+        self.tables = tables
+        self.monitor = HeartBeatMonitor(heartbeat_timeout)
+        self.n_workers = n_workers
+        self._bye_count = 0
+        self._lock = threading.Lock()
+        self._tcp = _TcpServer((host, port), _Handler)
+        self._tcp.ps = self                        # type: ignore
+        self.host, self.port = self._tcp.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request dispatch ---------------------------------------------------
+    def _dispatch(self, header: dict, bufs):
+        op = header.get("op")
+        if op == "pull":
+            t = self.tables[header["table"]]
+            return {"ok": True}, [t.pull(bufs[0].astype(np.int64))]
+        if op == "push":
+            t = self.tables[header["table"]]
+            t.push(bufs[0].astype(np.int64), bufs[1].astype(np.float32),
+                   lr=header.get("lr"))
+            return {"ok": True}, []
+        if op == "heartbeat":
+            self.monitor.beat(header["worker"])
+            return {"ok": True, "time": time.time()}, []
+        if op == "state":
+            t = self.tables[header["table"]]
+            d = t.state_dict()
+            arrs = [np.asarray(d["table"])]
+            has_g2 = "g2" in d
+            if has_g2:
+                arrs.append(np.asarray(d["g2"]))
+            return {"ok": True, "optimizer": d["optimizer"],
+                    "has_g2": has_g2}, arrs
+        if op == "load_state":
+            t = self.tables[header["table"]]
+            d = {"table": bufs[0], "optimizer": header["optimizer"]}
+            if header.get("has_g2"):
+                d["g2"] = bufs[1]
+            t.set_state_dict(d)
+            return {"ok": True}, []
+        if op == "stat":
+            return {"ok": True,
+                    "tables": {n: {"rows": t.num_embeddings,
+                                   "dim": t.embedding_dim}
+                               for n, t in self.tables.items()},
+                    "workers": self.monitor.workers(),
+                    "dead": self.monitor.dead_workers()}, []
+        if op == "bye":
+            done = False
+            with self._lock:
+                self._bye_count += 1
+                if self.n_workers and self._bye_count >= self.n_workers:
+                    done = True
+            if done:
+                threading.Thread(target=self.shutdown, daemon=True).start()
+            return {"ok": True, "remaining":
+                    (self.n_workers - self._bye_count)
+                    if self.n_workers else -1}, []
+        if op == "shutdown":
+            threading.Thread(target=self.shutdown, daemon=True).start()
+            return {"ok": True}, []
+        return {"ok": False, "error": f"unknown op {op!r}"}, []
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        """Serve on a background thread (fleet.run_server uses the blocking
+        form)."""
+        self.monitor.start()
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self.monitor.start()
+        self._tcp.serve_forever()
+
+    def shutdown(self):
+        self.monitor.stop()
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class _Conn:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=30)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.lock = threading.Lock()
+
+    def rpc(self, header: dict, bufs=()):
+        with self.lock:
+            _send_msg(self.sock, header, bufs)
+            reply, rbufs = _recv_msg(self.sock)
+        if not reply.get("ok", False):
+            raise RuntimeError(f"ps rpc {header.get('op')} failed: "
+                               f"{reply.get('error')}")
+        return reply, rbufs
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PsClient:
+    """Routes rows to shards by ``id % n_servers`` and fans requests out in
+    parallel (brpc_ps_client.cc pull_sparse semantics)."""
+
+    def __init__(self, endpoints: Sequence[str],
+                 worker_id: Optional[str] = None):
+        self.endpoints = list(endpoints)
+        self._conns = [_Conn(ep) for ep in self.endpoints]
+        self._pool = ThreadPoolExecutor(max_workers=max(
+            2, len(self.endpoints)))
+        self.worker_id = worker_id or f"worker-{os.getpid()}"
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    @property
+    def n(self):
+        return len(self._conns)
+
+    # -- sparse ops ---------------------------------------------------------
+    def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        flat = ids.reshape(-1)
+        owner = flat % self.n
+
+        def one(s):
+            mask = owner == s
+            if not mask.any():
+                return s, mask, None
+            _, rows = self._conns[s].rpc(
+                {"op": "pull", "table": table}, [flat[mask]])
+            return s, mask, rows[0]
+
+        first_dim = None
+        parts = list(self._pool.map(one, range(self.n)))
+        for _, _, rows in parts:
+            if rows is not None:
+                first_dim = rows.shape[1]
+                break
+        if first_dim is None:      # empty batch: ask a server for the dim
+            first_dim = self.stat()["tables"][table]["dim"]
+        out = np.empty((flat.size, first_dim), np.float32)
+        for _, mask, rows in parts:
+            if rows is not None:
+                out[mask] = rows
+        return out.reshape(ids.shape + (first_dim,))
+
+    def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None):
+        ids = np.asarray(ids, np.int64)
+        flat = ids.reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, -1)
+        owner = flat % self.n
+
+        def one(s):
+            mask = owner == s
+            if mask.any():
+                self._conns[s].rpc({"op": "push", "table": table,
+                                    "lr": lr}, [flat[mask], g[mask]])
+
+        list(self._pool.map(one, range(self.n)))
+
+    # -- liveness -----------------------------------------------------------
+    def heartbeat(self):
+        for c in self._conns:
+            c.rpc({"op": "heartbeat", "worker": self.worker_id})
+
+    def start_heartbeat(self, interval: float = 5.0):
+        def loop():
+            while not self._hb_stop.wait(interval):
+                try:
+                    self.heartbeat()
+                except (RuntimeError, OSError):
+                    pass
+        self.heartbeat()
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    # -- admin --------------------------------------------------------------
+    def stat(self, server: int = 0):
+        reply, _ = self._conns[server].rpc({"op": "stat"})
+        return reply
+
+    def bye(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+        for c in self._conns:
+            try:
+                c.rpc({"op": "bye", "worker": self.worker_id})
+            except (RuntimeError, OSError, ConnectionError):
+                pass
+            c.close()
+
+    def shutdown_servers(self):
+        for c in self._conns:
+            try:
+                c.rpc({"op": "shutdown"})
+            except (RuntimeError, OSError, ConnectionError):
+                pass
+
+
+class RemoteEmbeddingTable:
+    """pull/push-compatible stand-in for HostEmbeddingTable backed by a
+    PsClient — DistributedEmbedding/AsyncCommunicator work unchanged on
+    top (the lookup-table-op → pserver path of the reference)."""
+
+    def __init__(self, client: PsClient, table: str, embedding_dim: int):
+        self.client = client
+        self.table = table
+        self.embedding_dim = embedding_dim
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        return self.client.pull(self.table, ids)
+
+    def push(self, ids: np.ndarray, grads: np.ndarray,
+             lr: Optional[float] = None):
+        self.client.push(self.table, ids, grads, lr=lr)
+
+
+# ---------------------------------------------------------------------------
+# standalone entry (the role of the PS binary fleet.run_server launches)
+# ---------------------------------------------------------------------------
+
+def serve(port: int, table_specs: Sequence[str], host: str = "127.0.0.1",
+          n_workers: Optional[int] = None, heartbeat_timeout: float = 30.0,
+          announce=print):
+    """table spec: name:rows:dim[:optimizer[:lr]]"""
+    tables = {}
+    for spec in table_specs:
+        parts = spec.split(":")
+        name, rows, dim = parts[0], int(parts[1]), int(parts[2])
+        optim = parts[3] if len(parts) > 3 else "adagrad"
+        lr = float(parts[4]) if len(parts) > 4 else 0.05
+        tables[name] = HostEmbeddingTable(rows, dim, optim, lr)
+    srv = PsServer(tables, host=host, port=port,
+                   heartbeat_timeout=heartbeat_timeout, n_workers=n_workers)
+    announce(f"PS_READY {srv.host}:{srv.port}", flush=True)
+    srv.serve_forever()
+
+
+def _main():
+    ap = argparse.ArgumentParser(description="paddle_tpu PS shard server")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--table", action="append", required=True,
+                    help="name:rows:dim[:optimizer[:lr]]")
+    ap.add_argument("--n-workers", type=int, default=None,
+                    help="shut down after this many workers say bye")
+    ap.add_argument("--heartbeat-timeout", type=float, default=30.0)
+    a = ap.parse_args()
+    serve(a.port, a.table, a.host, a.n_workers, a.heartbeat_timeout)
+
+
+if __name__ == "__main__":
+    _main()

@@ -1,0 +1,277 @@
+"""Multi-host PS transport tier (brpc_ps_server/client + communicator +
+heart_beat_monitor roles): wire protocol, id%n shard routing, heartbeat
+liveness, fleet lifecycle, and a true 2-process server/trainer run trained
+to parity with the in-process table."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer
+from paddle_tpu.distributed.ps import (AsyncCommunicator,
+                                       DistributedEmbedding,
+                                       HostEmbeddingTable)
+from paddle_tpu.distributed.ps.service import (HeartBeatMonitor, PsClient,
+                                               PsServer,
+                                               RemoteEmbeddingTable)
+
+
+def _server(tables, **kw):
+    srv = PsServer(tables, port=0, **kw)
+    srv.start()
+    return srv
+
+
+class TestProtocolAndRouting:
+    def test_pull_push_single_shard(self):
+        t = HostEmbeddingTable(10, 4, optimizer="sgd", learning_rate=1.0)
+        srv = _server({"emb": t})
+        try:
+            c = PsClient([f"127.0.0.1:{srv.port}"])
+            ids = np.array([[1, 2], [3, 1]])
+            rows = c.pull("emb", ids)
+            np.testing.assert_allclose(rows, t._table[ids], rtol=1e-6)
+            g = np.ones(ids.shape + (4,), np.float32)
+            before = t._table.copy()
+            c.push("emb", ids, g)
+            # id 1 appears twice → accumulated
+            np.testing.assert_allclose(t._table[1], before[1] - 2.0,
+                                       rtol=1e-6)
+            np.testing.assert_allclose(t._table[2], before[2] - 1.0,
+                                       rtol=1e-6)
+            c.bye()
+        finally:
+            srv.shutdown()
+
+    def test_mod_sharding_two_servers(self):
+        """Rows route to server id%2; each server's table only sees its
+        own ids, and pulls reassemble in the right order."""
+        t0 = HostEmbeddingTable(10, 3, optimizer="sgd", seed=1)
+        t1 = HostEmbeddingTable(10, 3, optimizer="sgd", seed=2)
+        s0, s1 = _server({"emb": t0}), _server({"emb": t1})
+        try:
+            c = PsClient([f"127.0.0.1:{s0.port}", f"127.0.0.1:{s1.port}"])
+            ids = np.array([0, 1, 2, 3, 7])
+            rows = c.pull("emb", ids)
+            for i, idx in enumerate(ids):
+                src = t0 if idx % 2 == 0 else t1
+                np.testing.assert_allclose(rows[i], src._table[idx],
+                                           rtol=1e-6)
+            g = np.ones((5, 3), np.float32)
+            b0, b1 = t0._table.copy(), t1._table.copy()
+            c.push("emb", ids, g, lr=1.0)
+            assert not np.allclose(t0._table[[0, 2]], b0[[0, 2]])
+            assert np.allclose(t0._table[[1, 3, 7]], b0[[1, 3, 7]])
+            assert not np.allclose(t1._table[[1, 3, 7]], b1[[1, 3, 7]])
+            c.bye()
+        finally:
+            s0.shutdown()
+            s1.shutdown()
+
+    def test_empty_batch_pull(self):
+        srv = _server({"emb": HostEmbeddingTable(4, 5)})
+        try:
+            c = PsClient([f"127.0.0.1:{srv.port}"])
+            rows = c.pull("emb", np.zeros((0,), np.int64))
+            assert rows.shape == (0, 5)
+            c.bye()
+        finally:
+            srv.shutdown()
+
+    def test_bad_op_reports_error(self):
+        srv = _server({"emb": HostEmbeddingTable(4, 2)})
+        try:
+            c = PsClient([f"127.0.0.1:{srv.port}"])
+            with pytest.raises(RuntimeError, match="pull"):
+                c.pull("nope", np.array([1]))
+        finally:
+            srv.shutdown()
+
+    def test_state_roundtrip_over_wire(self):
+        t = HostEmbeddingTable(6, 2)
+        srv = _server({"emb": t})
+        try:
+            c = PsClient([f"127.0.0.1:{srv.port}"])
+            c.push("emb", np.arange(6), np.ones((6, 2), np.float32))
+            reply, bufs = c._conns[0].rpc({"op": "state", "table": "emb"})
+            assert reply["optimizer"] == "adagrad" and reply["has_g2"]
+            t2 = HostEmbeddingTable(6, 2, seed=9)
+            srv.tables["emb2"] = t2
+            c._conns[0].rpc({"op": "load_state", "table": "emb2",
+                             "optimizer": "adagrad", "has_g2": True}, bufs)
+            np.testing.assert_allclose(t2._table, t._table, rtol=1e-6)
+        finally:
+            srv.shutdown()
+
+
+class TestHeartbeat:
+    def test_beat_and_dead_detection(self):
+        mon = HeartBeatMonitor(timeout=0.1)
+        mon.beat("w0")
+        assert mon.dead_workers() == []
+        time.sleep(0.15)
+        assert mon.dead_workers() == ["w0"]
+        mon.beat("w0")                     # revival clears it
+        assert mon.dead_workers() == []
+
+    def test_on_dead_callback(self):
+        mon = HeartBeatMonitor(timeout=0.05)
+        died = []
+        mon.on_dead = died.append
+        mon.start(interval=0.02)
+        mon.beat("w1")
+        time.sleep(0.2)
+        mon.stop()
+        assert died == ["w1"]
+
+    def test_server_stat_sees_workers(self):
+        srv = _server({"emb": HostEmbeddingTable(4, 2)})
+        try:
+            c = PsClient([f"127.0.0.1:{srv.port}"], worker_id="trainer-7")
+            c.heartbeat()
+            stat = c.stat()
+            assert "trainer-7" in stat["workers"]
+            assert stat["tables"]["emb"] == {"rows": 4, "dim": 2}
+            c.bye()
+        finally:
+            srv.shutdown()
+
+
+class TestRemoteEmbeddingParity:
+    def test_remote_matches_local_training(self):
+        """Same seed, same data: training through the TCP transport must
+        produce the exact trajectory of the in-process table."""
+        paddle.seed(0)
+        local = DistributedEmbedding(20, 4, optimizer="sgd",
+                                     learning_rate=0.5, seed=0)
+        head_l = nn.Linear(4, 1)
+        opt_l = optimizer.SGD(learning_rate=0.5,
+                              parameters=head_l.parameters())
+
+        srv = _server({"emb": HostEmbeddingTable(
+            20, 4, optimizer="sgd", learning_rate=0.5, seed=0)})
+        try:
+            client = PsClient([f"127.0.0.1:{srv.port}"])
+            paddle.seed(0)
+            remote = DistributedEmbedding(
+                20, 4, table=RemoteEmbeddingTable(client, "emb", 4))
+            head_r = nn.Linear(4, 1)
+            opt_r = optimizer.SGD(learning_rate=0.5,
+                                  parameters=head_r.parameters())
+
+            ids = np.asarray([[1], [2], [3], [4]])
+            target = paddle.to_tensor(
+                np.asarray([[1.0], [-1.0], [1.0], [-1.0]], np.float32))
+            for emb, head, opt in ((local, head_l, opt_l),
+                                   (remote, head_r, opt_r)):
+                losses = []
+                for _ in range(15):
+                    rows = emb(paddle.to_tensor(ids))
+                    out = head(paddle.reshape(rows, [4, 4]))
+                    loss = ((out - target) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    losses.append(float(loss))
+                if emb is local:
+                    ref = losses
+            np.testing.assert_allclose(losses, ref, rtol=1e-5)
+            client.bye()
+        finally:
+            srv.shutdown()
+
+
+class TestFleetLifecycle:
+    def test_init_worker_stop_worker(self, monkeypatch):
+        from paddle_tpu.distributed import fleet
+        srv = _server({"emb": HostEmbeddingTable(8, 2)})
+        try:
+            monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                               f"127.0.0.1:{srv.port}")
+            monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+            fleet.init()
+            fleet.init_worker()
+            rows = fleet.ps_client().pull("emb", np.array([1, 2]))
+            assert rows.shape == (2, 2)
+            fleet.stop_worker()
+        finally:
+            srv.shutdown()
+
+    def test_server_exits_after_all_byes(self):
+        srv = PsServer({"emb": HostEmbeddingTable(4, 2)}, port=0,
+                       n_workers=2)
+        srv.start()
+        c1 = PsClient([f"127.0.0.1:{srv.port}"], worker_id="w1")
+        c2 = PsClient([f"127.0.0.1:{srv.port}"], worker_id="w2")
+        c1.bye()
+        assert srv._tcp.fileno() != -1     # still up after 1/2 byes
+        c2.bye()
+        deadline = time.monotonic() + 5
+        while srv._tcp.fileno() != -1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert srv._tcp.fileno() == -1     # closed after 2/2
+
+
+class TestTwoProcess:
+    def test_subprocess_server_trains_wide_deep(self, tmp_path):
+        """VERDICT's 2-process bar: a real PS server process + this trainer
+        process, Wide&Deep-style sparse+dense model, loss parity with the
+        in-process table run."""
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)     # server needs no accelerator
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.ps.service",
+             "--port", "0", "--table", "emb:50:4:sgd:0.5",
+             "--n-workers", "1"],
+            stdout=subprocess.PIPE, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        try:
+            line = proc.stdout.readline().strip()
+            assert line.startswith("PS_READY"), line
+            endpoint = line.split()[1]
+
+            def run(emb_factory):
+                paddle.seed(0)
+                emb = emb_factory()
+                head = nn.Linear(4 * 2 + 2, 1)   # 2 sparse fields + dense
+                opt = optimizer.SGD(learning_rate=0.2,
+                                    parameters=head.parameters())
+                rng = np.random.default_rng(5)
+                ids = rng.integers(0, 50, size=(30, 8, 2))
+                dense = rng.standard_normal((30, 8, 2)).astype(np.float32)
+                w = rng.standard_normal((50,)).astype(np.float32)
+                losses = []
+                for step in range(30):
+                    rows = emb(paddle.to_tensor(ids[step]))   # (8,2,4)
+                    feat = paddle.concat(
+                        [paddle.reshape(rows, [8, 8]),
+                         paddle.to_tensor(dense[step])], axis=1)
+                    out = head(feat)
+                    y = paddle.to_tensor(
+                        w[ids[step]].sum(axis=1, keepdims=True))
+                    loss = ((out - y) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    losses.append(float(loss))
+                return losses
+
+            client = PsClient([endpoint], worker_id="trainer-0")
+            remote_losses = run(lambda: DistributedEmbedding(
+                50, 4, table=RemoteEmbeddingTable(client, "emb", 4)))
+            local_losses = run(lambda: DistributedEmbedding(
+                50, 4, optimizer="sgd", learning_rate=0.5, seed=0))
+            np.testing.assert_allclose(remote_losses, local_losses,
+                                       rtol=1e-5)
+            assert remote_losses[-1] < remote_losses[0] * 0.5
+            client.bye()                    # n_workers=1 → server exits
+            assert proc.wait(timeout=10) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
