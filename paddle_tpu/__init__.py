@@ -57,6 +57,7 @@ from paddle_tpu import jit  # noqa: E402,F401
 from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import parallel  # noqa: E402,F401
 from paddle_tpu import distributed  # noqa: E402,F401
+from paddle_tpu import distribution  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401
 from paddle_tpu import text  # noqa: E402,F401
