@@ -16,6 +16,12 @@ import os as _os
 import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
+# DataLoader worker processes (io._iter_multiprocess) must never grab the
+# accelerator out from under the parent — they only run dataset/collate
+# python code.  The parent sets this env before spawning.
+if _os.environ.get("PADDLE_TPU_WORKER"):
+    _jax.config.update("jax_platforms", "cpu")
+
 from paddle_tpu.core import (  # noqa: F401,E402
     Tensor, Parameter, CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace,
     XPUPlace, set_device, get_device, device_count, no_grad, enable_grad,

@@ -24,7 +24,15 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info"]
+           "get_worker_info", "MultiSlotDataFeed"]
+
+
+def __getattr__(name):
+    # lazy: the native engine compiles its .so on first touch
+    if name == "MultiSlotDataFeed":
+        from paddle_tpu.ops.native import MultiSlotDataFeed
+        return MultiSlotDataFeed
+    raise AttributeError(name)
 
 
 class Dataset:
@@ -271,11 +279,17 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, use_shared_memory=True,
                  prefetch_factor=2, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        # use_process_workers=True → real OS worker processes (the
+        # reference's _DataLoaderIterMultiProcess); False keeps the thread
+        # pool, which is faster to start and fine for numpy-bound datasets
+        self.use_process_workers = use_process_workers
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self.is_iterable = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -315,6 +329,9 @@ class DataLoader:
         if self.num_workers == 0:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
+            return
+        if self.use_process_workers:
+            yield from self._iter_multiprocess()
             return
         # threaded prefetch pipeline with backpressure: in-order tickets, a
         # bounded buffer (prefetch_factor × num_workers), early-exit support
@@ -372,3 +389,73 @@ class DataLoader:
             stop.set()
             with cond:
                 cond.notify_all()
+
+    def _iter_multiprocess(self):
+        """Real worker processes (dataloader_iter.py
+        _DataLoaderIterMultiProcess): spawn children, feed index batches,
+        reorder results, collate in the parent (see io/_worker.py)."""
+        import multiprocessing as mp
+        import os
+
+        from paddle_tpu.io._worker import ExceptionWrapper, worker_loop
+
+        ctx = mp.get_context("spawn")
+        os.environ["PADDLE_TPU_WORKER"] = "1"   # children must not take the chip
+        try:
+            index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+            result_queue = ctx.Queue()
+            procs = [
+                ctx.Process(
+                    target=worker_loop,
+                    args=(self.dataset, index_queues[w], result_queue,
+                          self.worker_init_fn, w),
+                    daemon=True)
+                for w in range(self.num_workers)]
+            for p in procs:
+                p.start()
+        finally:
+            os.environ.pop("PADDLE_TPU_WORKER", None)
+
+        capacity = self.prefetch_factor * self.num_workers
+        batches = list(self.batch_sampler)
+        n = len(batches)
+        sent = 0
+        pending: dict = {}
+        timeout = self.timeout or None
+        try:
+            while sent < min(capacity, n):
+                index_queues[sent % self.num_workers].put(
+                    (sent, batches[sent]))
+                sent += 1
+            for i in range(n):
+                while i not in pending:
+                    if not any(p.is_alive() for p in procs) and \
+                            result_queue.empty():
+                        raise RuntimeError("DataLoader workers died")
+                    try:
+                        ticket, data = result_queue.get(timeout=timeout
+                                                        or 5.0)
+                    except _queue.Empty:
+                        if timeout:
+                            raise RuntimeError(
+                                f"DataLoader timed out after {timeout}s")
+                        continue
+                    pending[ticket] = data
+                data = pending.pop(i)
+                if sent < n:
+                    index_queues[sent % self.num_workers].put(
+                        (sent, batches[sent]))
+                    sent += 1
+                if isinstance(data, ExceptionWrapper):
+                    data.reraise()
+                yield self.collate_fn(data)
+        finally:
+            for q in index_queues:
+                try:
+                    q.put(None)
+                except (OSError, ValueError):
+                    pass
+            for p in procs:
+                p.join(timeout=2.0)
+                if p.is_alive():
+                    p.terminate()

@@ -1,0 +1,42 @@
+"""DataLoader worker-process loop.
+
+Reference: python/paddle/fluid/dataloader/dataloader_iter.py
+(_DataLoaderIterMultiProcess worker target at _worker_loop) — real OS
+processes so python-heavy datasets/transforms escape the GIL, unlike the
+thread pool used for numpy-releasing workloads.
+
+Workers run *dataset indexing only* and ship raw (numpy/python) samples
+back; collation to device tensors happens in the parent, keeping jax
+arrays off the pickle path.  Children are spawned with PADDLE_TPU_WORKER=1
+so paddle_tpu forces the cpu platform and never contends for the chip.
+"""
+from __future__ import annotations
+
+import traceback
+
+
+class ExceptionWrapper:
+    def __init__(self, exc: BaseException):
+        self.msg = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+        self.type_name = type(exc).__name__
+
+    def reraise(self):
+        raise RuntimeError(
+            f"DataLoader worker raised {self.type_name}:\n{self.msg}")
+
+
+def worker_loop(dataset, index_queue, result_queue, worker_init_fn,
+                worker_id: int):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:                      # poison pill
+            return
+        ticket, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            result_queue.put((ticket, samples))
+        except Exception as e:                # noqa: BLE001
+            result_queue.put((ticket, ExceptionWrapper(e)))

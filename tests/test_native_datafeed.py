@@ -1,0 +1,189 @@
+"""Native C++ datafeed engine (framework/data_feed.cc MultiSlotDataFeed
+role) + multiprocess DataLoader workers (dataloader_iter.py
+_DataLoaderIterMultiProcess role)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.ops.native import MultiSlotDataFeed, native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="g++ unavailable")
+
+
+def _write_multislot(path, n, seed=0):
+    """<count> v... per slot: dense(2), sparse ids, label(1)."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    with open(path, "w") as f:
+        for i in range(n):
+            dense = rng.standard_normal(2).round(3)
+            k = int(rng.integers(1, 5))
+            ids = rng.integers(0, 100, size=k)
+            label = i % 2
+            f.write(f"2 {dense[0]} {dense[1]} {k} "
+                    + " ".join(map(str, ids)) + f" 1 {label}\n")
+            rows.append((dense, ids, label))
+    return rows
+
+
+SLOTS = [("dense", "f", 2), ("ids", "u", 0), ("label", "f", 1)]
+
+
+class TestMultiSlotDataFeed:
+    def test_values_roundtrip(self, tmp_path):
+        p = str(tmp_path / "part-0")
+        rows = _write_multislot(p, 7)
+        feed = MultiSlotDataFeed(SLOTS, batch_size=3, files=[p],
+                                 nthreads=1)
+        got_dense, got_ids, got_label = [], [], []
+        for b in feed:
+            got_dense.append(b["dense"])
+            ids, lens = b["ids"]
+            off = 0
+            for L in lens:
+                got_ids.append(ids[off:off + L])
+                off += L
+            got_label.append(b["label"])
+        dense = np.concatenate(got_dense)
+        label = np.concatenate(got_label)[:, 0]
+        assert dense.shape == (7, 2)
+        # single thread → file order preserved
+        for i, (d, ids, lab) in enumerate(rows):
+            np.testing.assert_allclose(dense[i], d, atol=1e-3)
+            np.testing.assert_array_equal(got_ids[i], ids)
+            assert label[i] == lab
+
+    def test_multifile_multithread_totals(self, tmp_path):
+        paths = []
+        total = 0
+        for j in range(4):
+            p = str(tmp_path / f"part-{j}")
+            _write_multislot(p, 13 + j, seed=j)
+            total += 13 + j
+            paths.append(p)
+        feed = MultiSlotDataFeed(SLOTS, batch_size=8, files=paths,
+                                 nthreads=3)
+        rows = 0
+        for b in feed:
+            B = b["dense"].shape[0]
+            ids, lens = b["ids"]
+            assert lens.shape[0] == B and ids.shape[0] == lens.sum()
+            assert b["label"].shape == (B, 1)
+            rows += B
+        assert rows == total
+
+    def test_bad_record_raises(self, tmp_path):
+        p = str(tmp_path / "bad")
+        with open(p, "w") as f:
+            f.write("2 1.0 2.0 1 5 1 0\n")
+            f.write("9 1.0\n")               # claims 9 dense, has 1
+        feed = MultiSlotDataFeed(SLOTS, batch_size=4, files=[p])
+        with pytest.raises(RuntimeError, match="bad record|cannot open"):
+            for _ in feed:
+                pass
+
+    def test_missing_file_raises(self, tmp_path):
+        feed = MultiSlotDataFeed(SLOTS, batch_size=4,
+                                 files=[str(tmp_path / "nope")])
+        with pytest.raises(RuntimeError, match="cannot open"):
+            for _ in feed:
+                pass
+
+    def test_single_pass_enforced(self, tmp_path):
+        p = str(tmp_path / "part-0")
+        _write_multislot(p, 3)
+        feed = MultiSlotDataFeed(SLOTS, batch_size=2, files=[p])
+        list(feed)
+        with pytest.raises(RuntimeError, match="single-pass"):
+            iter(feed).__next__()
+
+    def test_feeds_training(self, tmp_path):
+        """Batches flow straight into embedding_bag + linear training —
+        the datafeed's sparse output IS the framework ragged encoding."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        p = str(tmp_path / "train")
+        _write_multislot(p, 32, seed=3)
+        emb = nn.Embedding(100, 8)
+        head = nn.Linear(8 + 2, 1)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.05,
+            parameters=emb.parameters() + head.parameters())
+        losses = []
+        for epoch in range(4):
+            feed = MultiSlotDataFeed(SLOTS, batch_size=8, files=[p],
+                                     nthreads=2)
+            for b in feed:
+                ids, lens = b["ids"]
+                seg = paddle.lengths_to_segment_ids(paddle.to_tensor(lens))
+                pooled = F.embedding_bag(paddle.to_tensor(ids), emb.weight,
+                                         seg, mode="mean")
+                feat = paddle.concat(
+                    [pooled, paddle.to_tensor(b["dense"])], axis=1)
+                loss = F.binary_cross_entropy_with_logits(
+                    head(feat), paddle.to_tensor(b["label"]))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+        assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+class _SquareDataset(Dataset):
+    """module-level so spawn workers can unpickle it"""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32(i) ** 2, np.int64(i)
+
+
+def _touch_marker(worker_id, marker):
+    open(f"{marker}{worker_id}", "w").close()
+
+
+class _FailingDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.float32(i)
+
+
+class TestMultiprocessWorkers:
+    def test_order_and_values(self):
+        dl = DataLoader(_SquareDataset(23), batch_size=4, num_workers=2,
+                        use_process_workers=True)
+        xs, idx = [], []
+        for xb, ib in dl:
+            xs.append(xb.numpy())
+            idx.append(ib.numpy())
+        x = np.concatenate(xs)
+        i = np.concatenate(idx)
+        np.testing.assert_array_equal(i, np.arange(23))
+        np.testing.assert_allclose(x, np.arange(23, dtype=np.float32) ** 2)
+
+    def test_worker_exception_propagates(self):
+        dl = DataLoader(_FailingDataset(), batch_size=2, num_workers=2,
+                        use_process_workers=True)
+        with pytest.raises(RuntimeError, match="boom at 5"):
+            list(dl)
+
+    def test_worker_init_fn_runs(self, tmp_path):
+        import functools
+        marker = str(tmp_path / "w")
+        init_fn = functools.partial(_touch_marker, marker=marker)
+        dl = DataLoader(_SquareDataset(8), batch_size=2, num_workers=2,
+                        use_process_workers=True, worker_init_fn=init_fn)
+        list(dl)
+        assert os.path.exists(marker + "0") and os.path.exists(marker + "1")
