@@ -176,13 +176,19 @@ class GradScaler:
         if not self._enable:
             return
         inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._parameter_list or []:
-            if p._grad is not None:
-                g = p._grad._data * inv
-                found = found or bool(jnp.any(~jnp.isfinite(g)))
-                p._grad._data = g
-        self._found_inf = found
+        # one device computation + ONE host sync for the whole parameter
+        # list (check_finite_and_unscale is a single fused op in the
+        # reference too — operators/amp/check_finite_and_unscale_op)
+        grads = [p._grad for p in optimizer._parameter_list or []
+                 if p._grad is not None]
+        if not grads:
+            self._found_inf = False
+            return
+        scaled = [g._data * inv for g in grads]
+        flags = jnp.stack([jnp.any(~jnp.isfinite(g)) for g in scaled])
+        for g, s in zip(grads, scaled):
+            g._data = s
+        self._found_inf = bool(jnp.any(flags))
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()

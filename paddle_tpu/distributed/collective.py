@@ -302,8 +302,16 @@ def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM,
     if _eager_world() == 1:
         return _rewrap(tensor, arr if not isinstance(src, (list, tuple))
                        else arrs[0])
-    raise NotImplementedError(
-        "eager multi-host reduce_scatter: wrap in a parallel region")
+    # eager multi-host: correct-if-slow fallback through a process
+    # allgather (the fast path is the in-trace psum_scatter above — eager
+    # loops are not where reduce_scatter bandwidth matters)
+    from jax.experimental import multihost_utils
+    world = _eager_world()
+    gathered = multihost_utils.process_allgather(arr)   # [world, ...]
+    reduced = gathered.sum(axis=0)
+    chunk = reduced.shape[0] // world
+    r = get_rank()
+    return _rewrap(tensor, reduced[r * chunk:(r + 1) * chunk])
 
 
 def scatter(tensor, tensor_list=None, src: int = 0,
@@ -321,7 +329,11 @@ def scatter(tensor, tensor_list=None, src: int = 0,
         bcast = broadcast(Tensor(arr), src=src, group=group)
         out = _unwrap(bcast)[idx]
         return _rewrap(tensor, out)
-    raise NotImplementedError("eager multi-host scatter")
+    # eager multi-host fallback: ship src's stacked list to everyone and
+    # keep this rank's row
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(arr)   # [world, n, ...]
+    return _rewrap(tensor, gathered[src][get_rank()])
 
 
 def alltoall(in_tensor_list, out_tensor_list=None,
@@ -345,7 +357,16 @@ def alltoall(in_tensor_list, out_tensor_list=None,
                 t if isinstance(t, Tensor) else Tensor(t)
                 for t in in_tensor_list)
         return arr
-    raise NotImplementedError("eager multi-host alltoall")
+    # eager multi-host fallback: allgather all ranks' stacked inputs
+    # [world, n, ...]; rank r's output list is column r
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(arr)
+    r = get_rank()
+    out = gathered[:, r] if gathered.ndim >= 2 else gathered
+    if out_tensor_list is not None:
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+    return out
 
 
 def p2p_shift(tensor, offset: int = 1, group: Optional[Group] = None,

@@ -1,23 +1,30 @@
 """``python -m paddle_tpu.distributed.launch`` — job launcher.
 
-Parity: python/paddle/distributed/fleet/launch.py:223 (launch_collective —
-one subprocess per device with the PADDLE_TRAINER_* env protocol,
-launch_utils.py:449 start_local_trainers, :473-476 env names).
+Parity: python/paddle/distributed/fleet/launch.py (launch_collective at
+:223, launch_ps at :292) + launch_utils.py (start_local_trainers :449,
+watch_local_trainers :522, env names :473-476, log management).
 
-TPU-native: on one host, a single SPMD process drives all chips, so the
-launcher execs the script once with the env protocol filled in.  For
-multi-host slices, pass ``--ips`` (comma list, parity with the reference) —
-each host runs this launcher; rank/world come from the position of this
-host's IP, and jax.distributed uses the first entry as coordinator (the
-analogue of the reference's TCP comm-id exchange).
+TPU-native: on one host a single SPMD process drives all chips, so
+collective mode launches ONE supervised trainer per host (nproc_per_node
+is forced to 1 — per-device processes are the reference's CUDA shape, not
+XLA's).  Multi-host slices pass ``--ips``; rank/world derive from this
+host's position and jax.distributed uses the first entry as coordinator.
+PS mode (``--server_num/--worker_num``) launches N parameter-server
+processes + M trainers with the TRAINING_ROLE env protocol, matching the
+reference's launch_ps.  All children get supervised: stdout/stderr tee to
+``log_dir/{worker,server}log.N``, and if any child dies the rest are
+terminated and the launcher exits with the failing code (the
+watch_local_trainers contract).
 """
 from __future__ import annotations
 
 import argparse
 import os
-import runpy
+import signal
 import socket
 import sys
+import time
+from typing import Dict, List, Optional
 
 __all__ = ["main"]
 
@@ -32,6 +39,12 @@ def _parse():
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="forced to 1: one SPMD controller per host")
     p.add_argument("--backend", type=str, default="xla")
+    p.add_argument("--server_num", type=int, default=0,
+                   help="PS mode: parameter servers on this host")
+    p.add_argument("--worker_num", type=int, default=0,
+                   help="PS mode: trainers on this host")
+    p.add_argument("--start_port", type=int,
+                   default=int(os.getenv("FLAGS_START_PORT", "6070")))
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -51,12 +64,78 @@ def _my_rank(ips):
     return int(os.getenv("PADDLE_TRAINER_ID", "0"))
 
 
-def main():
-    args = _parse()
-    ips = [s.strip() for s in args.ips.split(",") if s.strip()]
+class _Child:
+    """launch_utils.py TrainerProc: process + its log file + identity."""
+
+    def __init__(self, name: str, cmd: List[str], env: Dict[str, str],
+                 log_path: Optional[str]):
+        import subprocess
+        self.name = name
+        self.log_path = log_path
+        self.log_file = open(log_path, "w") if log_path else None
+        full_env = dict(os.environ)
+        full_env.update(env)
+        self.proc = subprocess.Popen(
+            cmd, env=full_env,
+            stdout=self.log_file or None,
+            stderr=subprocess.STDOUT if self.log_file else None)
+
+    def alive(self):
+        return self.proc.poll() is None
+
+    def terminate(self):
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:          # noqa: BLE001
+                self.proc.kill()
+        if self.log_file and not self.log_file.closed:
+            self.log_file.close()
+
+
+def _supervise(children: List[_Child]) -> int:
+    """watch_local_trainers (launch_utils.py:522): poll; first non-zero
+    exit kills the job; success when every child exits 0."""
+
+    def _sig(_s, _f):
+        for c in children:
+            c.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while True:
+            alive = False
+            for c in children:
+                rc = c.proc.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    print(f"launch: {c.name} exited with {rc}"
+                          + (f", see {c.log_path}" if c.log_path else ""),
+                          file=sys.stderr)
+                    for o in children:
+                        if o is not c:
+                            o.terminate()
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    finally:
+        for c in children:
+            if c.log_file and not c.log_file.closed:
+                c.log_file.close()
+
+
+def _launch_collective(args, ips) -> int:
     rank = _my_rank(ips)
-    port = int(os.getenv("FLAGS_START_PORT", "6070"))
-    endpoints = [f"{ip}:{port}" for ip in ips]
+    endpoints = [f"{ip}:{args.start_port}" for ip in ips]
+    if args.nproc_per_node != 1:
+        print("launch: nproc_per_node forced to 1 — one SPMD controller "
+              "drives every chip on this host (XLA, not one-proc-per-GPU)",
+              file=sys.stderr)
     env = {
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(len(ips)),
@@ -64,9 +143,51 @@ def main():
         "PADDLE_CURRENT_ENDPOINT": endpoints[rank] if rank < len(endpoints)
         else endpoints[0],
     }
-    os.environ.update(env)
-    sys.argv = [args.training_script] + args.training_script_args
-    runpy.run_path(args.training_script, run_name="__main__")
+    os.makedirs(args.log_dir, exist_ok=True)
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+    child = _Child(f"trainer-{rank}", cmd, env,
+                   os.path.join(args.log_dir, f"workerlog.{rank}"))
+    return _supervise([child])
+
+
+def _launch_ps(args) -> int:
+    """launch_ps: servers first, then trainers, one env block each."""
+    n_s, n_w = args.server_num, args.worker_num
+    server_eps = [f"127.0.0.1:{args.start_port + i}" for i in range(n_s)]
+    worker_eps = [f"127.0.0.1:{args.start_port + n_s + i}"
+                  for i in range(n_w)]
+    os.makedirs(args.log_dir, exist_ok=True)
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+    common = {
+        "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(worker_eps),
+        "PADDLE_TRAINERS_NUM": str(n_w),
+    }
+    children = []
+    for i in range(n_s):
+        env = dict(common, TRAINING_ROLE="PSERVER",
+                   PADDLE_PSERVER_ID=str(i),
+                   PADDLE_PORT=str(args.start_port + i),
+                   POD_IP="127.0.0.1")
+        children.append(_Child(
+            f"server-{i}", cmd, env,
+            os.path.join(args.log_dir, f"serverlog.{i}")))
+    for i in range(n_w):
+        env = dict(common, TRAINING_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(i),
+                   PADDLE_CURRENT_ENDPOINT=worker_eps[i])
+        children.append(_Child(
+            f"trainer-{i}", cmd, env,
+            os.path.join(args.log_dir, f"workerlog.{i}")))
+    return _supervise(children)
+
+
+def main():
+    args = _parse()
+    if args.server_num > 0 or args.worker_num > 0:
+        sys.exit(_launch_ps(args))
+    ips = [s.strip() for s in args.ips.split(",") if s.strip()]
+    sys.exit(_launch_collective(args, ips))
 
 
 if __name__ == "__main__":

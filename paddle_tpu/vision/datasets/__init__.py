@@ -137,24 +137,105 @@ class Cifar100(Cifar10):
 
 
 class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: vision/datasets/flowers.py).
+
+    Needs the reference's three files locally (zero egress): 102flowers.tgz
+    (jpg/image_NNNNN.jpg members), imagelabels.mat, setid.mat.  Samples:
+    (image, [label]) with image decoded via PIL ('pil' backend) or numpy
+    HWC ('cv2' backend), indices from setid's trnid/valid/tstid split
+    (flowers.py:138-158)."""
+
+    _FLAG = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None):
-        data_file = data_file or os.path.join(_CACHE, "flowers", "102flowers.tgz")
-        if not os.path.exists(data_file):
-            _no_download("Flowers", data_file)
-        raise NotImplementedError(
-            "Flowers .tgz/.mat parsing needs scipy.io; convert locally or "
-            "use FakeData")
+        import scipy.io as scio
+        assert mode in self._FLAG, mode
+        self.backend = backend or "cv2"
+        self.transform = transform
+        data_file = data_file or os.path.join(_CACHE, "flowers",
+                                              "102flowers.tgz")
+        label_file = label_file or os.path.join(_CACHE, "flowers",
+                                                "imagelabels.mat")
+        setid_file = setid_file or os.path.join(_CACHE, "flowers",
+                                                "setid.mat")
+        for p, n in ((data_file, "Flowers"), (label_file, "Flowers labels"),
+                     (setid_file, "Flowers setid")):
+            if not os.path.exists(p):
+                _no_download(n, p)
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self._FLAG[mode]][0]
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]])
+        raw = self._tar.extractfile(
+            self._members["jpg/image_%05d.jpg" % index]).read()
+        image = Image.open(_io.BytesIO(raw))
+        if self.backend == "cv2":
+            image = np.array(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label.astype("int64")
+
+    def __len__(self):
+        return len(self.indexes)
 
 
 class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference:
+    vision/datasets/voc2012.py): image list from
+    ImageSets/Segmentation/{mode}.txt, (JPEGImages jpg, SegmentationClass
+    png) decoded per backend."""
+
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _DATA = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LABEL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None):
-        data_file = data_file or os.path.join(_CACHE, "voc2012",
-                                              "VOCtrainval_11-May-2012.tar")
+        assert mode in ("train", "valid", "test"), mode
+        flag = "val" if mode == "valid" else mode
+        self.backend = backend or "cv2"
+        self.transform = transform
+        data_file = data_file or os.path.join(
+            _CACHE, "voc2012", "VOCtrainval_11-May-2012.tar")
         if not os.path.exists(data_file):
             _no_download("VOC2012", data_file)
-        raise NotImplementedError("VOC2012 parsing: round-2 scope")
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        self.data, self.labels = [], []
+        for line in self._tar.extractfile(
+                self._members[self._SET.format(flag)]):
+            name = line.decode("utf-8").strip()
+            if not name:
+                continue
+            self.data.append(self._DATA.format(name))
+            self.labels.append(self._LABEL.format(name))
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+        img = Image.open(_io.BytesIO(self._tar.extractfile(
+            self._members[self.data[idx]]).read()))
+        lbl = Image.open(_io.BytesIO(self._tar.extractfile(
+            self._members[self.labels[idx]]).read()))
+        if self.backend == "cv2":
+            img, lbl = np.array(img), np.array(lbl)
+        if self.transform is not None:
+            img = self.transform(img)
+        if self.backend == "cv2":
+            return img.astype("float32"), lbl.astype("float32")
+        return img, lbl
+
+    def __len__(self):
+        return len(self.data)
 
 
 class FakeData(Dataset):

@@ -124,18 +124,34 @@ def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
         N, C, H, W = x.shape
 
         def per_roi(roi):
+            # reference roi_pool_op.h: bin (i,j) max-pools rows
+            # [floor(i*hh/oh), ceil((i+1)*hh/oh)) etc.; empty bins -> 0.
+            # Masked-max formulation keeps it static-shaped for XLA.
             x1 = jnp.floor(roi[0] * spatial_scale).astype(jnp.int32)
             y1 = jnp.floor(roi[1] * spatial_scale).astype(jnp.int32)
             x2 = jnp.ceil(roi[2] * spatial_scale).astype(jnp.int32)
             y2 = jnp.ceil(roi[3] * spatial_scale).astype(jnp.int32)
             hh = jnp.maximum(y2 - y1, 1)
             ww = jnp.maximum(x2 - x1, 1)
-            ys = y1 + (jnp.arange(oh) * hh) // oh
-            xs = x1 + (jnp.arange(ow) * ww) // ow
-            ys = jnp.clip(ys, 0, H - 1)
-            xs = jnp.clip(xs, 0, W - 1)
-            img = x[0]
-            return img[:, ys][:, :, xs]
+            i = jnp.arange(oh)[:, None]
+            j = jnp.arange(ow)[:, None]
+            y = jnp.arange(H)[None, :]
+            xw = jnp.arange(W)[None, :]
+            hstart = y1 + (i * hh) // oh
+            hend = y1 + -((-(i + 1) * hh) // oh)     # ceil division
+            wstart = x1 + (j * ww) // ow
+            wend = x1 + -((-(j + 1) * ww) // ow)
+            rowm = (y >= jnp.clip(hstart, 0, H)) & \
+                   (y < jnp.clip(hend, 0, H))        # [oh, H]
+            colm = (xw >= jnp.clip(wstart, 0, W)) & \
+                   (xw < jnp.clip(wend, 0, W))       # [ow, W]
+            img = x[0]                               # [C, H, W]
+            t = jnp.where(rowm[:, None, :, None], img[None],
+                          -jnp.inf).max(axis=2)      # [oh, C, W]
+            o = jnp.where(colm[None, :, None, :], t[:, None],
+                          -jnp.inf).max(axis=3)      # [oh, ow, C]
+            o = jnp.transpose(o, (2, 0, 1))
+            return jnp.where(jnp.isfinite(o), o, 0.0)
         return jax.vmap(per_roi)(rois)
     return apply1(f, x, boxes, name="roi_pool")
 
