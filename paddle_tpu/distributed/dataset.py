@@ -1,0 +1,210 @@
+"""Dataset factory + DeviceWorker training loop.
+
+Reference roles:
+  * python/paddle/fluid/dataset.py — DatasetFactory (:23), DatasetBase
+    (:65 set_batch_size/set_thread/set_filelist/set_use_var),
+    QueueDataset (streaming), InMemoryDataset (:329
+    load_into_memory/local_shuffle/global_shuffle);
+  * fluid/executor.py:1649 train_from_dataset — the Trainer/DeviceWorker
+    runtime (trainer_desc → hogwild_worker.cc TrainFiles loop);
+  * framework/data_feed.cc — the parsing threads, here the native C++
+    engine (paddle_tpu.ops.native.MultiSlotDataFeed).
+
+TPU-native shape: the DeviceWorker loop is host-side batch delivery into
+one fused XLA TrainStep (there is no per-thread scope/program replica —
+XLA owns device parallelism), so ``train_from_dataset(step, dataset)``
+drives: C++ readers → slot dict → tensor conversion (sparse slots arrive
+in the framework ragged encoding) → step.  ``set_use_var`` takes slot
+specs ``(name, kind, dim)`` instead of static-graph Variables.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "QueueDataset", "InMemoryDataset",
+           "train_from_dataset"]
+
+
+class DatasetFactory:
+    """fluid/dataset.py:23 — create_dataset('QueueDataset'|'InMemoryDataset')."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        try:
+            return {"QueueDataset": QueueDataset,
+                    "InMemoryDataset": InMemoryDataset}[datafeed_class]()
+        except KeyError:
+            raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+class _DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: List[str] = []
+        self.slots: List[Tuple[str, str, int]] = []
+        self.queue_capacity = 16
+
+    # -- DatasetBase knobs (fluid/dataset.py:158-258) -----------------------
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        """Slot schema.  Accepts (name, kind, dim) tuples — kind 'f' dense
+        float32 row, 'u' sparse int64 id list — the TPU-native stand-in
+        for the reference's static-graph Variable list."""
+        slots = []
+        for v in var_list:
+            if isinstance(v, (tuple, list)) and len(v) == 3:
+                slots.append((str(v[0]), str(v[1]), int(v[2])))
+            else:
+                raise TypeError(
+                    "set_use_var expects (name, kind, dim) slot specs")
+        self.slots = slots
+
+    def set_pipe_command(self, cmd):      # text protocol is built-in
+        self._pipe_command = cmd
+
+    def _feed(self, files=None):
+        from paddle_tpu.ops.native import MultiSlotDataFeed
+        if not self.slots:
+            raise RuntimeError("set_use_var first")
+        return MultiSlotDataFeed(self.slots, self.batch_size,
+                                 files=files or self.filelist,
+                                 nthreads=self.thread_num,
+                                 capacity=self.queue_capacity)
+
+    def batches(self):
+        raise NotImplementedError
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming: batches come straight off the C++ reader threads
+    (fluid/dataset.py QueueDataset — no in-memory staging)."""
+
+    def batches(self):
+        yield from self._feed()
+
+
+class InMemoryDataset(_DatasetBase):
+    """fluid/dataset.py:329 — stage instances in host RAM, shuffle, then
+    serve (load_into_memory → local_shuffle → train)."""
+
+    def __init__(self):
+        super().__init__()
+        self._instances: Optional[list] = None
+        self._rng = np.random.default_rng(0)
+
+    def load_into_memory(self):
+        """Parse every file now (C++ threads), keep per-instance slot
+        values (batch_size=1 pass)."""
+        from paddle_tpu.ops.native import MultiSlotDataFeed
+        feed = MultiSlotDataFeed(self.slots, 1, files=self.filelist,
+                                 nthreads=self.thread_num,
+                                 capacity=self.queue_capacity)
+        self._instances = []
+        for b in feed:
+            inst = {}
+            for name, kind, _dim in self.slots:
+                if kind == "f":
+                    inst[name] = b[name][0]
+                else:
+                    ids, _lens = b[name]
+                    inst[name] = ids
+            self._instances.append(inst)
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        if self._instances is None:
+            raise RuntimeError("load_into_memory first")
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._rng.shuffle(self._instances)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12):
+        """Single-controller SPMD feeds every chip from one host process,
+        so the cross-trainer exchange the reference does here collapses to
+        a local shuffle (each multi-host process shuffles its own files)."""
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._instances = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._instances or [])
+
+    def batches(self):
+        if self._instances is None:
+            raise RuntimeError("load_into_memory first")
+        bs = self.batch_size
+        for i in range(0, len(self._instances), bs):
+            chunk = self._instances[i:i + bs]
+            out = {}
+            for name, kind, _dim in self.slots:
+                if kind == "f":
+                    out[name] = np.stack([c[name] for c in chunk])
+                else:
+                    ids = np.concatenate([c[name] for c in chunk])
+                    lens = np.array([len(c[name]) for c in chunk],
+                                    np.int64)
+                    out[name] = (ids, lens)
+            yield out
+
+
+def _default_converter(slots):
+    """batch dict → flat tensor list in slot order; sparse slots expand to
+    (ids, lengths)."""
+    import paddle_tpu as paddle
+
+    def convert(batch):
+        args = []
+        for name, kind, _dim in slots:
+            if kind == "f":
+                args.append(paddle.to_tensor(batch[name]))
+            else:
+                ids, lens = batch[name]
+                args.append(paddle.to_tensor(ids))
+                args.append(paddle.to_tensor(lens))
+        return args
+    return convert
+
+
+def train_from_dataset(step, dataset, converter: Optional[Callable] = None,
+                       epochs: int = 1, print_period: int = 100,
+                       fetch_handler: Optional[Callable] = None,
+                       debug: bool = False):
+    """The Trainer/DeviceWorker runtime (executor.py:1649 +
+    hogwild_worker.cc TrainFiles): drain the dataset's feed into ``step``
+    (a (Sharded)TrainStep or any callable taking the converted batch).
+
+    ``converter(batch_dict) -> [tensors]`` defaults to slot order with
+    sparse slots as (ids, lengths).  Returns per-epoch mean losses.
+    """
+    conv = converter or _default_converter(dataset.slots)
+    epoch_losses = []
+    it = 0
+    for _epoch in range(epochs):
+        losses = []
+        t0 = time.time()
+        for batch in dataset.batches():
+            loss = step(*conv(batch))
+            losses.append(float(np.asarray(
+                loss.numpy() if hasattr(loss, "numpy") else loss)))
+            it += 1
+            if fetch_handler is not None and it % print_period == 0:
+                fetch_handler(it, losses[-1])
+            elif debug and it % print_period == 0:
+                print(f"iter {it}: loss {losses[-1]:.6f} "
+                      f"({it / (time.time() - t0):.1f} it/s)")
+        if not losses:
+            raise RuntimeError("dataset produced no batches "
+                               "(set_filelist/set_use_var?)")
+        epoch_losses.append(float(np.mean(losses)))
+    return epoch_losses
