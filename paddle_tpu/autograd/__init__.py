@@ -83,17 +83,15 @@ def _run_engine(root_tensors, root_grads, retain_graph=False,
             continue
         # materialise missing output cotangents as zeros
         cots = []
-        for ref, c in zip(node.outputs, slots):
+        for i, (ref, c) in enumerate(zip(node.outputs, slots)):
             if c is not None:
                 cots.append(c)
+            elif node.out_avals is not None:
+                shape, dtype = node.out_avals[i]
+                cots.append(jnp.zeros(shape, dtype))
             else:
                 t = ref()
                 if t is None:
-                    # output died and nothing flowed into it; a dead output
-                    # cannot have received a cotangent — zeros are correct,
-                    # but we need its aval; vjp accepts zeros of primal shape
-                    # which we cannot recover, so this situation only occurs
-                    # for unused multi-outputs kept alive by the node itself.
                     raise RuntimeError(
                         f"backward: lost output of node {node.name}")
                 cots.append(jnp.zeros(t._data.shape, t._data.dtype))
@@ -134,6 +132,82 @@ def _run_engine(root_tensors, root_grads, retain_graph=False,
             node.outputs = []
 
 
+def _run_engine_tracked(root_tensors, root_grads, capture):
+    """The create_graph=True sweep (partial_grad_engine.cc double-grad
+    role): cotangents are *Tensors* and every node's backward is replayed
+    through ``core.apply`` as a re-linearization of its stored pure
+    forward — so the produced grads carry their own tape and
+    ``paddle.grad`` composes with itself.  The first-order graph is left
+    intact (retain_graph implied, matching the reference)."""
+    import jax
+
+    from paddle_tpu.core import apply as _apply
+
+    roots = [t._node for t in root_tensors if t._node is not None]
+    order = _topo_order(roots)
+    node_cots = {}
+
+    def add_cotangent(t: Tensor, c: Tensor):
+        if id(t) in capture:
+            prev = capture.get(id(t))
+            capture[id(t)] = c if prev is None else prev + c
+        if t._node is not None:
+            node = t._node
+            slots = node_cots.setdefault(id(node),
+                                         [None] * len(node.outputs))
+            idx = t._out_index
+            slots[idx] = c if slots[idx] is None else slots[idx] + c
+
+    for t, g in zip(root_tensors, root_grads):
+        add_cotangent(t, Tensor(g))
+
+    with enable_grad():
+        for node in reversed(order):
+            slots = node_cots.get(id(node))
+            if slots is None:
+                continue
+            if node.pure_fn is None or node.vjp_fn is None:
+                raise RuntimeError(
+                    "create_graph=True needs the forward graph intact "
+                    "(was it freed by an earlier backward without "
+                    "retain_graph?)")
+            cots = []
+            for i, (ref, c) in enumerate(zip(node.outputs, slots)):
+                if c is not None:
+                    cots.append(c)
+                elif node.out_avals is not None:
+                    shape, dtype = node.out_avals[i]
+                    cots.append(Tensor(jnp.zeros(shape, dtype)))
+                else:
+                    t = ref()
+                    if t is None:
+                        raise RuntimeError(
+                            f"backward: lost output of node {node.name}")
+                    cots.append(Tensor(jnp.zeros(t._data.shape,
+                                                 t._data.dtype)))
+            k = len(node.inputs)
+            seq = node.out_is_seq or len(cots) > 1
+            pure_fn = node.pure_fn
+
+            def node_backward(*arrs, _pure=pure_fn, _k=k, _seq=seq):
+                prim, cot = arrs[:_k], arrs[_k:]
+                _out, vjp = jax.vjp(_pure, *prim)
+                return vjp(tuple(cot) if _seq else cot[0])
+
+            in_grads = _apply(node_backward, *node.inputs, *cots,
+                              name=node.name + "_grad")
+            for t, g in zip(node.inputs, in_grads):
+                if g is None:
+                    continue
+                if t._hooks:
+                    for hook in list(t._hooks):
+                        res = hook(g)
+                        if res is not None:
+                            g = res if isinstance(res, Tensor) else \
+                                Tensor(res)
+                add_cotangent(t, g)
+
+
 def backward_from(tensor: Tensor, grad_tensor=None, retain_graph=False):
     if tensor.stop_gradient and tensor._node is None:
         raise RuntimeError(
@@ -168,18 +242,15 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     """``paddle.grad`` parity (reference: imperative/partial_grad_engine.cc).
 
     Returns grads of ``outputs`` w.r.t. ``inputs`` without touching ``.grad``.
-    ``create_graph`` (double backward) is not supported on the eager tape —
-    use the functional ``paddle_tpu.incubate.autograd`` / raw jax.grad for
-    higher-order derivatives.
+    With ``create_graph=True`` the backward itself is taped (each node's
+    stored pure forward is re-linearized through core.apply), so the
+    returned grads are differentiable again — the double-backward path of
+    partial_grad_engine.cc.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; use "
-            "jax.grad composition via paddle_tpu.jit for higher-order grads")
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = create_graph       # reference default semantics
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
     elif isinstance(grad_outputs, Tensor):
@@ -191,8 +262,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         else:
             gs.append(g._data if isinstance(g, Tensor) else jnp.asarray(g))
     capture = {id(t): None for t in inputs}
-    _run_engine(outputs, gs, retain_graph=retain_graph,
-                accumulate_into_grad=False, capture=capture)
+    if create_graph:
+        _run_engine_tracked(outputs, gs, capture)
+    else:
+        _run_engine(outputs, gs, retain_graph=retain_graph,
+                    accumulate_into_grad=False, capture=capture)
     results = []
     for t in inputs:
         c = capture[id(t)]
@@ -203,5 +277,5 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "(pass allow_unused=True to get None)")
             results.append(None)
         else:
-            results.append(Tensor(c))
+            results.append(c if isinstance(c, Tensor) else Tensor(c))
     return results

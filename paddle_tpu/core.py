@@ -302,16 +302,25 @@ class TapeNode:
     reference: paddle/fluid/imperative/layer.h + tracer.cc:205)."""
 
     __slots__ = ("vjp_fn", "inputs", "outputs", "name", "out_is_seq",
-                 "__weakref__")
+                 "pure_fn", "out_avals", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, outputs, name="", out_is_seq=False):
+    def __init__(self, vjp_fn, inputs, outputs, name="", out_is_seq=False,
+                 pure_fn=None, out_avals=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # list[Tensor] (differentiable inputs)
         self.outputs = outputs        # list[weakref to output Tensors]
+        # (shape, dtype) per output — lets the engines materialise zero
+        # cotangents for outputs whose Tensor has been GC'd (common for
+        # unused grads out of a multi-output *_grad node)
+        self.out_avals = out_avals
         self.name = name
         # the primal fn returned a tuple/list (vjp then expects the
         # cotangent wrapped in the same structure, even for one output)
         self.out_is_seq = out_is_seq
+        # forward restricted to the differentiable args — re-linearized by
+        # paddle.grad(create_graph=True) so the backward itself is taped
+        # (partial_grad_engine.cc double-grad role)
+        self.pure_fn = pure_fn
 
 
 def _is_float_dtype(d) -> bool:
@@ -658,7 +667,10 @@ def apply(fn: Callable, *args, name: str = "", nondiff: Sequence[int] = (),
     node = TapeNode(vjp_fn, [args[i] for i in grad_pos],
                     [weakref.ref(t) for t in outs], name=name or getattr(
                         fn, "__name__", "op"),
-                    out_is_seq=isinstance(out, (tuple, list)))
+                    out_is_seq=isinstance(out, (tuple, list)),
+                    pure_fn=pure,
+                    out_avals=[(t._data.shape, t._data.dtype)
+                               for t in outs])
     for idx, t in enumerate(outs):
         t._node = node
         t._out_index = idx

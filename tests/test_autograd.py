@@ -114,8 +114,11 @@ def test_numeric_grad(fn, inputs):
     check_grad(fn, inputs, wrt=wrt, atol=1e-4, rtol=1e-4, delta=1e-4)
 
 
-def test_second_order_unsupported():
+def test_second_order_supported():
+    # create_graph=True is the partial_grad_engine double-grad path —
+    # full coverage in tests/test_double_backward.py
     x = paddle.to_tensor([1.0], stop_gradient=False)
     y = x * x
-    with pytest.raises(NotImplementedError):
-        paddle.grad(y, x, create_graph=True)
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g1, x)
+    np.testing.assert_allclose(g2.numpy(), [2.0])

@@ -1,0 +1,82 @@
+"""paddle.grad(create_graph=True) — double/higher-order backward
+(reference: imperative/partial_grad_engine.cc create_graph path,
+unittests/test_imperative_double_grad.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_second_derivative_cubic():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0]))
+    x.stop_gradient = False
+    y = (x * x * x).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 3 * np.array([1, 4, 9.0]),
+                               rtol=1e-6)
+    (g2,) = paddle.grad(g1.sum(), x, create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([1, 2, 3.0]),
+                               rtol=1e-6)
+    (g3,) = paddle.grad(g2.sum(), x)
+    np.testing.assert_allclose(g3.numpy(), [6.0, 6.0, 6.0], rtol=1e-6)
+
+
+def test_chain_through_nonlinearity():
+    x = paddle.to_tensor(np.array(0.7))
+    x.stop_gradient = False
+    y = paddle.tanh(x)
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    t = np.tanh(0.7)
+    np.testing.assert_allclose(g1.numpy(), 1 - t * t, rtol=1e-6)
+    (g2,) = paddle.grad(g1, x)
+    np.testing.assert_allclose(g2.numpy(), -2 * t * (1 - t * t), rtol=1e-5)
+
+
+def test_gradient_penalty_backward_accumulates():
+    """WGAN-GP pattern: ||dD/dx||² differentiated into model params."""
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    fc = nn.Linear(3, 1)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((4, 3)).astype(np.float32))
+    x.stop_gradient = False
+    out = fc(x).sum()
+    (gx,) = paddle.grad(out, x, create_graph=True)
+    penalty = (gx * gx).sum()
+    penalty.backward()
+    w = fc.weight
+    assert w.grad is not None
+    # d penalty / dW = 2 * B * W broadcast (gx == W^T rows)
+    np.testing.assert_allclose(
+        w.grad.numpy().ravel(), (2 * 4 * w.numpy()).ravel(), rtol=1e-5)
+
+
+def test_grad_outputs_weighting():
+    x = paddle.to_tensor(np.array([2.0, 5.0]))
+    x.stop_gradient = False
+    y = x * x
+    v = paddle.to_tensor(np.array([1.0, 10.0]))
+    (g,) = paddle.grad(y, x, grad_outputs=v, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [4.0, 100.0], rtol=1e-6)
+    (gg,) = paddle.grad(g.sum(), x)
+    np.testing.assert_allclose(gg.numpy(), [2.0, 20.0], rtol=1e-6)
+
+
+def test_first_order_graph_survives():
+    x = paddle.to_tensor(np.array(3.0))
+    x.stop_gradient = False
+    y = x * x
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    # the original graph is still usable (retain implied)
+    (g1b,) = paddle.grad(y, x, create_graph=False, retain_graph=True)
+    np.testing.assert_allclose(g1.numpy(), g1b.numpy())
+
+
+def test_allow_unused_with_create_graph():
+    x = paddle.to_tensor(np.array(1.0))
+    z = paddle.to_tensor(np.array(1.0))
+    x.stop_gradient = False
+    z.stop_gradient = False
+    y = x * 2
+    gx, gz = paddle.grad(y, [x, z], create_graph=True, allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), 2.0)
