@@ -64,3 +64,8 @@ class Executor:
         raise NotImplementedError(
             "the C++ Executor does not exist in paddle_tpu; jit-compiled "
             "functions dispatch straight to XLA (see paddle_tpu.jit)")
+
+
+from paddle_tpu.static import nn  # noqa: E402,F401
+from paddle_tpu.static.nn import (  # noqa: E402,F401
+    case, cond, switch_case, while_loop)

@@ -1,0 +1,179 @@
+"""Control-flow API surface — paddle.static.nn.{while_loop, cond, case,
+switch_case} parity.
+
+Reference: python/paddle/fluid/layers/control_flow.py (while_loop :1115,
+cond :2197, case :2719, switch_case :3277) — block-building ops executed
+by the interpreter's conditional/while op kernels.
+
+TPU mapping — one API, two regimes (the same dual-regime rule as the
+collectives):
+  * **eager** (concrete Tensors): plain python control flow.  The tape
+    records whichever branch/iterations actually ran, so backward works
+    exactly like the reference's dygraph mode.
+  * **in-trace** (inside jit/TrainStep capture, tracer-backed Tensors):
+    lowers to ``lax.while_loop`` / ``lax.cond`` / ``lax.switch`` —
+    compiler-friendly control flow with no unrolling, the XLA-native
+    replacement for the reference's WhileOp/ConditionalBlockOp kernels.
+
+Loop state must be a flat list/tuple of Tensors with loop-invariant
+shapes/dtypes (the reference imposes the same via assign-to-same-var).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import Tensor
+
+__all__ = ["while_loop", "cond", "case", "switch_case"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _any_tracer(vals) -> bool:
+    return any(_is_tracer(v) for v in jax.tree_util.tree_leaves(
+        [_unwrap(v) for v in vals]))
+
+
+def _wrap_list(arrs, like):
+    out = []
+    for a, l in zip(arrs, like):
+        out.append(Tensor(a) if isinstance(l, Tensor) else a)
+    return out
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: Optional[str] = None) -> List:
+    """control_flow.py:1115.  ``cond(*vars) -> scalar bool``,
+    ``body(*vars) -> new vars`` (same structure/shapes)."""
+    if not callable(cond) or not callable(body):
+        raise TypeError("cond and body must be callable")
+    loop_vars = list(loop_vars)
+    if not loop_vars:
+        raise ValueError("loop_vars cannot be empty")
+
+    if not _any_tracer(loop_vars) and not _is_tracer(cond(*loop_vars)):
+        # eager: python loop; the tape sees the executed iterations
+        vals = loop_vars
+        while bool(_unwrap(cond(*vals))):
+            out = body(*vals)
+            vals = list(out) if isinstance(out, (list, tuple)) else [out]
+            if len(vals) != len(loop_vars):
+                raise ValueError(
+                    f"body returned {len(vals)} vars, expected "
+                    f"{len(loop_vars)}")
+        return vals
+
+    # in-trace: lax.while_loop over raw arrays
+    init = tuple(_unwrap(v) for v in loop_vars)
+
+    def _cond(c):
+        return jnp.asarray(_unwrap(cond(*_wrap_list(c, loop_vars)))) \
+            .reshape(())
+
+    def _body(c):
+        out = body(*_wrap_list(c, loop_vars))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(_unwrap(o) for o in out)
+
+    final = lax.while_loop(_cond, _body, init)
+    return _wrap_list(final, loop_vars)
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name: Optional[str] = None):
+    """control_flow.py:2197.  Both branches must return the same
+    structure (the reference errors likewise at runtime)."""
+    if _is_tracer(pred):
+        tf = true_fn or (lambda: None)
+        ff = false_fn or (lambda: None)
+
+        def _run(fn):
+            def inner(_):
+                out = fn()
+                return jax.tree_util.tree_map(_unwrap, out)
+            return inner
+        out = lax.cond(jnp.asarray(_unwrap(pred)).reshape(()).astype(bool),
+                       _run(tf), _run(ff), operand=None)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a) if a is not None else None, out)
+    taken = true_fn if bool(_unwrap(pred)) else false_fn
+    return taken() if taken is not None else None
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Optional[Callable] = None,
+         name: Optional[str] = None):
+    """control_flow.py:2719 — first true predicate wins; eager and
+    in-trace (chained lax.cond) regimes."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs cannot be empty")
+    for p, f in pred_fn_pairs:
+        if not callable(f):
+            raise TypeError("branch fns must be callable")
+    if not any(_is_tracer(p) for p, _ in pred_fn_pairs):
+        for p, f in pred_fn_pairs:
+            if bool(_unwrap(p)):
+                return f()
+        if default is None:
+            raise ValueError("no predicate true and no default given")
+        return default()
+    # in-trace: fold into nested lax.cond, last-else = default (or the
+    # last branch, matching the reference's default=None behaviour)
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+        pred_fn_pairs = pred_fn_pairs[:-1]
+
+    def build(i):
+        if i == len(pred_fn_pairs):
+            return lambda: jax.tree_util.tree_map(_unwrap, default())
+        p, f = pred_fn_pairs[i]
+        nxt = build(i + 1)
+        return lambda: lax.cond(
+            jnp.asarray(_unwrap(p)).reshape(()).astype(bool),
+            lambda _: jax.tree_util.tree_map(_unwrap, f()),
+            lambda _: nxt(), operand=None)
+    out = build(0)()
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name: Optional[str] = None):
+    """control_flow.py:3277.  ``branch_fns``: dict {int: fn} or sequence of
+    (int, fn) / bare fns.  Out-of-range indices take ``default`` (or the
+    max-index branch, per the reference)."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = [(i, f) if callable(f) else tuple(f)
+                 for i, f in enumerate(branch_fns)]
+        pairs = [(int(k), f) for k, f in pairs]
+    keys = [k for k, _ in pairs]
+    if len(set(keys)) != len(keys):
+        raise ValueError("duplicate branch indices")
+    if default is None:
+        default = pairs[-1][1]
+    if not _is_tracer(branch_index):
+        k = int(_unwrap(branch_index))
+        for key, f in pairs:
+            if key == k:
+                return f()
+        return default()
+    # in-trace: map sparse keys onto a dense lax.switch table + default slot
+    key_arr = jnp.asarray(keys)
+    idx = jnp.asarray(_unwrap(branch_index)).reshape(()).astype(jnp.int32)
+    matches = (key_arr == idx)
+    dense = jnp.where(matches.any(), jnp.argmax(matches), len(pairs))
+    fns = [(lambda f=f: jax.tree_util.tree_map(_unwrap, f()))
+           for _, f in pairs]
+    fns.append(lambda: jax.tree_util.tree_map(_unwrap, default()))
+    out = lax.switch(dense, fns)
+    return jax.tree_util.tree_map(Tensor, out)

@@ -1,0 +1,131 @@
+"""Control-flow surface (fluid/layers/control_flow.py while_loop/cond/
+case/switch_case) in both regimes: eager python flow (tape-recorded) and
+in-trace lax lowering (no unrolling)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static.nn import case, cond, switch_case, while_loop
+
+
+class TestWhileLoop:
+    def test_eager_counts(self):
+        i = paddle.to_tensor(np.int64(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        out_i, out_s = while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: [i + 1, s + 2.0],
+            [i, s])
+        assert int(out_i.numpy()) == 5
+        assert float(out_s.numpy()) == 10.0
+
+    def test_eager_backward_through_loop(self):
+        x = paddle.to_tensor(np.float32(2.0))
+        x.stop_gradient = False
+        i = paddle.to_tensor(np.int64(0))
+        _, y = while_loop(lambda i, y: i < 3,
+                          lambda i, y: [i + 1, y * x],
+                          [i, paddle.to_tensor(np.float32(1.0))])
+        y.backward()           # y = x^3 -> dy/dx = 3x^2 = 12
+        np.testing.assert_allclose(x.grad.numpy(), 12.0, rtol=1e-6)
+
+    def test_in_trace_no_unroll(self):
+        def f(n):
+            i, s = while_loop(
+                lambda i, s: i < n,
+                lambda i, s: [i + 1, s + i.astype("float32")],
+                [paddle.to_tensor(jnp.int32(0)),
+                 paddle.to_tensor(jnp.float32(0.0))])
+            return s._data
+        out = jax.jit(lambda n: f(paddle.to_tensor(n)))(jnp.int32(10))
+        assert float(out) == sum(range(10))
+        # data-dependent trip count executes without retrace
+        out2 = jax.jit(lambda n: f(paddle.to_tensor(n)))(jnp.int32(4))
+        assert float(out2) == sum(range(4))
+
+    def test_body_arity_error(self):
+        with pytest.raises(ValueError, match="expected"):
+            while_loop(lambda a, b: a < 1, lambda a, b: [a + 1],
+                       [paddle.to_tensor(0), paddle.to_tensor(0)])
+
+
+class TestCond:
+    def test_eager(self):
+        x = paddle.to_tensor(np.float32(3.0))
+        out = cond(x > 0, lambda: x * 2, lambda: x - 1)
+        assert float(out.numpy()) == 6.0
+        out = cond(x < 0, lambda: x * 2, lambda: x - 1)
+        assert float(out.numpy()) == 2.0
+
+    def test_eager_backward_taken_branch(self):
+        x = paddle.to_tensor(np.float32(3.0))
+        x.stop_gradient = False
+        out = cond(x > 0, lambda: x * x, lambda: x)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 6.0)
+
+    def test_in_trace_both_branches_compiled(self):
+        def f(x):
+            t = paddle.to_tensor(x)
+            out = cond(t > 0, lambda: t * 2, lambda: t - 1)
+            return out._data
+        jf = jax.jit(f)
+        assert float(jf(jnp.float32(5.0))) == 10.0
+        assert float(jf(jnp.float32(-5.0))) == -6.0
+
+
+class TestCaseSwitch:
+    def test_case_eager_first_true_wins(self):
+        x = paddle.to_tensor(np.float32(2.0))
+        out = case([(x > 3, lambda: paddle.to_tensor(np.float32(30.0))),
+                    (x > 1, lambda: paddle.to_tensor(np.float32(10.0)))],
+                   default=lambda: paddle.to_tensor(np.float32(-1.0)))
+        assert float(out.numpy()) == 10.0
+
+    def test_case_eager_default(self):
+        x = paddle.to_tensor(np.float32(0.0))
+        out = case([(x > 3, lambda: x)],
+                   default=lambda: paddle.to_tensor(np.float32(-1.0)))
+        assert float(out.numpy()) == -1.0
+        with pytest.raises(ValueError, match="default"):
+            case([(x > 3, lambda: x)])
+
+    def test_case_in_trace(self):
+        def f(x):
+            t = paddle.to_tensor(x)
+            out = case([(t > 3, lambda: t * 100),
+                        (t > 1, lambda: t * 10)],
+                       default=lambda: t)
+            return out._data
+        jf = jax.jit(f)
+        assert float(jf(jnp.float32(5.0))) == 500.0
+        assert float(jf(jnp.float32(2.0))) == 20.0
+        assert float(jf(jnp.float32(0.5))) == 0.5
+
+    def test_switch_case_eager(self):
+        mk = lambda v: (lambda: paddle.to_tensor(np.float32(v)))
+        out = switch_case(paddle.to_tensor(np.int64(1)),
+                          {1: mk(10.0), 2: mk(20.0)}, default=mk(-1.0))
+        assert float(out.numpy()) == 10.0
+        out = switch_case(paddle.to_tensor(np.int64(7)),
+                          {1: mk(10.0), 2: mk(20.0)}, default=mk(-1.0))
+        assert float(out.numpy()) == -1.0
+
+    def test_switch_case_in_trace_sparse_keys(self):
+        def f(i):
+            mk = lambda v: (lambda: paddle.to_tensor(jnp.float32(v)))
+            out = switch_case(paddle.to_tensor(i),
+                              {3: mk(30.0), 10: mk(100.0)},
+                              default=mk(-1.0))
+            return out._data
+        jf = jax.jit(f)
+        assert float(jf(jnp.int32(3))) == 30.0
+        assert float(jf(jnp.int32(10))) == 100.0
+        assert float(jf(jnp.int32(4))) == -1.0
+
+    def test_duplicate_keys_error(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            switch_case(paddle.to_tensor(0),
+                        [(0, lambda: None), (0, lambda: None)])
