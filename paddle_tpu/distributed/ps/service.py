@@ -189,6 +189,10 @@ class PsServer:
             t.push(bufs[0].astype(np.int64), bufs[1].astype(np.float32),
                    lr=header.get("lr"))
             return {"ok": True}, []
+        if op == "graph":
+            # GNN tier: delegate to GraphTable.dispatch (graph_brpc_server
+            # sample_neighbors / node_feat / degree ops)
+            return self.tables[header["table"]].dispatch(header, bufs)
         if op == "heartbeat":
             self.monitor.beat(header["worker"])
             return {"ok": True, "time": time.time()}, []
@@ -210,8 +214,8 @@ class PsServer:
             return {"ok": True}, []
         if op == "stat":
             return {"ok": True,
-                    "tables": {n: {"rows": t.num_embeddings,
-                                   "dim": t.embedding_dim}
+                    "tables": {n: {"rows": getattr(t, "num_embeddings", 0),
+                                   "dim": getattr(t, "embedding_dim", 0)}
                                for n, t in self.tables.items()},
                     "workers": self.monitor.workers(),
                     "dead": self.monitor.dead_workers()}, []
