@@ -65,6 +65,7 @@ from paddle_tpu import parallel  # noqa: E402,F401
 from paddle_tpu import distributed  # noqa: E402,F401
 from paddle_tpu import distribution  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
+from paddle_tpu import quantization  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401
 from paddle_tpu import text  # noqa: E402,F401
 from paddle_tpu import models  # noqa: E402,F401

@@ -1,0 +1,156 @@
+"""Quantization (slim) tier — QAT fake-quant + post-training quantization.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/ —
+  * quantization_pass.py fake_quantize_abs_max /
+    fake_quantize_moving_average_abs_max / channel-wise variants (the op
+    kernels live in operators/fake_quantize_op.cc);
+  * imperative/qat.py ImperativeQuantAware — swaps Linear/Conv2D for
+    quantized counterparts that fake-quant weights + activations;
+  * post_training_quantization.py — calibrate abs-max over sample data,
+    then store int8 weights + scales.
+
+TPU notes: int8 matmul on the MXU is not exposed through jax today, so
+the *execution* of quantized layers stays bf16/fp32 with
+quantize→dequantize applied (exactly what the reference's fake-quant
+training path computes); the artifacts (int8 weights + scales from PTQ)
+are the deployment contract.  Gradients flow via the straight-through
+estimator: ``x + stop_gradient(q(x) - x)`` — identity backward, quantized
+forward, matching fake_quantize_op's grad kernel.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply1
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["fake_quantize_dequantize_abs_max",
+           "fake_channel_wise_quantize_dequantize_abs_max",
+           "MovingAverageAbsMaxObserver", "QuantizedLinear",
+           "ImperativeQuantAware", "quant_post_weights", "dequant_weights"]
+
+
+def _qmax(bits: int) -> float:
+    return float(2 ** (bits - 1) - 1)
+
+
+def fake_quantize_dequantize_abs_max(x, bits: int = 8, name=None):
+    """operators/fake_quantize_op.cc FakeQuantizeDequantizeAbsMax: scale =
+    max|x|; straight-through gradient."""
+    qm = _qmax(bits)
+
+    def _q(a):
+        scale = jnp.maximum(jnp.max(jnp.abs(a)), 1e-8)
+        q = jnp.round(a / scale * qm) / qm * scale
+        return a + jax.lax.stop_gradient(q - a)
+    return apply1(_q, x, name="fake_quant_dequant_abs_max")
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bits: int = 8,
+                                                  quant_axis: int = 0,
+                                                  name=None):
+    """Per-output-channel scales (fake_channel_wise_quantize_op) — the
+    weight path of QAT conv/linear."""
+    qm = _qmax(bits)
+
+    def _q(a):
+        axes = tuple(i for i in range(a.ndim) if i != quant_axis)
+        scale = jnp.maximum(jnp.max(jnp.abs(a), axis=axes, keepdims=True),
+                            1e-8)
+        q = jnp.round(a / scale * qm) / qm * scale
+        return a + jax.lax.stop_gradient(q - a)
+    return apply1(_q, x, name="fake_channel_wise_quant")
+
+
+class MovingAverageAbsMaxObserver:
+    """fake_quantize_moving_average_abs_max state machine (rate 0.9) for
+    activation scales."""
+
+    def __init__(self, rate: float = 0.9):
+        self.rate = rate
+        self.scale: Optional[float] = None
+
+    def update(self, x) -> float:
+        cur = float(jnp.max(jnp.abs(
+            x._data if isinstance(x, Tensor) else jnp.asarray(x))))
+        self.scale = cur if self.scale is None else \
+            self.rate * self.scale + (1 - self.rate) * cur
+        return max(self.scale, 1e-8)
+
+    def quantize(self, x, bits: int = 8):
+        qm = _qmax(bits)
+        scale = self.update(x)
+
+        def _q(a):
+            q = jnp.clip(jnp.round(a / scale * qm), -qm, qm) / qm * scale
+            return a + jax.lax.stop_gradient(q - a)
+        return apply1(_q, x, name="fake_quant_moving_avg")
+
+
+class QuantizedLinear(Layer):
+    """imperative/qat.py QuantizedLinear: fake-quant weight (channel-wise)
+    and input activation (moving-average) around the dense matmul."""
+
+    def __init__(self, linear, weight_bits: int = 8, activation_bits: int = 8):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = getattr(linear, "bias", None)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._observer = MovingAverageAbsMaxObserver()
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        xq = self._observer.quantize(x, self.activation_bits)
+        wq = fake_channel_wise_quantize_dequantize_abs_max(
+            self.weight, self.weight_bits, quant_axis=1)
+        return F.linear(xq, wq, self.bias)
+
+
+class ImperativeQuantAware:
+    """imperative/qat.py ImperativeQuantAware.quantize: in-place module
+    swap Linear→QuantizedLinear (the reference also covers Conv2D; conv
+    follows the same recipe via fake_channel_wise on axis 0)."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def quantize(self, model: Layer) -> Layer:
+        from paddle_tpu.nn.layer.common import Linear
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, Linear):
+                model._sub_layers[name] = QuantizedLinear(
+                    child, self.weight_bits, self.activation_bits)
+            else:
+                self.quantize(child)
+        return model
+
+
+# ---------------------------------------------------------------------------
+# post-training (weight) quantization
+# ---------------------------------------------------------------------------
+
+def quant_post_weights(model: Layer, bits: int = 8) -> Dict[str, dict]:
+    """post_training_quantization.py weight path: per-channel int8 weights
+    + float scales for every Linear weight; returns the deployment dict
+    {param_name: {"int": int8 array, "scale": [out] scales}}."""
+    qm = _qmax(bits)
+    out = {}
+    for name, p in model.named_parameters():
+        if p._data.ndim != 2 or not name.endswith("weight"):
+            continue
+        w = np.asarray(p._data, np.float32)
+        scale = np.maximum(np.abs(w).max(axis=0), 1e-8)      # per out-col
+        q = np.clip(np.round(w / scale * qm), -qm, qm).astype(np.int8)
+        out[name] = {"int": q, "scale": (scale / qm).astype(np.float32)}
+    return out
+
+
+def dequant_weights(packed: Dict[str, dict]) -> Dict[str, np.ndarray]:
+    return {n: d["int"].astype(np.float32) * d["scale"]
+            for n, d in packed.items()}

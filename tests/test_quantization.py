@@ -1,0 +1,112 @@
+"""Quantization tier (fluid/contrib/slim/quantization roles): fake-quant
+ops + STE gradients, QAT module swap + training, PTQ weight packing."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.quantization import (ImperativeQuantAware,
+                                     MovingAverageAbsMaxObserver,
+                                     QuantizedLinear, dequant_weights,
+                                     fake_channel_wise_quantize_dequantize_abs_max,
+                                     fake_quantize_dequantize_abs_max,
+                                     quant_post_weights)
+
+
+class TestFakeQuant:
+    def test_abs_max_values(self):
+        x = np.array([-1.0, 0.3, 0.5, 1.27], np.float32)
+        out = fake_quantize_dequantize_abs_max(
+            paddle.to_tensor(x)).numpy()
+        scale = 1.27
+        exp = np.round(x / scale * 127) / 127 * scale
+        np.testing.assert_allclose(out, exp, rtol=1e-6)
+        # 8-bit grid: at most 255 distinct levels
+        assert np.abs(out - x).max() <= scale / 127
+
+    def test_ste_gradient_is_identity(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 16).astype(np.float32))
+        x.stop_gradient = False
+        y = fake_quantize_dequantize_abs_max(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(16), rtol=1e-6)
+
+    def test_channel_wise_scales(self):
+        w = np.stack([np.linspace(-1, 1, 8),
+                      np.linspace(-100, 100, 8)]).astype(np.float32)
+        out = fake_channel_wise_quantize_dequantize_abs_max(
+            paddle.to_tensor(w), quant_axis=0).numpy()
+        # each row quantized against its own scale → both rows accurate
+        assert np.abs(out[0] - w[0]).max() <= 1 / 127 + 1e-6
+        assert np.abs(out[1] - w[1]).max() <= 100 / 127 + 1e-6
+
+    def test_moving_average_observer(self):
+        obs = MovingAverageAbsMaxObserver(rate=0.5)
+        obs.update(np.array([2.0], np.float32))
+        assert obs.scale == 2.0
+        obs.update(np.array([4.0], np.float32))
+        assert abs(obs.scale - 3.0) < 1e-6
+
+
+class TestQAT:
+    def test_module_swap(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.inner = nn.Sequential(nn.Linear(8, 8), nn.ReLU())
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(self.inner(F.relu(self.fc1(x))))
+
+        net = ImperativeQuantAware().quantize(Net())
+        assert isinstance(net.fc1, QuantizedLinear)
+        assert isinstance(net.fc2, QuantizedLinear)
+        assert isinstance(net.inner[0], QuantizedLinear)
+
+    def test_qat_trains(self):
+        paddle.seed(0)
+        net = ImperativeQuantAware().quantize(
+            nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2)))
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64)
+        losses = []
+        for _ in range(30):
+            loss = F.cross_entropy(net(paddle.to_tensor(x)),
+                                   paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.3, losses
+
+
+class TestPTQ:
+    def test_weight_pack_roundtrip(self):
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3))
+        packed = quant_post_weights(net)
+        assert len(packed) == 2
+        for name, d in packed.items():
+            assert d["int"].dtype == np.int8
+        deq = dequant_weights(packed)
+        for name, w in deq.items():
+            orig = dict(net.named_parameters())[name].numpy()
+            assert np.abs(w - orig).max() <= np.abs(orig).max() / 127 + 1e-6
+
+    def test_ptq_forward_close_to_fp32(self):
+        paddle.seed(2)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((32, 8)).astype(np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+        packed = quant_post_weights(net)
+        for name, w in dequant_weights(packed).items():
+            dict(net.named_parameters())[name].set_value(w)
+        out = net(paddle.to_tensor(x)).numpy()
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.05, rel
