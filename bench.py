@@ -15,9 +15,16 @@ the output as BENCH_r{N}.json; keep every line parseable on its own.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+# persistent XLA compile cache: repeat driver runs skip the 20-40s
+# per-model compiles (cache key includes topology + jax version)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(
+                          os.path.abspath(__file__)), ".jax_cache"))
 
 V100_BERT_TOKENS_PER_SEC = 25_000.0
 V100_RESNET50_SAMPLES_PER_SEC = 380.0

@@ -75,7 +75,7 @@ from paddle_tpu.hapi.model import Model  # noqa: E402,F401
 from paddle_tpu.hapi import summary, flops  # noqa: E402,F401
 from paddle_tpu.nn.layer.common import ParamAttr  # noqa: E402,F401
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 
 def is_compiled_with_cuda() -> bool:

@@ -10,4 +10,7 @@ from paddle_tpu.nn.functional.pooling import *  # noqa: F401,F403
 from paddle_tpu.tensor.manipulation import one_hot  # noqa: F401
 from paddle_tpu.tensor.sequence import (  # noqa: F401
     embedding_bag, sequence_mask, sequence_pad, sequence_unpad,
-    sequence_pool, sequence_softmax, sequence_reverse, segment_softmax)
+    sequence_pool, sequence_softmax, sequence_reverse, segment_softmax,
+    sequence_concat, sequence_enumerate, sequence_expand_as,
+    sequence_first_step, sequence_last_step)
+from paddle_tpu.nn.functional.extras import *  # noqa: F401,F403

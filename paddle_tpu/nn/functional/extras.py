@@ -1,0 +1,313 @@
+"""Functional surface completions + fluid-era aliases.
+
+The reference's ``paddle.nn.functional`` re-exports a long tail of
+fluid.layers ops; the ones with their own kernels here:
+  * grid_sample      — operators/grid_sampler_op.h (bilinear, zeros pad)
+  * affine_grid      — operators/affine_grid_op.h
+  * temporal_shift   — operators/temporal_shift_op.h (TSM video models)
+  * bilinear_tensor_product — operators/bilinear_tensor_product_op.h
+  * hsigmoid_loss    — operators/hierarchical_sigmoid_op.h (dense-path
+    variant: the id tree is a complete binary tree over classes; the
+    reference's custom-tree mode maps onto explicit path/ code inputs)
+  * diag_embed, erf  — tensor kernels surfaced through functional
+Pure re-exports (same op living elsewhere in this framework) are aliased
+at the bottom — the reference does exactly this from fluid.layers.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor, apply1
+
+__all__ = ["grid_sample", "affine_grid", "temporal_shift",
+           "bilinear_tensor_product", "hsigmoid_loss", "diag_embed", "erf",
+           # aliases
+           "roi_align", "roi_pool", "yolo_box", "prior_box", "box_coder",
+           "image_resize", "resize_bilinear", "resize_nearest", "smooth_l1",
+           "warpctc", "fc", "pool2d", "sequence_conv"]
+
+
+def erf(x, name=None):
+    return apply1(jax.scipy.special.erf, x, name="erf")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """operators/diag_embed_op.h: batch of vectors -> batch of diagonal
+    matrices."""
+    def _d(a):
+        n = a.shape[-1] + abs(offset)
+        out_shape = a.shape[:-1] + (n, n)
+        out = jnp.zeros(out_shape, a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = []
+        src = {d1: nd - 2, d2: nd - 1}
+        it = iter(perm)
+        for i in range(nd):
+            order.append(src[i] if i in src else next(it))
+        return jnp.transpose(out, order)
+    return apply1(_d, input, name="diag_embed")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """operators/affine_grid_op.h: theta [N,2,3] + (N,C,H,W) -> sampling
+    grid [N,H,W,2] in [-1,1] coords."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    N, _C, H, W = [int(v) for v in out_shape]
+
+    def _base(n, align):
+        if align:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    def _g(th):
+        ys = _base(H, align_corners)
+        xs = _base(W, align_corners)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", base, th)     # [N, H, W, 2]
+    return apply1(_g, theta, name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """operators/grid_sampler_op.h: sample NCHW input at grid [N,H',W',2]
+    (xy in [-1,1]).  modes: bilinear/nearest; padding: zeros/border."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(mode)
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(padding_mode)
+
+    def _unnorm(coord, size):
+        if align_corners:
+            return (coord + 1) / 2 * (size - 1)
+        return ((coord + 1) * size - 1) / 2
+
+    def _s(a, g):
+        N, C, H, W = a.shape
+        gx = _unnorm(g[..., 0], W)
+        gy = _unnorm(g[..., 1], H)
+        if padding_mode == "reflection":
+            def refl(v, size):
+                span = 2 * (size - 1) if align_corners else 2 * size
+                v = jnp.abs(v) % (span if span > 0 else 1)
+                return jnp.minimum(v, span - v)
+            gx, gy = refl(gx, W), refl(gy, H)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, H - 1)
+            ixc = jnp.clip(ix, 0, W - 1)
+            out = a[jnp.arange(N)[:, None, None], :, iyc, ixc]   # [N,h,w,C]
+            if padding_mode == "zeros":
+                valid = ((iy >= 0) & (iy < H) & (ix >= 0) &
+                         (ix < W))[..., None]
+                out = jnp.where(valid, out, 0.0)
+            return out
+
+        if mode == "nearest":
+            out = gather(jnp.round(gy).astype(jnp.int32),
+                         jnp.round(gx).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(gx).astype(jnp.int32)
+            y0 = jnp.floor(gy).astype(jnp.int32)
+            wx = (gx - x0)[..., None]
+            wy = (gy - y0)[..., None]
+            out = (gather(y0, x0) * (1 - wx) * (1 - wy) +
+                   gather(y0, x0 + 1) * wx * (1 - wy) +
+                   gather(y0 + 1, x0) * (1 - wx) * wy +
+                   gather(y0 + 1, x0 + 1) * wx * wy)
+        return jnp.transpose(out, (0, 3, 1, 2))          # -> NCHW'
+    return apply1(_s, x, grid, name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """operators/temporal_shift_op.h (TSM): [N*T, C, H, W]; first
+    shift_ratio*C channels shift t-1, next block shifts t+1."""
+    def _t(a):
+        NT, C, H, W = a.shape
+        T = seg_num
+        n = NT // T
+        v = a.reshape(n, T, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        pad = jnp.zeros((n, 1, C, H, W), a.dtype)
+        fwd = jnp.concatenate([v[:, 1:], pad], axis=1)     # shift left
+        bwd = jnp.concatenate([pad, v[:, :-1]], axis=1)    # shift right
+        out = jnp.concatenate([fwd[:, :, :c1], bwd[:, :, c1:c2],
+                               v[:, :, c2:]], axis=2)
+        return out.reshape(NT, C, H, W)
+    return apply1(_t, x, name="temporal_shift")
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    """operators/bilinear_tensor_product_op.h: out[:, k] = x W_k y^T."""
+    def _b(a, b, w, *rest):
+        out = jnp.einsum("bi,kij,bj->bk", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x, y, weight) + ((bias,) if bias is not None else ())
+    return apply1(_b, *args, name="bilinear_tensor_product")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """operators/hierarchical_sigmoid_op.h, default-tree mode: classes sit
+    at the leaves of a complete binary tree with num_classes-1 internal
+    nodes; the loss is sum of binary cross-entropies along the root→leaf
+    path.  Custom trees pass path_table [N, L] (internal-node ids, -1 pad)
+    and path_code [N, L] (0/1 branch codes)."""
+    import numpy as np
+    if path_table is None:
+        nc = int(num_classes)
+        depth = max(1, math.ceil(math.log2(max(nc, 2))))
+        table = np.full((nc, depth), -1, np.int64)
+        code = np.zeros((nc, depth), np.int64)
+        for cls in range(nc):
+            node = cls + (1 << depth)         # leaf id in implicit heap
+            path = []
+            while node > 1:
+                parent = node // 2
+                path.append((parent - 1, node % 2))
+                node = parent
+            for d, (nid, bit) in enumerate(reversed(path)):
+                if nid < nc - 1:
+                    table[cls, d] = nid
+                    code[cls, d] = bit
+        table_t = Tensor(jnp.asarray(table))
+        code_t = Tensor(jnp.asarray(code))
+
+        def _h(a, lbl, w, tbl, cd, *rest):
+            t = jnp.take(tbl, lbl.astype(jnp.int32), axis=0)  # [N, L]
+            c = jnp.take(cd, lbl.astype(jnp.int32), axis=0)
+            valid = t >= 0
+            tc = jnp.maximum(t, 0)
+            wp = jnp.take(w, tc, axis=0)                      # [N, L, D]
+            logits = jnp.einsum("nld,nd->nl", wp, a)
+            if rest:
+                logits = logits + jnp.take(rest[0], tc, axis=0)
+            # bce with code as target (code 1 = right branch)
+            lp = jax.nn.log_sigmoid(logits)
+            ln = jax.nn.log_sigmoid(-logits)
+            loss = -(c * lp + (1 - c) * ln)
+            return jnp.sum(jnp.where(valid, loss, 0.0),
+                           axis=1, keepdims=True)
+        args = (input, label, weight, table_t, code_t) + (
+            (bias,) if bias is not None else ())
+        return apply1(_h, *args, nondiff=(1, 3, 4), name="hsigmoid_loss")
+
+    def _h2(a, tbl, cd, w, *rest):
+        valid = tbl >= 0
+        tc = jnp.maximum(tbl, 0).astype(jnp.int32)
+        wp = jnp.take(w, tc, axis=0)
+        logits = jnp.einsum("nld,nd->nl", wp, a)
+        if rest:
+            logits = logits + jnp.take(rest[0], tc, axis=0)
+        lp = jax.nn.log_sigmoid(logits)
+        ln = jax.nn.log_sigmoid(-logits)
+        loss = -(cd * lp + (1 - cd) * ln)
+        return jnp.sum(jnp.where(valid, loss, 0.0), axis=1, keepdims=True)
+    args = (input, path_table, path_code, weight) + (
+        (bias,) if bias is not None else ())
+    return apply1(_h2, *args, nondiff=(1, 2), name="hsigmoid_loss")
+
+
+# ---------------------------------------------------------------------------
+# aliases: same capability living elsewhere in the framework
+# ---------------------------------------------------------------------------
+
+def _alias(modpath, attr):
+    def fn(*args, **kwargs):
+        import importlib
+        mod = importlib.import_module(modpath)
+        return getattr(mod, attr)(*args, **kwargs)
+    fn.__name__ = attr
+    fn.__doc__ = f"alias of {modpath}.{attr}"
+    return fn
+
+
+roi_align = _alias("paddle_tpu.vision.ops", "roi_align")
+roi_pool = _alias("paddle_tpu.vision.ops", "roi_pool")
+yolo_box = _alias("paddle_tpu.vision.ops", "yolo_box")
+prior_box = _alias("paddle_tpu.vision.ops", "prior_box")
+box_coder = _alias("paddle_tpu.vision.ops", "box_coder")
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 **kw):
+    from paddle_tpu.nn.functional.common import interpolate
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode=resample.lower())
+
+
+def resize_bilinear(input, out_shape=None, scale=None, **kw):
+    return image_resize(input, out_shape, scale, "BILINEAR")
+
+
+def resize_nearest(input, out_shape=None, scale=None, **kw):
+    return image_resize(input, out_shape, scale, "NEAREST")
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    from paddle_tpu.nn.functional.loss import smooth_l1_loss
+    return smooth_l1_loss(x, y, reduction="none")
+
+
+def warpctc(input, label, input_length=None, label_length=None, blank=0,
+            norm_by_times=False):
+    from paddle_tpu.nn.functional.loss import ctc_loss
+    return ctc_loss(input, label, input_length, label_length, blank=blank)
+
+
+def fc(x, size, num_flatten_dims=1, weight=None, bias=None, name=None):
+    from paddle_tpu.nn.functional.common import linear
+    if weight is None:
+        raise ValueError("paddle_tpu fc is functional: pass weight "
+                         "explicitly (the reference auto-creates params "
+                         "in global scope, which does not exist here)")
+    return linear(x, weight, bias)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, **kw):
+    import paddle_tpu.nn.functional as F
+    if global_pooling:
+        pool_size = input.shape[2:]
+    f = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    return f(input, pool_size, pool_stride, pool_padding)
+
+
+def sequence_conv(input, lengths, weight, bias=None, context_length=3,
+                  padding=True, name=None):
+    """operators/sequence_ops/sequence_conv_op.h on the padded-dense
+    encoding: context-window features -> linear projection."""
+    def _sc(a, lens, w, *rest):
+        b, t, d = a.shape
+        half = context_length // 2
+        ctx = jnp.concatenate([jnp.zeros((b, half, d), a.dtype), a,
+                               jnp.zeros((b, context_length - 1 - half, d),
+                                         a.dtype)], axis=1)
+        windows = jnp.concatenate(
+            [ctx[:, i:i + t] for i in range(context_length)], axis=-1)
+        out = jnp.einsum("btk,ko->bto", windows, w)
+        if rest:
+            out = out + rest[0]
+        mask = (jnp.arange(t)[None, :] <
+                lens.astype(jnp.int32)[:, None])[..., None]
+        return jnp.where(mask, out, 0.0)
+    args = (input, lengths, weight) + ((bias,) if bias is not None else ())
+    return apply1(_sc, *args, nondiff=(1,), name="sequence_conv")

@@ -15,7 +15,19 @@ __all__ = ["ParamAttr", "Linear", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Embedding", "Flatten", "Upsample",
            "UpsamplingBilinear2D", "UpsamplingNearest2D", "Bilinear",
            "CosineSimilarity", "PairwiseDistance", "Pad1D", "Pad2D", "Pad3D",
-           "ZeroPad2D", "Identity", "Unfold", "Fold"]
+           "ZeroPad2D", "Identity", "Unfold", "Fold", "PixelShuffle"]
+
+
+class PixelShuffle(Layer):
+    """reference: nn/layer/vision.py PixelShuffle (pixel_shuffle_op.h)."""
+
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
 
 
 class ParamAttr:

@@ -8,7 +8,7 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
            "CTCLoss", "SigmoidFocalLoss", "TripletMarginLoss",
-           "SoftMarginLoss"]
+           "SoftMarginLoss", "HSigmoidLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -180,3 +180,35 @@ class SoftMarginLoss(Layer):
 
     def forward(self, input, label):
         return F.soft_margin_loss(input, label, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classification head (reference:
+    nn/layer/loss.py HSigmoidLoss over hierarchical_sigmoid_op.h).
+    Default complete-binary-tree mode; custom trees pass path_table /
+    path_code to forward."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        import numpy as np
+
+        from paddle_tpu.core import Parameter
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        n_nodes = num_classes - 1
+        std = 1.0 / max(1.0, feature_size ** 0.5)
+        rng = np.random.default_rng(0)
+        self.weight = Parameter(rng.uniform(
+            -std, std, (n_nodes, feature_size)).astype(np.float32),
+            name="hsigmoid_w")
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Parameter(np.zeros((n_nodes,), np.float32),
+                                  name="hsigmoid_b")
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)

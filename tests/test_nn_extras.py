@@ -1,0 +1,164 @@
+"""New functional-surface ops (grid_sample/affine_grid/temporal_shift/
+bilinear_tensor_product/hsigmoid/diag_embed) — torch CPU as the oracle
+where it implements the same kernel (the reference's own op tests compare
+against handwritten numpy; torch matches those semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from op_test import check_grad
+
+torch = pytest.importorskip("torch")
+RNG = np.random.default_rng(0)
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("align", [True, False])
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    def test_matches_torch(self, mode, align):
+        x = RNG.standard_normal((2, 3, 5, 6)).astype(np.float32)
+        grid = (RNG.random((2, 4, 4, 2)) * 2 - 1).astype(np.float32)
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode=mode, align_corners=align).numpy()
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode,
+            padding_mode="zeros", align_corners=align).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_border_padding(self):
+        x = RNG.standard_normal((1, 1, 4, 4)).astype(np.float32)
+        grid = np.array([[[[-2.0, -2.0], [2.0, 2.0]]]], np.float32)
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            padding_mode="border").numpy()
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), padding_mode="border",
+            align_corners=True).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_grad(self):
+        x = RNG.standard_normal((1, 2, 4, 4)).astype(np.float64)
+        grid = (RNG.random((1, 3, 3, 2)) * 1.6 - 0.8).astype(np.float64)
+        check_grad(lambda a: F.grid_sample(
+            a, paddle.to_tensor(grid)), [x], atol=2e-3)
+
+
+class TestAffineGrid:
+    @pytest.mark.parametrize("align", [True, False])
+    def test_matches_torch(self, align):
+        theta = RNG.standard_normal((2, 2, 3)).astype(np.float32)
+        out = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                            align_corners=align).numpy()
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(theta), [2, 3, 4, 5],
+            align_corners=align).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_composes_with_grid_sample_identity(self):
+        x = RNG.standard_normal((1, 1, 6, 6)).astype(np.float32)
+        theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+        g = F.affine_grid(paddle.to_tensor(theta), [1, 1, 6, 6])
+        out = F.grid_sample(paddle.to_tensor(x), g).numpy()
+        np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+class TestTemporalShift:
+    def test_shift_semantics(self):
+        T, C = 4, 8
+        x = np.arange(1 * T * C).reshape(T, C, 1, 1).astype(np.float32)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=T,
+                               shift_ratio=0.25).numpy()
+        c1 = C // 4
+        # first quarter channels pull from t+1 (zero at the end)
+        np.testing.assert_allclose(out[:-1, :c1], x[1:, :c1])
+        np.testing.assert_allclose(out[-1, :c1], 0.0)
+        # second quarter pulls from t-1 (zero at the start)
+        np.testing.assert_allclose(out[1:, c1:2 * c1], x[:-1, c1:2 * c1])
+        np.testing.assert_allclose(out[0, c1:2 * c1], 0.0)
+        # rest untouched
+        np.testing.assert_allclose(out[:, 2 * c1:], x[:, 2 * c1:])
+
+
+class TestBilinearHsigmoidDiag:
+    def test_bilinear_tensor_product(self):
+        x = RNG.standard_normal((3, 4)).astype(np.float64)
+        y = RNG.standard_normal((3, 5)).astype(np.float64)
+        w = RNG.standard_normal((2, 4, 5)).astype(np.float64)
+        out = F.bilinear_tensor_product(
+            paddle.to_tensor(x), paddle.to_tensor(y),
+            paddle.to_tensor(w)).numpy()
+        ref = np.einsum("bi,kij,bj->bk", x, w, y)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        check_grad(lambda a, b, c: F.bilinear_tensor_product(a, b, c),
+                   [x, y, w], wrt=(0, 1, 2))
+
+    def test_diag_embed(self):
+        x = RNG.standard_normal((2, 3)).astype(np.float32)
+        out = F.diag_embed(paddle.to_tensor(x)).numpy()
+        ref = torch.diag_embed(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        out1 = F.diag_embed(paddle.to_tensor(x), offset=1).numpy()
+        ref1 = torch.diag_embed(torch.tensor(x), offset=1).numpy()
+        np.testing.assert_allclose(out1, ref1, rtol=1e-6)
+
+    def test_erf(self):
+        x = np.linspace(-2, 2, 9).astype(np.float32)
+        from scipy.special import erf as serf
+        np.testing.assert_allclose(F.erf(paddle.to_tensor(x)).numpy(),
+                                   serf(x), rtol=1e-5)
+
+    def test_hsigmoid_trains(self):
+        paddle.seed(0)
+        n_cls, dim, b = 8, 16, 32
+        head = nn.HSigmoidLoss(dim, n_cls)
+        proj = nn.Linear(4, dim)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.1,
+            parameters=proj.parameters() + head.parameters())
+        x = RNG.standard_normal((b, 4)).astype(np.float32)
+        y = (x.argmax(1) * 2).astype(np.int64)          # classes 0,2,4,6
+        losses = []
+        for _ in range(100):
+            feat = proj(paddle.to_tensor(x))
+            loss = head(feat, paddle.to_tensor(y)).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
+
+    def test_hsigmoid_path_is_log2(self):
+        """loss of a uniform-logit model ≈ depth * log 2."""
+        n_cls, dim = 16, 8
+        head = nn.HSigmoidLoss(dim, n_cls, bias_attr=False)
+        head.weight.set_value(np.zeros_like(head.weight.numpy()))
+        x = paddle.to_tensor(np.ones((4, dim), np.float32))
+        y = paddle.to_tensor(np.array([0, 5, 10, 15], np.int64))
+        loss = head(x, y).numpy()
+        np.testing.assert_allclose(loss, np.log(2.0) * 4, rtol=1e-5)
+
+
+class TestLayerAndAliases:
+    def test_pixel_shuffle_layer(self):
+        x = RNG.standard_normal((1, 8, 3, 3)).astype(np.float32)
+        out = nn.PixelShuffle(2)(paddle.to_tensor(x)).numpy()
+        ref = torch.nn.functional.pixel_shuffle(torch.tensor(x), 2).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_aliases_resolve(self):
+        assert F.roi_align.__doc__.startswith("alias of")
+        x = RNG.standard_normal((1, 1, 4, 4)).astype(np.float32)
+        out = F.resize_nearest(paddle.to_tensor(x), out_shape=[8, 8])
+        assert list(out.shape) == [1, 1, 8, 8]
+
+    def test_sequence_conv(self):
+        x = RNG.standard_normal((2, 5, 3)).astype(np.float64)
+        lens = np.array([5, 2], np.int64)
+        w = RNG.standard_normal((9, 4)).astype(np.float64)
+        out = F.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(lens),
+                              paddle.to_tensor(w)).numpy()
+        assert out.shape == (2, 5, 4)
+        assert np.allclose(out[1, 2:], 0.0)      # masked past length
+        check_grad(lambda a, ww: F.sequence_conv(
+            a, paddle.to_tensor(lens), ww), [x, w], wrt=(0, 1))
