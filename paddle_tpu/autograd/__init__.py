@@ -104,27 +104,36 @@ def _run_engine(root_tensors, root_grads, retain_graph=False,
             in_grads = node.vjp_fn(tuple(cots))
         else:
             in_grads = node.vjp_fn(cots[0])
+        from paddle_tpu.framework.selected_rows import SelectedRows
         for t, g in zip(node.inputs, in_grads):
             if g is None:
                 continue
             if t._hooks:
-                gt = Tensor(g)
+                gt = g if isinstance(g, SelectedRows) else Tensor(g)
                 for hook in list(t._hooks):
                     res = hook(gt)
                     if res is not None:
-                        gt = res if isinstance(res, Tensor) else Tensor(res)
-                g = gt._data
+                        gt = res if isinstance(
+                            res, (Tensor, SelectedRows)) else Tensor(res)
+                g = gt._data if isinstance(gt, Tensor) else gt
             add_cotangent(t, g)
         if not retain_graph:
             node.vjp_fn = None
 
-    # write .grad on leaves
+    # write .grad on leaves (SelectedRows stays row-sparse; mixing with a
+    # dense grad densifies — selected_rows_functor SelectedRowsAddTensor)
+    from paddle_tpu.framework.selected_rows import SelectedRows
     for key, arr in leaf_cots.items():
         t = _leaf_refs[key]
-        if t._grad is None:
-            t._grad = Tensor(arr)
-        else:
-            t._grad = Tensor(t._grad._data + arr)
+        if t._grad is not None:
+            prev = t._grad._data if isinstance(t._grad, Tensor) else t._grad
+            if isinstance(prev, SelectedRows):
+                arr = prev + arr
+            elif isinstance(arr, SelectedRows):
+                arr = arr + prev
+            else:
+                arr = prev + arr
+        t._grad = arr if isinstance(arr, SelectedRows) else Tensor(arr)
 
     if not retain_graph:
         for node in order:

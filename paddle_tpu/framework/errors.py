@@ -1,0 +1,125 @@
+"""Typed error taxonomy + enforce helpers.
+
+Reference: paddle/fluid/platform/errors.h (the 12 REGISTER_ERROR types at
+:71-82) and enforce.h's PADDLE_ENFORCE_* macro family — every kernel and
+framework check raises a *typed* error with an actionable message, and
+the python layer re-exports the types (fluid/core EnforceNotMet
+subclasses).
+
+The enforce helpers mirror the macros' spirit: one-line checks that
+produce the reference's "Expected X, but received Y" message shape, so
+error text stays greppable across the two codebases.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError",
+    "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
+    "FatalError", "ExternalError",
+    "enforce", "enforce_eq", "enforce_gt", "enforce_ge", "enforce_lt",
+    "enforce_le", "enforce_not_none", "enforce_shape",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all typed framework errors (enforce.h EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, LookupError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExternalError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, msg: str, error=InvalidArgumentError):
+    """PADDLE_ENFORCE(cond, ...): raise typed error when cond is false."""
+    if not cond:
+        raise error(msg)
+
+
+def _cmp(a, b, op, sym, msg, error):
+    if not op(a, b):
+        raise error(f"Expected {a!r} {sym} {b!r}."
+                    + (f" {msg}" if msg else ""))
+
+
+def enforce_eq(a, b, msg: str = "", error=InvalidArgumentError):
+    _cmp(a, b, lambda x, y: x == y, "==", msg, error)
+
+
+def enforce_gt(a, b, msg: str = "", error=InvalidArgumentError):
+    _cmp(a, b, lambda x, y: x > y, ">", msg, error)
+
+
+def enforce_ge(a, b, msg: str = "", error=InvalidArgumentError):
+    _cmp(a, b, lambda x, y: x >= y, ">=", msg, error)
+
+
+def enforce_lt(a, b, msg: str = "", error=InvalidArgumentError):
+    _cmp(a, b, lambda x, y: x < y, "<", msg, error)
+
+
+def enforce_le(a, b, msg: str = "", error=InvalidArgumentError):
+    _cmp(a, b, lambda x, y: x <= y, "<=", msg, error)
+
+
+def enforce_not_none(v, name: str = "value", error=NotFoundError):
+    if v is None:
+        raise error(f"Expected {name} to be set, but received None.")
+    return v
+
+
+def enforce_shape(tensor, expected, name: str = "tensor",
+                  error=InvalidArgumentError):
+    """Shape check with -1 wildcards (the ENFORCE pattern of every
+    InferShape): enforce_shape(x, [None, 3], "x")."""
+    shape = list(getattr(tensor, "shape", tensor))
+    if len(shape) != len(expected) or any(
+            e not in (None, -1) and int(e) != int(s)
+            for s, e in zip(shape, expected)):
+        raise error(f"Expected {name}.shape compatible with "
+                    f"{list(expected)}, but received {shape}.")

@@ -1,0 +1,83 @@
+"""SelectedRows — row-sparse gradients.
+
+Reference: paddle/fluid/framework/selected_rows.h (rows_ + value_ +
+height_), the merge-add in operators/math/selected_rows_functor.cc
+(MergeAdd), and the sparse update modes of sgd_op.h / adam_op.h
+(lazy_mode).  In the reference, lookup_table_op with is_sparse=True emits
+a SelectedRows gradient so a trillion-row table never materialises a
+dense grad.
+
+TPU split: the *jitted* path never needs this (XLA fuses gather-grad
+scatters, and giant tables live in the PS tier); SelectedRows serves the
+*eager* tape, where a dense zeros(vocab, dim) per backward would bury
+the host for large vocabularies.  ``Embedding(sparse=True)`` produces
+one; optimizers apply row updates directly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """rows [n] int64 ids into a height-row table + values [n, ...]."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows, jnp.int64).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+
+    # -- arithmetic the autograd engine needs -------------------------------
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        # dense + sparse -> dense (reference: selected_rows_functor
+        # SelectedRowsAddTensor)
+        dense = jnp.asarray(other)
+        return dense.at[self.rows].add(self.values.astype(dense.dtype))
+
+    __radd__ = __add__
+
+    def __mul__(self, scalar):
+        return SelectedRows(self.rows, self.values * scalar, self.height)
+
+    __rmul__ = __mul__
+
+    def merge(self) -> "SelectedRows":
+        """MergeAdd (selected_rows_functor.cc): unique rows, summed
+        values — run before any optimizer update so duplicate ids in a
+        batch accumulate once."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0],
+                               fill_value=self.height)
+        acc = jnp.zeros((uniq.shape[0],) + self.values.shape[1:],
+                        self.values.dtype).at[inv].add(self.values)
+        keep = uniq < self.height
+        n = int(jnp.sum(keep))
+        order = jnp.argsort(~keep)            # real rows first
+        return SelectedRows(uniq[order][:n], acc[order][:n], self.height)
+
+    def to_dense(self):
+        out = jnp.zeros((self.height,) + self.values.shape[1:],
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"value_shape={tuple(self.values.shape)})")

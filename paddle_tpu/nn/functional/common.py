@@ -72,10 +72,17 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Lookup (reference: operators/lookup_table_v2_op).  ``sparse`` is
-    accepted; on TPU dense one-hot-free gather is already the fast path and
-    sparse grads are handled by the embedding-table subsystem
-    (paddle_tpu.distributed.ps) instead of SelectedRows."""
+    """Lookup (reference: operators/lookup_table_v2_op).
+
+    ``sparse=True`` on the eager tape emits a SelectedRows gradient
+    (framework/selected_rows.py) exactly like lookup_table's is_sparse —
+    no dense zeros(vocab, dim) per backward.  Under jit the dense path is
+    used regardless (XLA fuses the scatter; PS tier owns giant tables)."""
+    if sparse:
+        out = _sparse_embedding(x, weight, padding_idx)
+        if out is not None:
+            return out
+
     def _emb(ids, w):
         out = jnp.take(w, ids.astype(jnp.int32), axis=0)
         if padding_idx is not None:
@@ -83,6 +90,51 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, 0.0, out)
         return out
     return apply1(_emb, x, weight, nondiff=(0,), name="embedding")
+
+
+def _sparse_embedding(x, weight, padding_idx):
+    """Eager row-sparse lookup: custom TapeNode whose pullback returns a
+    SelectedRows (the lookup_table_grad SelectedRows branch,
+    operators/lookup_table_v2_op.h).  Returns None when the sparse path
+    does not apply (in-trace, non-leaf weight, grad off)."""
+    import weakref
+
+    from paddle_tpu.core import TapeNode, is_grad_enabled
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    if not isinstance(weight, Tensor) or not isinstance(x, Tensor):
+        return None
+    ids = x._data
+    warr = weight._data
+    if isinstance(ids, jax.core.Tracer) or isinstance(warr, jax.core.Tracer):
+        return None
+    if weight._node is not None:
+        # non-leaf weight: SelectedRows cannot flow through another
+        # node's array-typed vjp — use the dense path
+        return None
+    iarr = ids.astype(jnp.int32)
+    out = jnp.take(warr, iarr, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((iarr == padding_idx)[..., None], 0.0, out)
+    track = is_grad_enabled() and not weight.stop_gradient
+    t = Tensor(out, stop_gradient=not track)
+    if not track:
+        return t
+    height, dim = warr.shape
+
+    def vjp_fn(cot):
+        flat = cot.reshape(-1, dim)
+        rows = iarr.reshape(-1)
+        if padding_idx is not None:
+            flat = jnp.where((rows == padding_idx)[:, None], 0.0, flat)
+        return (SelectedRows(rows, flat, height),)
+
+    node = TapeNode(vjp_fn, [weight], [weakref.ref(t)],
+                    name="embedding_sparse", out_avals=[(out.shape,
+                                                         out.dtype)])
+    t._node = node
+    t._out_index = 0
+    t.is_leaf_ = False
+    return t
 
 
 def one_hot(x, num_classes, name=None):

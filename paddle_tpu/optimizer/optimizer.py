@@ -86,6 +86,7 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        from paddle_tpu.framework.selected_rows import SelectedRows
         lr = self.get_lr()
         params = self._parameter_list
         if params is None:
@@ -93,15 +94,32 @@ class Optimizer:
         grads_and_params = [(p, p._grad) for p in params
                             if p._grad is not None and p.trainable]
         if self._grad_clip is not None:
+            # clip operates on dense tensors; densify SelectedRows first
+            # (the reference likewise excludes sparse grads from global
+            # clipping or merges them — clip_op on SelectedRows densifies)
             clipped = self._grad_clip(
-                [(p, g) for p, g in grads_and_params])
+                [(p, Tensor(g.to_dense()) if isinstance(g, SelectedRows)
+                  else g) for p, g in grads_and_params])
             grads_and_params = clipped
         self._global_step += 1
         for p, g in grads_and_params:
             state = self._state_for(p)
             p_lr = lr * getattr(p, "optimize_attr",
                                 {"learning_rate": 1.0})["learning_rate"]
-            garr = g._data if isinstance(g, Tensor) else g
+            if isinstance(g, SelectedRows):
+                sr = g.merge()        # MergeAdd: duplicate ids accumulate
+                if hasattr(self, "update_sparse"):
+                    # row-sparse fast path (sgd_op.h / adam_op.h lazy_mode
+                    # SelectedRows branches); weight decay skipped like
+                    # the reference's sparse regularization behaviour
+                    new_p, new_state = self.update_sparse(
+                        p._data, sr, state, p_lr)
+                    p._data = new_p
+                    state.update(new_state)
+                    continue
+                garr = sr.to_dense()
+            else:
+                garr = g._data if isinstance(g, Tensor) else g
             garr = self._apply_decay(p, p._data, garr)
             new_p, new_state = self.update(p._data, garr, state, p_lr)
             p._data = new_p
@@ -188,6 +206,10 @@ class SGD(Optimizer):
 
     def update(self, param, grad, state, lr):
         return param - lr * grad, {}
+
+    def update_sparse(self, param, sr, state, lr):
+        """sgd_op.h SelectedRows branch: touch only the gradient rows."""
+        return param.at[sr.rows].add(-lr * sr.values.astype(param.dtype)), {}
 
 
 class Momentum(Optimizer):
@@ -305,6 +327,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def init_state(self, value):
         return {"moment1": jnp.zeros_like(value),
@@ -324,6 +347,28 @@ class Adam(Optimizer):
         new_p = param - lr_t * m / (jnp.sqrt(v) + eps)
         return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
                        "beta2_pow": b2p}
+
+    def update_sparse(self, param, sr, state, lr):
+        """adam_op.h lazy_mode SelectedRows branch: moments and param move
+        only on the gradient's rows (non-lazy semantics would decay every
+        row's moments; the reference defaults sparse Adam to lazy in
+        dygraph for exactly this cost reason).  Falls back to the dense
+        rule when lazy_mode=False."""
+        if not self._lazy_mode:
+            g = sr.to_dense()
+            return self.update(param, g, state, lr)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        rows, vals = sr.rows, sr.values.astype(param.dtype)
+        m_r = b1 * state["moment1"][rows] + (1 - b1) * vals
+        v_r = b2 * state["moment2"][rows] + (1 - b2) * vals * vals
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        new_p = param.at[rows].add(-lr_t * m_r / (jnp.sqrt(v_r) + eps))
+        return new_p, {
+            "moment1": state["moment1"].at[rows].set(m_r),
+            "moment2": state["moment2"].at[rows].set(v_r),
+            "beta1_pow": b1p, "beta2_pow": b2p}
 
 
 class AdamW(Adam):
