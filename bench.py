@@ -214,9 +214,10 @@ def bench_longseq_flash(on_accel):
     """Long-sequence *training* with the Pallas flash-attention fwd+bwd
     kernels — the config whose naive S×S backward would exhaust HBM
     (S=8192: scores alone are 8k×8k×nh×B ≈ 8 GiB fp32 per layer).
-    vs_baseline: tokens/s relative to the same model at S=2048 scaled by
-    the ideal O(S) cost ratio — 1.0 means the kernel holds its linear-
-    memory claim without a throughput cliff."""
+    vs_baseline is the raw throughput retention tokens/s(S=8k) /
+    tokens/s(S=2k): attention FLOPs/token grow ~4× over that range, so
+    anything ≥ ~0.5 means no quadratic-memory cliff; >1 happens when the
+    short-sequence config underutilises the chip (B=1, S=2k)."""
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.jit import TrainStep

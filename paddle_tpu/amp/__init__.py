@@ -179,15 +179,22 @@ class GradScaler:
         # one device computation + ONE host sync for the whole parameter
         # list (check_finite_and_unscale is a single fused op in the
         # reference too — operators/amp/check_finite_and_unscale_op)
+        from paddle_tpu.framework.selected_rows import SelectedRows
         grads = [p._grad for p in optimizer._parameter_list or []
                  if p._grad is not None]
         if not grads:
             self._found_inf = False
             return
-        scaled = [g._data * inv for g in grads]
-        flags = jnp.stack([jnp.any(~jnp.isfinite(g)) for g in scaled])
+        # SelectedRows grads unscale their row values in place (the
+        # reference's check_finite_and_unscale handles SelectedRows too)
+        scaled = [(g.values if isinstance(g, SelectedRows) else g._data)
+                  * inv for g in grads]
+        flags = jnp.stack([jnp.any(~jnp.isfinite(s)) for s in scaled])
         for g, s in zip(grads, scaled):
-            g._data = s
+            if isinstance(g, SelectedRows):
+                g.values = s
+            else:
+                g._data = s
         self._found_inf = bool(jnp.any(flags))
 
     def minimize(self, optimizer, scaled_loss):

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core import (Tensor, TapeNode, no_grad, enable_grad,
                              is_grad_enabled, set_grad_enabled)
+from paddle_tpu.framework.selected_rows import SelectedRows
 
 __all__ = ["backward", "backward_from", "grad", "no_grad", "enable_grad",
            "is_grad_enabled", "set_grad_enabled"]
@@ -104,7 +105,6 @@ def _run_engine(root_tensors, root_grads, retain_graph=False,
             in_grads = node.vjp_fn(tuple(cots))
         else:
             in_grads = node.vjp_fn(cots[0])
-        from paddle_tpu.framework.selected_rows import SelectedRows
         for t, g in zip(node.inputs, in_grads):
             if g is None:
                 continue
@@ -122,14 +122,14 @@ def _run_engine(root_tensors, root_grads, retain_graph=False,
 
     # write .grad on leaves (SelectedRows stays row-sparse; mixing with a
     # dense grad densifies — selected_rows_functor SelectedRowsAddTensor)
-    from paddle_tpu.framework.selected_rows import SelectedRows
     for key, arr in leaf_cots.items():
         t = _leaf_refs[key]
         if t._grad is not None:
             prev = t._grad._data if isinstance(t._grad, Tensor) else t._grad
-            if isinstance(prev, SelectedRows):
-                arr = prev + arr
-            elif isinstance(arr, SelectedRows):
+            # SelectedRows.__add__ handles sparse+sparse and sparse+dense;
+            # jax arrays don't know SelectedRows, so put SR on the left
+            if isinstance(arr, SelectedRows) and not isinstance(
+                    prev, SelectedRows):
                 arr = arr + prev
             else:
                 arr = prev + arr
@@ -286,5 +286,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "(pass allow_unused=True to get None)")
             results.append(None)
         else:
-            results.append(c if isinstance(c, Tensor) else Tensor(c))
+            results.append(c if isinstance(c, (Tensor, SelectedRows))
+                           else Tensor(c))
     return results

@@ -493,7 +493,9 @@ class Tensor:
 
     def clear_gradient(self, set_to_zero=False):
         if set_to_zero and self._grad is not None:
-            self._grad = Tensor(jnp.zeros_like(self._grad._data))
+            data = (self._grad._data if isinstance(self._grad, Tensor)
+                    else self._grad.to_dense())   # SelectedRows grad
+            self._grad = Tensor(jnp.zeros_like(data))
         else:
             self._grad = None
 

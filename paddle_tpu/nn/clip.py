@@ -83,6 +83,10 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
                     error_if_nonfinite=False):
     if isinstance(parameters, Tensor):
         parameters = [parameters]
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    for p in parameters:
+        if isinstance(getattr(p, "_grad", None), SelectedRows):
+            p._grad = Tensor(p._grad.to_dense())   # clip is a dense op
     grads = [p._grad for p in parameters if p._grad is not None]
     if not grads:
         return Tensor(jnp.zeros(()))

@@ -13,6 +13,15 @@ __all__ = ["Optimizer", "SGD", "Momentum", "LarsMomentum", "Adam", "AdamW",
            "Adamax", "Adagrad", "Adadelta", "RMSProp", "Lamb"]
 
 
+def _dense_grad(g):
+    """Optimizer paths that only know dense math densify SelectedRows
+    grads up front (base Optimizer.step keeps them sparse)."""
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    if isinstance(g, SelectedRows):
+        return Tensor(g.merge().to_dense())
+    return g
+
+
 def _as_float(v):
     if isinstance(v, Tensor):
         return v._data
@@ -283,7 +292,7 @@ class LarsMomentum(Optimizer):
         params = self._parameter_list
         if params is None:
             raise ValueError("Optimizer created without parameters")
-        grads_and_params = [(p, p._grad) for p in params
+        grads_and_params = [(p, _dense_grad(p._grad)) for p in params
                             if p._grad is not None and p.trainable]
         if self._grad_clip is not None:
             grads_and_params = self._grad_clip(
@@ -400,7 +409,8 @@ class AdamW(Adam):
         coeff = self._coeff
         lr = self.get_lr()
         self._global_step += 1
-        grads_and_params = [(p, p._grad) for p in self._parameter_list
+        grads_and_params = [(p, _dense_grad(p._grad))
+                            for p in self._parameter_list
                             if p._grad is not None and p.trainable]
         if self._grad_clip is not None:
             grads_and_params = self._grad_clip(grads_and_params)
@@ -552,7 +562,8 @@ class Lamb(Optimizer):
             return super().step()
         lr = self.get_lr()
         self._global_step += 1
-        grads_and_params = [(p, p._grad) for p in self._parameter_list
+        grads_and_params = [(p, _dense_grad(p._grad))
+                            for p in self._parameter_list
                             if p._grad is not None and p.trainable]
         if self._grad_clip is not None:
             grads_and_params = self._grad_clip(grads_and_params)
