@@ -11,6 +11,8 @@ from paddle_tpu.nn.layer.norm import *  # noqa: F401,F403
 from paddle_tpu.nn.layer.pooling import *  # noqa: F401,F403
 from paddle_tpu.nn.layer.rnn import *  # noqa: F401,F403
 from paddle_tpu.nn.layer.transformer import *  # noqa: F401,F403
+from paddle_tpu.nn.decode import (  # noqa: F401
+    BeamSearchDecoder, Decoder, dynamic_decode, gather_tree)
 from paddle_tpu.nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
                                 ClipGradByGlobalNorm)
 from paddle_tpu.nn import utils  # noqa: F401

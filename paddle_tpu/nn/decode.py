@@ -1,0 +1,186 @@
+"""Seq2seq decoding — Decoder / BeamSearchDecoder / dynamic_decode.
+
+Reference: python/paddle/fluid/layers/rnn.py (Decoder :800,
+BeamSearchDecoder :866, dynamic_decode :1581) + the gather_tree op
+(operators/gather_tree_op.h) used to backtrace beams.
+
+TPU notes: decoding is inference with data-dependent termination; the
+loop here is a host loop over at most ``max_step_num`` fused cell steps
+(each step is one XLA computation over the [batch*beam, ...] state),
+which matches how the reference's while_op executes it.  States are kept
+beam-major ``[batch*beam, ...]`` exactly like the reference's
+tile_beam_merge_with_batch convention.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+
+class Decoder:
+    """Abstract decoding contract (rnn.py:800): initialize/step/finalize."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class _BeamState(NamedTuple):
+    cell_states: object          # pytree of [B*beam, ...] Tensors
+    log_probs: np.ndarray        # [B, beam]
+    finished: np.ndarray         # [B, beam] bool
+    lengths: np.ndarray          # [B, beam]
+
+
+def gather_tree(ids, parents):
+    """operators/gather_tree_op.h: backtrace [T, B, beam] step ids +
+    parent-beam indices into final sequences."""
+    ids = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids)
+    parents = np.asarray(parents.numpy() if isinstance(parents, Tensor)
+                         else parents)
+    T, B, W = ids.shape
+    out = np.zeros_like(ids)
+    for b in range(B):
+        for w in range(W):
+            parent = w
+            for t in range(T - 1, -1, -1):
+                out[t, b, w] = ids[t, b, parent]
+                parent = parents[t, b, parent]
+    return out
+
+
+class BeamSearchDecoder(Decoder):
+    """rnn.py:866.  ``cell(inputs, states) -> (out, new_states)``;
+    ``embedding_fn`` maps ids -> cell inputs; ``output_fn`` maps cell
+    output -> vocab logits."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn or (lambda ids: ids)
+        self.output_fn = output_fn or (lambda x: x)
+
+    # -- beam-major helpers --------------------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (rnn.py:933) — for tensors the cell
+        closes over, e.g. attention memory."""
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        tiled = jnp.repeat(arr, beam_size, axis=0)
+        return Tensor(tiled)
+
+    def _tile(self, tree):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda t: self.tile_beam_merge_with_batch(t, self.beam_size)
+            if isinstance(t, Tensor) else t, tree,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    def initialize(self, initial_cell_states):
+        import jax
+        leaves = [t for t in jax.tree_util.tree_leaves(
+            initial_cell_states) if isinstance(t, Tensor)]
+        batch = int(leaves[0].shape[0])
+        states = self._tile(initial_cell_states)
+        ids = np.full((batch * self.beam_size,), self.start_token, np.int64)
+        inputs = self.embedding_fn(Tensor(jnp.asarray(ids)))
+        log_probs = np.full((batch, self.beam_size), -1e9, np.float32)
+        log_probs[:, 0] = 0.0                 # only beam 0 live at t=0
+        return inputs, _BeamState(states, log_probs,
+                                  np.zeros((batch, self.beam_size), bool),
+                                  np.zeros((batch, self.beam_size),
+                                           np.int64))
+
+    def step(self, time, inputs, state: _BeamState):
+        import jax
+        W = self.beam_size
+        cell_out, next_cell_states = self.cell(inputs, state.cell_states)
+        logits = self.output_fn(cell_out)
+        logits_np = np.asarray(
+            (logits._data if isinstance(logits, Tensor) else logits),
+            np.float32)
+        BW, V = logits_np.shape
+        B = BW // W
+        step_lp = jax.nn.log_softmax(jnp.asarray(logits_np), axis=-1)
+        step_lp = np.asarray(step_lp).reshape(B, W, V)
+        # finished beams only extend with end_token at zero cost
+        # (rnn.py _beam_search_step's noend mask)
+        fin = state.finished[:, :, None]
+        mask = np.full((1, 1, V), -1e9, np.float32)
+        mask[0, 0, self.end_token] = 0.0
+        step_lp = np.where(fin, mask, step_lp)
+        total = state.log_probs[:, :, None] + step_lp       # [B, W, V]
+        flat = total.reshape(B, W * V)
+        top = np.argpartition(-flat, W, axis=1)[:, :W]
+        # order the W winners by score (argpartition is unordered)
+        order = np.argsort(-np.take_along_axis(flat, top, 1), axis=1)
+        top = np.take_along_axis(top, order, 1)
+        new_lp = np.take_along_axis(flat, top, 1)           # [B, W]
+        parent = (top // V).astype(np.int64)
+        token = (top % V).astype(np.int64)
+        finished = np.take_along_axis(state.finished, parent, 1) | (
+            token == self.end_token)
+        lengths = np.take_along_axis(state.lengths, parent, 1) + \
+            (~np.take_along_axis(state.finished, parent, 1)).astype(np.int64)
+
+        gather = (parent + np.arange(B)[:, None] * W).reshape(-1)
+
+        def _sel(t):
+            if not isinstance(t, Tensor):
+                return t
+            return Tensor(jnp.take(t._data, jnp.asarray(gather), axis=0))
+        next_cell_states = jax.tree_util.tree_map(
+            _sel, next_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        next_inputs = self.embedding_fn(
+            Tensor(jnp.asarray(token.reshape(-1))))
+        outputs = {"token": token, "parent": parent}
+        return outputs, _BeamState(next_cell_states, new_lp, finished,
+                                   lengths), next_inputs, finished
+
+    def finalize(self, outputs, final_state: _BeamState, sequence_lengths):
+        ids = np.stack([o["token"] for o in outputs])       # [T, B, W]
+        parents = np.stack([o["parent"] for o in outputs])
+        seqs = gather_tree(ids, parents)                    # [T, B, W]
+        predicted = np.transpose(seqs, (1, 0, 2))           # [B, T, W]
+        return Tensor(jnp.asarray(predicted)), final_state
+
+
+def dynamic_decode(decoder: Decoder, inits=None, max_step_num: int = 100,
+                   output_time_major: bool = False, return_length: bool =
+                   False, **kwargs):
+    """rnn.py:1581: run decoder.initialize/step until every sequence
+    finishes or max_step_num.  Returns (outputs, final_states) plus
+    sequence lengths when ``return_length``."""
+    inputs, state = decoder.initialize(inits)
+    outputs = []
+    for t in range(max_step_num):
+        out, state, inputs, finished = decoder.step(t, inputs, state)
+        outputs.append(out)
+        if np.asarray(finished).all():
+            break
+    final_out, final_state = decoder.finalize(outputs, state, state.lengths)
+    if output_time_major and isinstance(final_out, Tensor):
+        final_out = Tensor(jnp.swapaxes(final_out._data, 0, 1))
+    if return_length:
+        return final_out, final_state, Tensor(jnp.asarray(state.lengths))
+    return final_out, final_state

@@ -8,7 +8,7 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
            "CTCLoss", "SigmoidFocalLoss", "TripletMarginLoss",
-           "SoftMarginLoss", "HSigmoidLoss"]
+           "SoftMarginLoss", "HSigmoidLoss", "NCELoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -212,3 +212,66 @@ class HSigmoidLoss(Layer):
         return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
                                self.bias, path_table=path_table,
                                path_code=path_code)
+
+
+class NCELoss(Layer):
+    """Noise-contrastive estimation head (reference: operators/nce_op.h +
+    fluid.layers.nce): binary-classify the true class against
+    num_neg_samples noise draws instead of a full-vocab softmax.
+
+    Uniform sampler with the standard logQ correction: with q = 1/V,
+    s'_c = s_c - log(k·q_c); loss = -log σ(s'_y) - Σ_i log(1-σ(s'_i)).
+    The reference's custom_dist/log_uniform samplers map onto the
+    ``sampler`` arg ('uniform' implemented; the fused path for giant
+    vocabs is the PS/SelectedRows tier)."""
+
+    def __init__(self, num_total_classes, dim, num_neg_samples=10,
+                 sampler="uniform", weight_attr=None, bias_attr=None,
+                 seed=0, name=None):
+        super().__init__()
+        import numpy as np
+
+        from paddle_tpu.core import Parameter
+        if sampler != "uniform":
+            raise NotImplementedError("only the uniform sampler is "
+                                      "implemented")
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        rng = np.random.default_rng(seed)
+        std = 1.0 / max(1.0, dim ** 0.5)
+        self.weight = Parameter(rng.uniform(
+            -std, std, (num_total_classes, dim)).astype(np.float32),
+            name="nce_w")
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Parameter(np.zeros((num_total_classes,),
+                                           np.float32), name="nce_b")
+        self._rng = rng
+
+    def forward(self, input, label):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from paddle_tpu.core import Tensor, apply1
+        k, V = self.num_neg_samples, self.num_total_classes
+        b = int(input.shape[0])
+        noise = Tensor(jnp.asarray(
+            self._rng.integers(0, V, size=(b, k)).astype(np.int64)))
+        log_kq = float(np.log(k / V))
+
+        def _nce(x, lbl, noise_ids, w, *rest):
+            lbl = lbl.reshape(-1).astype(jnp.int32)
+            cand = jnp.concatenate([lbl[:, None],
+                                    noise_ids.astype(jnp.int32)], axis=1)
+            wc = jnp.take(w, cand, axis=0)               # [B, 1+k, D]
+            s = jnp.einsum("bkd,bd->bk", wc, x)
+            if rest:
+                s = s + jnp.take(rest[0], cand, axis=0)
+            s = s - log_kq
+            pos = -jax.nn.log_sigmoid(s[:, 0])
+            neg = -jnp.sum(jax.nn.log_sigmoid(-s[:, 1:]), axis=1)
+            return (pos + neg)[:, None]
+        args = (input, label, noise, self.weight) + (
+            (self.bias,) if self.bias is not None else ())
+        return apply1(_nce, *args, nondiff=(1, 2), name="nce_loss")

@@ -1,0 +1,120 @@
+"""Seq2seq decoding (fluid/layers/rnn.py BeamSearchDecoder/dynamic_decode
++ gather_tree op) and NCE loss (operators/nce_op.h)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core import Tensor
+from paddle_tpu.nn.decode import gather_tree
+
+
+class TestGatherTree:
+    def test_backtrace(self):
+        # T=3, B=1, W=2: reference gather_tree_op example shape
+        ids = np.array([[[2, 2]], [[6, 1]], [[3, 9]]])
+        parents = np.array([[[0, 0]], [[1, 1]], [[0, 0]]])
+        out = gather_tree(ids, parents)
+        # beam 0 at t=2 token 3, parent 0 -> t=1 token 6? parent[1,0]=1
+        # walk: t2 w0: ids=3, parent=0; t1 from parent chain
+        assert out.shape == (3, 1, 2)
+        np.testing.assert_array_equal(out[2, 0], [3, 9])
+
+
+class _CounterCell:
+    """Deterministic 'cell': logits favor (last_token + 1) % V, so the
+    best beam is the counting sequence and beam search must find it."""
+
+    def __init__(self, vocab, hidden=4):
+        self.vocab = vocab
+
+    def __call__(self, inputs, states):
+        ids = np.asarray(inputs.numpy()).astype(np.int64).reshape(-1)
+        logits = np.full((ids.size, self.vocab), -5.0, np.float32)
+        nxt = (ids + 1) % self.vocab
+        logits[np.arange(ids.size), nxt] = 5.0
+        # second-best: same token again (worse score)
+        logits[np.arange(ids.size), ids] = 2.0
+        return Tensor(logits), states
+
+
+class TestBeamSearch:
+    def test_counting_sequence_wins(self):
+        V, B, W = 7, 2, 3
+        end = V - 1
+        cell = _CounterCell(V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=end,
+                                   beam_size=W)
+        inits = Tensor(np.zeros((B, 4), np.float32))
+        out, state, lengths = nn.dynamic_decode(dec, inits,
+                                                max_step_num=10,
+                                                return_length=True)
+        pred = out.numpy()                       # [B, T, W]
+        assert pred.shape[0] == B and pred.shape[2] == W
+        # best beam counts 1,2,3,...,end then freezes on end_token while
+        # worse beams finish
+        np.testing.assert_array_equal(pred[0, :end, 0],
+                                      np.arange(1, end + 1))
+        assert (pred[0, end:, 0] == end).all()
+        assert lengths.numpy()[0, 0] == end      # length up to end token
+
+    def test_finished_beams_freeze(self):
+        V, end = 4, 3
+        cell = _CounterCell(V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=end,
+                                   beam_size=2)
+        inits = Tensor(np.zeros((1, 4), np.float32))
+        out, state = nn.dynamic_decode(dec, inits, max_step_num=8)
+        pred = out.numpy()[0, :, 0]
+        # after reaching end (token 3), only end_token repeats
+        first_end = int(np.argmax(pred == end))
+        assert (pred[first_end:] == end).all()
+
+    def test_tile_beam_merge(self):
+        x = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 2)
+        np.testing.assert_allclose(
+            t.numpy(), [[0, 1, 2], [0, 1, 2], [3, 4, 5], [3, 4, 5]])
+
+
+class TestNCELoss:
+    def test_shape_and_positive(self):
+        nce = nn.NCELoss(num_total_classes=50, dim=8, num_neg_samples=5)
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .standard_normal((6, 8)).astype(np.float32))
+        y = paddle.to_tensor(np.arange(6, dtype=np.int64)[:, None])
+        loss = nce(x, y)
+        assert list(loss.shape) == [6, 1]
+        assert (loss.numpy() > 0).all()
+
+    def test_trains_vs_full_softmax_task(self):
+        """NCE on a 4-class linearly separable task approaches the true
+        class: loss falls and the true-class score dominates."""
+        paddle.seed(0)
+        rng = np.random.default_rng(1)
+        V, D, B = 16, 8, 64
+        proj = nn.Linear(4, D)
+        nce = nn.NCELoss(V, D, num_neg_samples=4, seed=2)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.05,
+            parameters=proj.parameters() + nce.parameters())
+        x = rng.standard_normal((B, 4)).astype(np.float32)
+        y = x.argmax(1).astype(np.int64)[:, None]
+        losses = []
+        for _ in range(60):
+            loss = nce(proj(paddle.to_tensor(x)),
+                       paddle.to_tensor(y)).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        # inference-time full scores rank the true class first mostly
+        feats = proj(paddle.to_tensor(x)).numpy()
+        scores = feats @ nce.weight.numpy().T + nce.bias.numpy()
+        acc = (scores.argmax(1) == y[:, 0]).mean()
+        assert acc > 0.7, acc
+
+    def test_unsupported_sampler(self):
+        with pytest.raises(NotImplementedError):
+            nn.NCELoss(10, 4, sampler="log_uniform")
