@@ -45,6 +45,10 @@ def _parse():
                    help="PS mode: trainers on this host")
     p.add_argument("--start_port", type=int,
                    default=int(os.getenv("FLAGS_START_PORT", "6070")))
+    p.add_argument("--elastic_retries", type=int, default=0,
+                   help="restart a failed child up to N times before "
+                        "failing the job (elastic/failure-recovery role "
+                        "of the reference's elastic manager)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -69,16 +73,28 @@ class _Child:
 
     def __init__(self, name: str, cmd: List[str], env: Dict[str, str],
                  log_path: Optional[str]):
-        import subprocess
         self.name = name
+        self.cmd = cmd
+        self.env = env
         self.log_path = log_path
-        self.log_file = open(log_path, "w") if log_path else None
+        self.restarts = 0
+        self._spawn()
+
+    def _spawn(self):
+        import subprocess
+        self.log_file = open(self.log_path, "a") if self.log_path else None
         full_env = dict(os.environ)
-        full_env.update(env)
+        full_env.update(self.env)
         self.proc = subprocess.Popen(
-            cmd, env=full_env,
+            self.cmd, env=full_env,
             stdout=self.log_file or None,
             stderr=subprocess.STDOUT if self.log_file else None)
+
+    def restart(self):
+        if self.log_file and not self.log_file.closed:
+            self.log_file.close()
+        self.restarts += 1
+        self._spawn()
 
     def alive(self):
         return self.proc.poll() is None
@@ -94,9 +110,10 @@ class _Child:
             self.log_file.close()
 
 
-def _supervise(children: List[_Child]) -> int:
-    """watch_local_trainers (launch_utils.py:522): poll; first non-zero
-    exit kills the job; success when every child exits 0."""
+def _supervise(children: List[_Child], elastic_retries: int = 0) -> int:
+    """watch_local_trainers (launch_utils.py:522): poll; a non-zero exit
+    restarts the child while elastic retries remain, else kills the job;
+    success when every child exits 0."""
 
     def _sig(_s, _f):
         for c in children:
@@ -113,6 +130,14 @@ def _supervise(children: List[_Child]) -> int:
                 if rc is None:
                     alive = True
                 elif rc != 0:
+                    if c.restarts < elastic_retries:
+                        print(f"launch: {c.name} exited with {rc}; "
+                              f"elastic restart "
+                              f"{c.restarts + 1}/{elastic_retries}",
+                              file=sys.stderr)
+                        c.restart()
+                        alive = True
+                        continue
                     print(f"launch: {c.name} exited with {rc}"
                           + (f", see {c.log_path}" if c.log_path else ""),
                           file=sys.stderr)
@@ -147,7 +172,7 @@ def _launch_collective(args, ips) -> int:
     cmd = [sys.executable, args.training_script] + args.training_script_args
     child = _Child(f"trainer-{rank}", cmd, env,
                    os.path.join(args.log_dir, f"workerlog.{rank}"))
-    return _supervise([child])
+    return _supervise([child], args.elastic_retries)
 
 
 def _launch_ps(args) -> int:
@@ -179,7 +204,7 @@ def _launch_ps(args) -> int:
         children.append(_Child(
             f"trainer-{i}", cmd, env,
             os.path.join(args.log_dir, f"workerlog.{i}")))
-    return _supervise(children)
+    return _supervise(children, args.elastic_retries)
 
 
 def main():

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Config-driven per-op benchmark harness + regression gate.
+
+Reference roles:
+  * paddle/fluid/operators/benchmark/op_tester.cc:67 — replay one op from
+    an OpTesterConfig (shapes/dtypes/attrs), time repeated runs;
+  * tools/test_op_benchmark.sh + tools/check_op_benchmark_result.py — the
+    CI gate comparing op timings against a stored baseline.
+
+Usage:
+    python tools/op_bench.py                         # built-in suite
+    python tools/op_bench.py --config cfg.json       # custom ops
+    python tools/op_bench.py --save base.json        # record baseline
+    python tools/op_bench.py --compare base.json --threshold 0.15
+        # exit 1 if any op is >15% slower than the baseline
+
+Config entries: {"name", "op" (dotted path under paddle_tpu),
+"args" ([{shape, dtype, low?, high?} or scalar]), "kwargs"?, "grad"?}.
+Timings use a device->host fetch as the execution fence (the tunnel's
+block_until_ready can return early; see bench.py _sync).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+import time
+
+import numpy as np
+
+BUILTIN_SUITE = [
+    {"name": "matmul_1k", "op": "paddle_tpu.matmul",
+     "args": [{"shape": [1024, 1024], "dtype": "float32"},
+              {"shape": [1024, 1024], "dtype": "float32"}]},
+    {"name": "softmax_8kx1k", "op": "paddle_tpu.nn.functional.softmax",
+     "args": [{"shape": [8192, 1024], "dtype": "float32"}]},
+    {"name": "layer_norm", "op": "paddle_tpu.nn.functional.layer_norm",
+     "args": [{"shape": [4096, 1024], "dtype": "float32"}],
+     "kwargs": {"normalized_shape": [1024]}},
+    {"name": "conv2d_64", "op": "paddle_tpu.nn.functional.conv2d",
+     "args": [{"shape": [8, 64, 56, 56], "dtype": "float32"},
+              {"shape": [64, 64, 3, 3], "dtype": "float32"}],
+     "kwargs": {"padding": 1}},
+    {"name": "embedding_bag", "op": "paddle_tpu.nn.functional.embedding_bag",
+     "args": [{"shape": [512, 64], "dtype": "int64", "low": 0,
+               "high": 30000},
+              {"shape": [30000, 128], "dtype": "float32"}],
+     "kwargs": {"mode": "mean"}},
+    {"name": "reduce_sum_16m", "op": "paddle_tpu.sum",
+     "args": [{"shape": [4096, 4096], "dtype": "float32"}]},
+]
+
+
+def _resolve(path: str):
+    mod, _, attr = path.rpartition(".")
+    obj = importlib.import_module(mod)
+    return getattr(obj, attr)
+
+
+def _make_arg(spec, rng):
+    import paddle_tpu as paddle
+    if not isinstance(spec, dict):
+        return spec
+    dtype = spec.get("dtype", "float32")
+    shape = spec["shape"]
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        arr = rng.integers(spec.get("low", 0), spec.get("high", 100),
+                           size=shape).astype(dtype)
+    else:
+        arr = rng.standard_normal(shape).astype(dtype)
+    return paddle.to_tensor(arr)
+
+
+def _sync(out):
+    from paddle_tpu.core import Tensor
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    arr = out._data if isinstance(out, Tensor) else out
+    np.asarray(arr)
+
+
+def run_one(cfg, warmup=3, iters=10):
+    fn = _resolve(cfg["op"])
+    rng = np.random.default_rng(0)
+    args = [_make_arg(a, rng) for a in cfg.get("args", [])]
+    kwargs = cfg.get("kwargs", {})
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kwargs)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    return {"name": cfg.get("name", cfg["op"]), "op": cfg["op"],
+            "ms": round(dt * 1e3, 4)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", help="JSON list of op configs")
+    ap.add_argument("--save", help="write results JSON here")
+    ap.add_argument("--compare", help="baseline JSON to gate against")
+    ap.add_argument("--threshold", type=float, default=0.1,
+                    help="allowed relative slowdown vs baseline")
+    ap.add_argument("--iters", type=int, default=10)
+    a = ap.parse_args(argv)
+
+    suite = BUILTIN_SUITE
+    if a.config:
+        with open(a.config) as f:
+            suite = json.load(f)
+    results = []
+    for cfg in suite:
+        try:
+            r = run_one(cfg, iters=a.iters)
+        except Exception as e:               # noqa: BLE001
+            r = {"name": cfg.get("name", cfg.get("op")), "error": repr(e)}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    if a.save:
+        with open(a.save, "w") as f:
+            json.dump(results, f, indent=1)
+    if a.compare:
+        with open(a.compare) as f:
+            base = {r["name"]: r for r in json.load(f) if "ms" in r}
+        failed = []
+        for r in results:
+            b = base.get(r.get("name"))
+            if b is None or "ms" not in r:
+                continue
+            slowdown = r["ms"] / b["ms"] - 1.0
+            if slowdown > a.threshold:
+                failed.append((r["name"], b["ms"], r["ms"], slowdown))
+        for name, bms, rms, s in failed:
+            print(f"REGRESSION {name}: {bms}ms -> {rms}ms (+{s:.0%})",
+                  file=sys.stderr)
+        if failed:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
