@@ -1,0 +1,2 @@
+"""paddle.utils parity tier: custom-op runtime (cpp_extension)."""
+from paddle_tpu.utils import cpp_extension  # noqa: F401

@@ -1,0 +1,163 @@
+"""Custom C++ op runtime — paddle.utils.cpp_extension parity.
+
+Reference: paddle/fluid/framework/custom_operator.cc (runtime .so load +
+op registration over a stable C ABI) and
+python/paddle/utils/cpp_extension/ (load(): g++ the user's sources, then
+expose the ops to python).
+
+TPU mapping: *device* custom kernels are written in Pallas
+(paddle_tpu/ops/pallas — that is the custom-kernel story for the MXU);
+this module covers the reference's *host* custom-op capability: user C++
+compiled at runtime and registered as a differentiable framework op.
+The op executes on host via ``jax.pure_callback`` wrapped in a
+``jax.custom_vjp``, so it works eagerly, under ``jit`` capture, and on
+the tape (backward uses the user's ``*_backward`` symbol when present).
+
+C ABI contract (elementwise/same-shape ops — the overwhelmingly common
+custom-op case; richer signatures belong in Pallas):
+
+    extern "C" void <name>_forward(const float* x, long long n,
+                                   float* out);
+    extern "C" void <name>_backward(const float* x, const float* gout,
+                                    long long n, float* gin);   // optional
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import Tensor, apply1
+
+__all__ = ["load", "CustomOp"]
+
+_CACHE_DIR = os.path.join(tempfile.gettempdir(), "paddle_tpu_custom_ops")
+
+
+def _compile(source_path: str, tag: str) -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so = os.path.join(_CACHE_DIR, f"{tag}.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(
+            source_path):
+        return so
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", source_path,
+           "-o", so + ".tmp"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+    if r.returncode != 0:
+        raise RuntimeError(f"custom op build failed:\n{r.stderr[-2000:]}")
+    os.replace(so + ".tmp", so)
+    return so
+
+
+class CustomOp:
+    """One loaded op: callable on Tensors, differentiable when the
+    backward symbol exists."""
+
+    def __init__(self, name: str, lib: ctypes.CDLL):
+        self.name = name
+        self._fwd = getattr(lib, f"{name}_forward")
+        self._fwd.argtypes = [ctypes.POINTER(ctypes.c_float),
+                              ctypes.c_longlong,
+                              ctypes.POINTER(ctypes.c_float)]
+        self._bwd = getattr(lib, f"{name}_backward", None)
+        if self._bwd is not None:
+            self._bwd.argtypes = [ctypes.POINTER(ctypes.c_float),
+                                  ctypes.POINTER(ctypes.c_float),
+                                  ctypes.c_longlong,
+                                  ctypes.POINTER(ctypes.c_float)]
+        self._jax_fn = self._build()
+
+    # -- host callbacks ------------------------------------------------------
+    def _run_fwd(self, x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        out = np.empty_like(x)
+        self._fwd(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), x.size,
+                  out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    def _run_bwd(self, x: np.ndarray, gout: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, np.float32)
+        gout = np.ascontiguousarray(gout, np.float32)
+        gin = np.empty_like(x)
+        self._bwd(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  gout.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  x.size,
+                  gin.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return gin
+
+    def _build(self):
+        def call_fwd(x):
+            return jax.pure_callback(
+                self._run_fwd, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                x, vmap_method="sequential")
+
+        if self._bwd is None:
+            return call_fwd
+
+        @jax.custom_vjp
+        def op(x):
+            return call_fwd(x)
+
+        def fwd(x):
+            return call_fwd(x), x
+
+        def bwd(x, g):
+            gin = jax.pure_callback(
+                self._run_bwd, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                x, g, vmap_method="sequential")
+            return (gin,)
+
+        op.defvjp(fwd, bwd)
+        return op
+
+    def __call__(self, x):
+        if isinstance(x, Tensor):
+            return apply1(self._jax_fn, x, name=self.name)
+        return self._jax_fn(jnp.asarray(x))
+
+
+class _OpModule:
+    def __init__(self, ops):
+        for op in ops:
+            setattr(self, op.name, op)
+        self._ops = {op.name: op for op in ops}
+
+    def __iter__(self):
+        return iter(self._ops.values())
+
+
+def load(name: str, sources=None, source_code: Optional[str] = None,
+         functions=None, verbose: bool = False):
+    """cpp_extension.load parity: compile sources (or inline
+    ``source_code``) and return a module whose attributes are the ops
+    named in ``functions`` (default: derived from ``name``)."""
+    if source_code is not None:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        tag = name + "_" + hashlib.sha1(
+            source_code.encode()).hexdigest()[:12]
+        src = os.path.join(_CACHE_DIR, tag + ".cpp")
+        if not os.path.exists(src):
+            with open(src, "w") as f:
+                f.write(source_code)
+    elif sources:
+        src = sources[0]
+        with open(src, "rb") as f:
+            tag = name + "_" + hashlib.sha1(f.read()).hexdigest()[:12]
+    else:
+        raise ValueError("pass sources=[...] or source_code=...")
+    so = _compile(src, tag)
+    lib = ctypes.CDLL(so)
+    fns = functions or [name]
+    ops = [CustomOp(fn, lib) for fn in fns]
+    if verbose:
+        print(f"loaded custom ops {fns} from {so}")
+    if len(ops) == 1:
+        return ops[0]
+    return _OpModule(ops)

@@ -1,0 +1,99 @@
+"""Custom C++ op runtime (framework/custom_operator.cc +
+utils/cpp_extension roles): runtime g++ build, forward correctness,
+tape + jit integration, custom backward."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.native import native_available
+from paddle_tpu.utils import cpp_extension
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="g++ unavailable")
+
+LEAKY_SRC = r"""
+#include <cstddef>
+extern "C" void leaky_forward(const float* x, long long n, float* out) {
+    for (long long i = 0; i < n; ++i)
+        out[i] = x[i] > 0.f ? x[i] : 0.1f * x[i];
+}
+extern "C" void leaky_backward(const float* x, const float* gout,
+                               long long n, float* gin) {
+    for (long long i = 0; i < n; ++i)
+        gin[i] = x[i] > 0.f ? gout[i] : 0.1f * gout[i];
+}
+"""
+
+CUBE_SRC = r"""
+extern "C" void cube_forward(const float* x, long long n, float* out) {
+    for (long long i = 0; i < n; ++i) out[i] = x[i] * x[i] * x[i];
+}
+"""
+
+
+def _leaky():
+    return cpp_extension.load("leaky", source_code=LEAKY_SRC)
+
+
+class TestCustomOp:
+    def test_forward_values(self):
+        op = _leaky()
+        x = np.array([-2.0, -0.5, 0.0, 3.0], np.float32)
+        out = op(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.where(x > 0, x, 0.1 * x),
+                                   rtol=1e-6)
+
+    def test_custom_backward_on_tape(self):
+        op = _leaky()
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = op(x) * 3.0
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.3, 3.0], rtol=1e-6)
+
+    def test_inside_jit(self):
+        import jax
+        op = _leaky()
+        f = jax.jit(lambda a: op._jax_fn(a) * 2)
+        out = f(np.array([-1.0, 1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [-0.2, 2.0], rtol=1e-6)
+
+    def test_forward_only_op_not_differentiable_backward_free(self):
+        op = cpp_extension.load("cube", source_code=CUBE_SRC)
+        x = np.array([2.0], np.float32)
+        np.testing.assert_allclose(op(paddle.to_tensor(x)).numpy(), [8.0],
+                                   rtol=1e-6)
+
+    def test_build_error_surfaces(self):
+        with pytest.raises(RuntimeError, match="build failed"):
+            cpp_extension.load("broken",
+                               source_code="this is not c++ at all;")
+
+    def test_compile_cache_reused(self):
+        op1 = cpp_extension.load("leaky", source_code=LEAKY_SRC)
+        op2 = cpp_extension.load("leaky", source_code=LEAKY_SRC)
+        out1 = op1(paddle.to_tensor(np.array([1.0], np.float32))).numpy()
+        out2 = op2(paddle.to_tensor(np.array([1.0], np.float32))).numpy()
+        np.testing.assert_allclose(out1, out2)
+
+    def test_trains_in_model(self):
+        op = _leaky()
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        fc = nn.Linear(4, 4)
+        head = nn.Linear(4, 1)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.05,
+            parameters=fc.parameters() + head.parameters())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.abs(x @ np.ones((4, 1), np.float32))
+        losses = []
+        for _ in range(25):
+            out = head(op(fc(paddle.to_tensor(x))))
+            loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses
