@@ -98,7 +98,7 @@ def bench_resnet50(on_accel):
     from paddle_tpu.vision.models import resnet50, resnet18
 
     if on_accel:
-        B, HW = 64, 224
+        B, HW = 128, 224        # swept 64/128/256: 128 peaks on one chip
         model = resnet50(num_classes=1000)
     else:
         B, HW = 8, 64

@@ -1,0 +1,117 @@
+"""VisualDL-compatible experiment logging.
+
+Reference role: the VisualDL LogWriter the reference ecosystem logs to
+(visualdl.LogWriter — add_scalar/add_histogram/...) plus hapi's VisualDL
+callback.  TPU stack: events are written in TensorBoard format (via
+torch's SummaryWriter, baked into this image) so XProf device traces
+(paddle_tpu.profiler) and training curves land in one TensorBoard; when
+no event-writer backend exists the writer degrades to JSONL scalars so
+logging never takes down training.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+__all__ = ["LogWriter", "VisualDL"]
+
+
+class LogWriter:
+    """visualdl.LogWriter parity (add_scalar/add_text/close; histogram
+    degrades to scalar stats in the JSONL backend)."""
+
+    def __init__(self, logdir: str = "vdl_log", **kwargs):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._tb = None
+        self._jsonl = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._tb = SummaryWriter(log_dir=logdir)
+        except Exception:                          # noqa: BLE001
+            self._jsonl = open(os.path.join(logdir, "scalars.jsonl"), "a")
+
+    def add_scalar(self, tag: str, value, step: Optional[int] = None):
+        value = float(value)
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, global_step=step)
+        else:
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "value": value, "step": step,
+                 "time": time.time()}) + "\n")
+            self._jsonl.flush()
+
+    def add_text(self, tag: str, text: str, step: Optional[int] = None):
+        if self._tb is not None:
+            self._tb.add_text(tag, text, global_step=step)
+        else:
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "text": text, "step": step}) + "\n")
+            self._jsonl.flush()
+
+    def add_histogram(self, tag: str, values, step: Optional[int] = None):
+        import numpy as np
+        arr = np.asarray(values)
+        if self._tb is not None:
+            self._tb.add_histogram(tag, arr, global_step=step)
+        else:
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "mean": float(arr.mean()),
+                 "std": float(arr.std()), "min": float(arr.min()),
+                 "max": float(arr.max()), "step": step}) + "\n")
+            self._jsonl.flush()
+
+    def flush(self):
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _visualdl_base():
+    from paddle_tpu.hapi.callbacks import Callback
+    return Callback
+
+
+class VisualDL(_visualdl_base()):
+    """hapi callback (reference: paddle.callbacks.VisualDL): logs every
+    train/eval metric Model.fit produces."""
+
+    def __init__(self, log_dir: str = "vdl_log"):
+        super().__init__()
+        self.writer = LogWriter(log_dir)
+        self._step = 0
+
+    def _log(self, prefix, logs):
+        for k, v in (logs or {}).items():
+            try:
+                self.writer.add_scalar(f"{prefix}/{k}", float(v),
+                                       self._step)
+            except (TypeError, ValueError):
+                pass                       # non-scalar entries skipped
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._log("train", logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("epoch", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        self.writer.flush()
