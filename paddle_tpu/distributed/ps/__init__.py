@@ -20,8 +20,10 @@ TPU-native mapping, two tiers:
   (framework/fleet/fleet_wrapper.h:111).  ``AsyncCommunicator`` batches
   pushes on a worker thread (service/communicator.cc semantics), and
   ``geo`` mode accumulates deltas and folds them in every k steps
-  (sparse_geo_table.cc semantics), all in-process: multi-host RPC transport
-  is round-2 scope.
+  (sparse_geo_table.cc semantics).  The multi-host transport lives in
+  ps/service.py (TCP pull/push + heartbeat); ``HashEmbeddingTable`` adds
+  the dynamic-vocab hash-table generation, and ps/graph.py the GNN
+  sampling service on the same transport.
 """
 from __future__ import annotations
 
@@ -37,7 +39,8 @@ from paddle_tpu.core import Parameter, Tensor, apply1
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.parallel.mesh import DistAttr
 
-__all__ = ["ShardedEmbedding", "HostEmbeddingTable", "DistributedEmbedding",
+__all__ = ["HashEmbeddingTable",
+           "ShardedEmbedding", "HostEmbeddingTable", "DistributedEmbedding",
            "AsyncCommunicator"]
 
 
@@ -229,3 +232,94 @@ class DistributedEmbedding(Layer):
 
     def flush(self):
         self.communicator.flush()
+
+
+class HashEmbeddingTable:
+    """Dynamic-vocab sparse table: rows exist only once touched.
+
+    Parity: the hash-table PS generation — framework/fleet/heter_ps/
+    hashtable.h + distributed/table/common_sparse_table.cc's
+    first-touch row creation — behind the reference's "trillions of
+    parameters" claim: the id space is unbounded (feature hashes), and
+    memory grows with *touched* rows, not vocabulary size.
+
+    Same pull/push surface as HostEmbeddingTable, so DistributedEmbedding,
+    AsyncCommunicator, and the PS service transport all work unchanged;
+    ids may be any int64 (hash values included).
+    """
+
+    def __init__(self, embedding_dim: int, optimizer: str = "adagrad",
+                 learning_rate: float = 0.05,
+                 initializer_range: float = 0.05, seed: int = 0):
+        self.num_embeddings = 0            # dynamic; grows on touch
+        self.embedding_dim = embedding_dim
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        self._init_range = initializer_range
+        self._seed = seed
+        if optimizer not in ("adagrad", "sgd"):
+            raise ValueError(f"unsupported table optimizer {optimizer!r}")
+        self._rows: Dict[int, np.ndarray] = {}
+        self._g2: Dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self._rows.get(i)
+        if r is None:
+            # deterministic per-id init: same id hashes to the same row on
+            # any shard/restart (common_sparse_table's initializer role)
+            rng = np.random.default_rng((self._seed * 0x9E3779B9 + i)
+                                        & 0xFFFFFFFF)
+            r = rng.uniform(-self._init_range, self._init_range,
+                            self.embedding_dim).astype(np.float32)
+            self._rows[i] = r
+            self.num_embeddings = len(self._rows)
+        return r
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        flat = ids.reshape(-1)
+        with self._lock:
+            out = np.stack([self._row(int(i)) for i in flat])
+        return out.reshape(ids.shape + (self.embedding_dim,))
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr=None):
+        lr = self.learning_rate if lr is None else lr
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size,
+                                                  self.embedding_dim)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        acc = np.zeros((uniq.size, self.embedding_dim), np.float32)
+        np.add.at(acc, inv, g)
+        with self._lock:
+            for k, i in enumerate(uniq.tolist()):
+                row = self._row(i)
+                if self.optimizer == "adagrad":
+                    self._g2[i] = self._g2.get(i, 0.0) + float(
+                        (acc[k] ** 2).mean())
+                    row -= lr * acc[k] / (np.sqrt(self._g2[i]) + 1e-6)
+                else:
+                    row -= lr * acc[k]
+
+    # save/load: ids + rows arrays (ordered), g2 aligned
+    def state_dict(self):
+        with self._lock:
+            ids = np.fromiter(self._rows.keys(), np.int64,
+                              count=len(self._rows))
+            table = (np.stack([self._rows[int(i)] for i in ids])
+                     if ids.size else
+                     np.zeros((0, self.embedding_dim), np.float32))
+            d = {"ids": ids, "table": table, "optimizer": self.optimizer}
+            if self.optimizer == "adagrad":
+                d["g2"] = np.asarray([self._g2.get(int(i), 0.0)
+                                      for i in ids], np.float32)
+            return d
+
+    def set_state_dict(self, d):
+        with self._lock:
+            self._rows = {int(i): np.asarray(r, np.float32)
+                          for i, r in zip(d["ids"], d["table"])}
+            if "g2" in d:
+                self._g2 = {int(i): float(v)
+                            for i, v in zip(d["ids"], d["g2"])}
+            self.num_embeddings = len(self._rows)

@@ -143,3 +143,59 @@ def test_rank_models_train(cls):
     losses = [float(step(paddle.to_tensor(ids), paddle.to_tensor(dense),
                          paddle.to_tensor(y))) for _ in range(10)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_hash_table_dynamic_vocab():
+    """hashtable.h role: unbounded id space, rows on first touch,
+    deterministic init, duplicate-id accumulation."""
+    from paddle_tpu.distributed.ps import HashEmbeddingTable
+    t = HashEmbeddingTable(4, optimizer="sgd", learning_rate=1.0)
+    ids = np.array([10 ** 15, 7, 10 ** 15])
+    rows = t.pull(ids)
+    assert t.num_embeddings == 2
+    np.testing.assert_allclose(rows[0], rows[2])
+    t.push(ids, np.ones((3, 4), np.float32))
+    after = t.pull(np.array([10 ** 15]))[0]
+    np.testing.assert_allclose(after, rows[0] - 2.0, rtol=1e-6)
+    # state roundtrip incl. adagrad-free sgd mode
+    t2 = HashEmbeddingTable(4, optimizer="sgd")
+    t2.set_state_dict(t.state_dict())
+    np.testing.assert_allclose(t2.pull(np.array([7])), t.pull(np.array([7])))
+
+
+def test_hash_table_over_ps_service():
+    from paddle_tpu.distributed.ps import HashEmbeddingTable
+    from paddle_tpu.distributed.ps.service import PsClient, PsServer
+    t = HashEmbeddingTable(3)
+    srv = PsServer({"hash": t}, port=0)
+    srv.start()
+    try:
+        c = PsClient([f"127.0.0.1:{srv.port}"])
+        rows = c.pull("hash", np.array([123456789, 42]))
+        assert rows.shape == (2, 3) and t.num_embeddings == 2
+        c.push("hash", np.array([42]), np.ones((1, 3), np.float32))
+        c.bye()
+    finally:
+        srv.shutdown()
+
+
+def test_hash_table_in_distributed_embedding():
+    from paddle_tpu.distributed.ps import (DistributedEmbedding,
+                                           HashEmbeddingTable)
+    emb = DistributedEmbedding(0, 4, table=HashEmbeddingTable(
+        4, optimizer="sgd", learning_rate=0.5))
+    head = nn.Linear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.5, parameters=head.parameters())
+    ids = np.asarray([[10 ** 12], [2], [3], [10 ** 12]])
+    target = paddle.to_tensor(
+        np.asarray([[1.0], [-1.0], [1.0], [1.0]], np.float32))
+    losses = []
+    for _ in range(30):
+        rows = emb(paddle.to_tensor(ids))
+        out = head(paddle.reshape(rows, [4, 4]))
+        loss = ((out - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2, losses
