@@ -130,7 +130,7 @@ def bench_gpt2_345m(on_accel):
     from paddle_tpu.models import GPT, gpt2_345m, gpt_tiny, gpt_loss
 
     if on_accel:
-        B, S = 4, 1024
+        B, S = 8, 1024          # swept 4/8/16: 8 peaks on one chip
         cfg = gpt2_345m(remat=True, max_seq_len=S)
     else:
         B, S = 2, 128

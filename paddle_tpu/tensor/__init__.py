@@ -10,7 +10,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.core import Tensor, apply1, convert_dtype
+from paddle_tpu.core import Tensor, apply, apply1, convert_dtype
 
 from paddle_tpu.tensor import creation, linalg, logic, manipulation, math
 from paddle_tpu.tensor import random, search, sequence, stat
@@ -32,8 +32,17 @@ def einsum(equation, *operands):
                   name="einsum")
 
 
-def histogramdd(*a, **k):
-    raise NotImplementedError
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """paddle.histogramdd parity → jnp.histogramdd."""
+    def _h(a, *rest):
+        w = rest[0] if rest else None
+        hist, edges = jnp.histogramdd(a, bins=bins, range=ranges,
+                                      density=density, weights=w)
+        return (hist,) + tuple(edges)
+    args = (x,) if weights is None else (x, weights)
+    outs = apply(_h, *args, name="histogramdd")
+    return outs[0], list(outs[1:])
 
 
 # ---------------------------------------------------------------------------

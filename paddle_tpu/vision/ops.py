@@ -62,14 +62,21 @@ def nms(boxes, scores=None, iou_threshold=0.3, top_k: int = -1):
     return Tensor(np.asarray(keep, np.int64))
 
 
+def _roi_image_index(boxes_num, n_rois):
+    """boxes_num [N] -> per-roi image index [R] (roi_align_op's batch
+    mapping); None -> all rois sample image 0."""
+    if boxes_num is None:
+        return np.zeros((n_rois,), np.int32)
+    bn = np.asarray(boxes_num.numpy() if hasattr(boxes_num, "numpy")
+                    else boxes_num).astype(np.int64)
+    return np.repeat(np.arange(bn.size), bn).astype(np.int32)
+
+
 def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True):
     """RoIAlign (reference: operators/roi_align_op). x: (N,C,H,W),
-    boxes: (R,4) xyxy in input scale, all sampled from image 0."""
-    if boxes_num is not None:
-        raise NotImplementedError(
-            "roi_align: per-image roi batching (boxes_num) not yet "
-            "supported — all rois sample image 0; pass boxes_num=None")
+    boxes: (R,4) xyxy in input scale; boxes_num [N] assigns rois to
+    images (None = all from image 0)."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
@@ -77,6 +84,7 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
     def f(x, rois):
         N, C, H, W = x.shape
         R = rois.shape[0]
+        img_idx = jnp.asarray(_roi_image_index(boxes_num, R))
         offset = 0.5 if aligned else 0.0
         x1 = rois[:, 0] * spatial_scale - offset
         y1 = rois[:, 1] * spatial_scale - offset
@@ -105,25 +113,22 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
                     + v11 * wy[None, :, None] * wx[None, None])
 
         def per_roi(r):
-            img = x[0]  # (C,H,W); multi-image via boxes_num: round-2
+            img = x[img_idx[r]]                  # (C,H,W)
             return bilinear(img, ys[r], xs[r])
         return jax.vmap(per_roi)(jnp.arange(R))
     return apply1(f, x, boxes, name="roi_align")
 
 
 def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
-    if boxes_num is not None:
-        raise NotImplementedError(
-            "roi_pool: per-image roi batching (boxes_num) not yet "
-            "supported — all rois sample image 0; pass boxes_num=None")
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
 
     def f(x, rois):
         N, C, H, W = x.shape
+        img_idx = jnp.asarray(_roi_image_index(boxes_num, rois.shape[0]))
 
-        def per_roi(roi):
+        def per_roi(roi, img_i):
             # reference roi_pool_op.h: bin (i,j) max-pools rows
             # [floor(i*hh/oh), ceil((i+1)*hh/oh)) etc.; empty bins -> 0.
             # Masked-max formulation keeps it static-shaped for XLA.
@@ -145,14 +150,14 @@ def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
                    (y < jnp.clip(hend, 0, H))        # [oh, H]
             colm = (xw >= jnp.clip(wstart, 0, W)) & \
                    (xw < jnp.clip(wend, 0, W))       # [ow, W]
-            img = x[0]                               # [C, H, W]
+            img = x[img_i]                           # [C, H, W]
             t = jnp.where(rowm[:, None, :, None], img[None],
                           -jnp.inf).max(axis=2)      # [oh, C, W]
             o = jnp.where(colm[None, :, None, :], t[:, None],
                           -jnp.inf).max(axis=3)      # [oh, ow, C]
             o = jnp.transpose(o, (2, 0, 1))
             return jnp.where(jnp.isfinite(o), o, 0.0)
-        return jax.vmap(per_roi)(rois)
+        return jax.vmap(per_roi)(rois, img_idx)
     return apply1(f, x, boxes, name="roi_pool")
 
 
