@@ -11,7 +11,7 @@ from paddle_tpu.core import apply1
 __all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
            "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
            "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
-           "adaptive_max_pool2d", "adaptive_max_pool3d"]
+           "adaptive_max_pool2d", "adaptive_max_pool3d", "max_unpool2d"]
 
 
 def _tuplify(v, n):
@@ -72,21 +72,95 @@ def _pool(x, kernel, stride, padding, n, channel_last, mode, ceil_mode,
     return apply1(_run, x, name=name)
 
 
+def _max_pool2d_with_mask(x, kernel, stride, padding, name):
+    """Max pool that also returns the argmax as flattened H*W input
+    indices (reference: operators/pool_with_index_op — the mask consumed
+    by max_unpool2d).  NCHW only; windows are materialised as kh*kw
+    strided slices, so this stays a static-shape gather/argmax XLA
+    likes."""
+    kh, kw = _tuplify(kernel, 2)
+    sh, sw = _tuplify(stride if stride is not None else kernel, 2)
+    pad = _norm_pad(padding, 2)
+    if isinstance(pad, str):
+        raise ValueError("return_mask needs explicit int padding")
+    (pt, pb), (pl, pr) = pad
+
+    def _run(a):
+        N, C, H, W = a.shape
+        oh = (H + pt + pb - kh) // sh + 1
+        ow = (W + pl + pr - kw) // sw + 1
+        padded = jnp.pad(a, [(0, 0), (0, 0), (pt, pb), (pl, pr)],
+                         constant_values=-jnp.inf)
+        wins, gidx = [], []
+        for i in range(kh):
+            for j in range(kw):
+                wins.append(padded[:, :, i:i + sh * oh:sh,
+                                   j:j + sw * ow:sw])
+                gy = jnp.arange(oh) * sh + i - pt
+                gx = jnp.arange(ow) * sw + j - pl
+                gidx.append(gy[:, None] * W + gx[None, :])
+        stack = jnp.stack(wins)                      # [k, N, C, oh, ow]
+        arg = jnp.argmax(stack, axis=0)              # [N, C, oh, ow]
+        out = jnp.max(stack, axis=0)
+        g = jnp.stack(gidx)                          # [k, oh, ow]
+        flat_idx = g[arg,                            # window idx -> H*W idx
+                     jnp.arange(oh).reshape(1, 1, oh, 1),
+                     jnp.arange(ow).reshape(1, 1, 1, ow)]
+        return out, flat_idx.astype(jnp.int32)
+    from paddle_tpu.core import apply
+    out, mask = apply(_run, x, name=name)
+    mask.stop_gradient = True
+    return out, mask
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
-    out = _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
-                "max", ceil_mode, True, "max_pool1d")
-    return out
+    if return_mask:
+        from paddle_tpu.tensor.manipulation import reshape, squeeze, unsqueeze
+        k = _tuplify(kernel_size, 1) + (1,)
+        s = _tuplify(stride if stride is not None else kernel_size, 1) + (1,)
+        p = _tuplify(padding, 1) + (0,)
+        out, mask = _max_pool2d_with_mask(unsqueeze(x, -1), k, s, list(p),
+                                          "max_pool1d")
+        return squeeze(out, -1), squeeze(mask, -1)
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "max", ceil_mode, True, "max_pool1d")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
-    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
-                "max", ceil_mode, True, "max_pool2d")
     if return_mask:
-        # indices: argmax within each window (paddle returns flattened spatial idx)
-        raise NotImplementedError("return_mask=True not yet supported")
-    return out
+        if data_format != "NCHW":
+            raise ValueError("return_mask supports NCHW")
+        return _max_pool2d_with_mask(x, kernel_size, stride, padding,
+                                     "max_pool2d")
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 "max", ceil_mode, True, "max_pool2d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """reference: operators/unpool_op — scatter pooled values back to the
+    positions the mask recorded."""
+    kh, kw = _tuplify(kernel_size, 2)
+    sh, sw = _tuplify(stride if stride is not None else kernel_size, 2)
+    ph, pw = _tuplify(padding, 2)
+    from paddle_tpu.core import apply1
+
+    def _run(a, idx):
+        N, C, oh, ow = a.shape
+        if output_size is not None:
+            H, W = [int(v) for v in output_size[-2:]]
+        else:
+            H = (oh - 1) * sh - 2 * ph + kh
+            W = (ow - 1) * sw - 2 * pw + kw
+        flat = jnp.zeros((N, C, H * W), a.dtype)
+        out = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            idx.reshape(N, C, -1)].set(a.reshape(N, C, -1))
+        return out.reshape(N, C, H, W)
+    return apply1(_run, x, indices, nondiff=(1,), name="max_unpool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,

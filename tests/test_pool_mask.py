@@ -1,0 +1,59 @@
+"""max_pool return_mask + max_unpool2d (operators/pool_with_index_op +
+unpool_op roles), indices verified bitwise against torch."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+RNG = np.random.default_rng(0)
+
+
+@pytest.mark.parametrize("kernel,stride,padding", [
+    (2, 2, 0), (3, 2, 1), (2, 1, 0)])
+def test_mask_matches_torch(kernel, stride, padding):
+    x = RNG.standard_normal((2, 3, 6, 8)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), kernel, stride=stride,
+                             padding=padding, return_mask=True)
+    to, tm = torch.nn.functional.max_pool2d(
+        torch.tensor(x), kernel, stride=stride, padding=padding,
+        return_indices=True)
+    np.testing.assert_allclose(out.numpy(), to.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), tm.numpy())
+
+
+def test_unpool_roundtrip():
+    x = RNG.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                             return_mask=True)
+    un = F.max_unpool2d(out, mask, 2, stride=2).numpy()
+    tun = torch.nn.functional.max_unpool2d(
+        *torch.nn.functional.max_pool2d(torch.tensor(x), 2, stride=2,
+                                        return_indices=True),
+        2, stride=2).numpy()
+    np.testing.assert_allclose(un, tun, rtol=1e-6)
+    # every pooled value landed at its recorded position
+    flat = un.reshape(1, 2, -1)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, mask.numpy().reshape(1, 2, -1), axis=2),
+        out.numpy().reshape(1, 2, -1), rtol=1e-6)
+
+
+def test_grad_flows_to_argmax_positions():
+    x = paddle.to_tensor(RNG.standard_normal((1, 1, 4, 4))
+                         .astype(np.float32))
+    x.stop_gradient = False
+    out, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
+    out.sum().backward()
+    g = x.grad.numpy()
+    assert g.sum() == 4 and ((g == 0) | (g == 1)).all()
+
+
+def test_max_pool1d_mask():
+    x = RNG.standard_normal((2, 3, 10)).astype(np.float32)
+    o, m = F.max_pool1d(paddle.to_tensor(x), 2, return_mask=True)
+    to, tm = torch.nn.functional.max_pool1d(torch.tensor(x), 2,
+                                            return_indices=True)
+    np.testing.assert_allclose(o.numpy(), to.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(m.numpy(), tm.numpy())
