@@ -48,14 +48,17 @@ class AvgPool3D(_PoolNd):
 
 class MaxPool1D(_PoolNd):
     def forward(self, x):
-        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            **{k: v for k, v in self.kwargs.items()
+                               if k in ("return_mask",)})
 
 
 class MaxPool2D(_PoolNd):
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
                             **{k: v for k, v in self.kwargs.items()
-                               if k in ("ceil_mode", "data_format")})
+                               if k in ("ceil_mode", "data_format",
+                                        "return_mask")})
 
 
 class MaxPool3D(_PoolNd):
