@@ -1,0 +1,135 @@
+"""paddle.inference parity — the deployment-facing Predictor facade.
+
+Reference: paddle/fluid/inference/api/ (AnalysisPredictor
+analysis_predictor.cc, paddle_inference_api.h Config/Predictor/Tensor)
++ python surface paddle.inference.{Config, create_predictor}.
+
+TPU mapping: the saved artifact is jit.save's StableHLO + weights (the
+AnalysisPredictor's optimized program role — XLA *is* the analysis/
+optimization pass stack here), and the Predictor is a thin handle-based
+facade over TranslatedLayer so reference deployment code ports by
+renaming imports.  GPU/MKLDNN/TensorRT config knobs are accepted and
+recorded (XLA owns those decisions on TPU).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """paddle_infer.Config parity (the knobs that matter here: model
+    path; device selection collapses to wherever jax put the program)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # jit.save writes <path>.pdmodel/<path>.pdparams — accept either
+        # the bare prefix or the .pdmodel path
+        p = prog_file or ""
+        if p.endswith(".pdmodel"):
+            p = p[: -len(".pdmodel")]
+        self.model_prefix = p
+        self._use_gpu = False
+        self._enable_profile = False
+        self._flags: Dict[str, object] = {}
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.__init__(prog_file, params_file)
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self._use_gpu = True          # accepted; device is XLA's choice
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def switch_ir_optim(self, on: bool = True):
+        self._flags["ir_optim"] = on  # XLA always optimizes
+
+    def enable_mkldnn(self):
+        self._flags["mkldnn"] = True  # n/a on TPU, recorded for parity
+
+    def enable_tensorrt_engine(self, **kw):
+        self._flags["tensorrt"] = kw  # n/a on TPU, recorded for parity
+
+    def model_dir(self):
+        return self.model_prefix
+
+
+class PredictorTensor:
+    """paddle_infer.Tensor parity: named handle with copy_from/to_cpu."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"tensor {self.name} has no value yet — "
+                               "call Predictor.run() first")
+        return self._value
+
+
+class Predictor:
+    """paddle_infer.Predictor parity over a TranslatedLayer."""
+
+    def __init__(self, config: Config):
+        from paddle_tpu import jit
+        self.config = config
+        self._layer = jit.load(config.model_prefix)
+        n_in = max(1, len(getattr(self._layer._exported, "in_avals", []))
+                   - len(self._layer._params))
+        self._inputs = {f"input_{i}": PredictorTensor(f"input_{i}")
+                        for i in range(n_in)}
+        self._outputs: Dict[str, PredictorTensor] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs[name]
+
+    def run(self):
+        import paddle_tpu as paddle
+        args = []
+        for name, h in self._inputs.items():
+            if h._value is None:
+                raise RuntimeError(f"input {name} not set")
+            args.append(paddle.to_tensor(h._value))
+        if self.config._enable_profile:
+            from paddle_tpu.profiler import RecordEvent
+            with RecordEvent("Predictor.run"):
+                out = self._layer(*args)
+        else:
+            out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = {}
+        for i, o in enumerate(outs):
+            t = PredictorTensor(f"output_{i}")
+            t._value = np.asarray(o.numpy())
+            self._outputs[t.name] = t
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    """paddle.inference.create_predictor parity."""
+    return Predictor(config)

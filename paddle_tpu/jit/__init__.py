@@ -438,17 +438,43 @@ def save(layer, path, input_spec=None, **configs):
             return tuple(o._data if isinstance(o, Tensor) else o
                          for o in flat_out)
 
-        shapes = [jax.ShapeDtypeStruct(
-            tuple(max(s, 1) if s != -1 else 1 for s in spec.shape),
-            jnp.dtype(spec.dtype) if not isinstance(spec.dtype, str)
-            else jnp.dtype(spec.dtype)) for spec in input_spec]
+        def spec_shapes(symbolic):
+            out = []
+            n_sym = 0
+            for spec in input_spec:
+                dt = jnp.dtype(spec.dtype)
+                dims, dyn = [], False
+                for s in spec.shape:
+                    if s is None or s == -1:
+                        dims.append(f"_d{n_sym}")
+                        n_sym += 1
+                        dyn = True
+                    else:
+                        dims.append(str(int(s)))
+                if symbolic and dyn:
+                    out.append(jax.ShapeDtypeStruct(
+                        jax_export.symbolic_shape(",".join(dims)), dt))
+                else:
+                    out.append(jax.ShapeDtypeStruct(
+                        tuple(1 if s in (None, -1) else int(s)
+                              for s in spec.shape), dt))
+            return out
+
         param_shapes = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype)
                         for _, p in named_params]
         buffer_shapes = [jax.ShapeDtypeStruct(tuple(b.shape), b.dtype)
                          for _, b in named_buffers]
         try:
-            exp = jax_export.export(jax.jit(pure))(*param_shapes,
-                                                   *buffer_shapes, *shapes)
+            # dynamic dims export as shape-polymorphic symbols so the
+            # loaded Predictor accepts any batch size (the reference's
+            # -1 dims); ops that can't trace polymorphically fall back
+            # to a concrete batch-1 export
+            try:
+                exp = jax_export.export(jax.jit(pure))(
+                    *param_shapes, *buffer_shapes, *spec_shapes(True))
+            except Exception:                  # noqa: BLE001
+                exp = jax_export.export(jax.jit(pure))(
+                    *param_shapes, *buffer_shapes, *spec_shapes(False))
             with open(path + ".pdmodel", "wb") as f:
                 f.write(exp.serialize())
         finally:

@@ -1,0 +1,67 @@
+"""paddle.inference Predictor facade (inference/api AnalysisPredictor +
+paddle_inference_api.h roles) over the StableHLO jit.save artifact."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, PredictorTensor, create_predictor
+from paddle_tpu.static import InputSpec
+
+
+def _save_model(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 4], "float32")])
+    return net, prefix
+
+
+class TestPredictor:
+    def test_run_matches_eager(self, tmp_path):
+        net, prefix = _save_model(tmp_path)
+        pred = create_predictor(Config(prefix))
+        names = pred.get_input_names()
+        assert len(names) == 1
+        x = np.random.default_rng(0).standard_normal(
+            (3, 4)).astype(np.float32)
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        assert pred.run()
+        out_names = pred.get_output_names()
+        out = pred.get_output_handle(out_names[0]).copy_to_cpu()
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_pdmodel_path_accepted(self, tmp_path):
+        _net, prefix = _save_model(tmp_path)
+        cfg = Config(prefix + ".pdmodel")
+        assert cfg.model_prefix == prefix
+        pred = create_predictor(cfg)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(np.zeros((1, 4), np.float32))
+        pred.run()
+
+    def test_compat_knobs_accepted(self, tmp_path):
+        _net, prefix = _save_model(tmp_path)
+        cfg = Config(prefix)
+        cfg.enable_use_gpu(100, 0)
+        cfg.disable_gpu()
+        cfg.switch_ir_optim(True)
+        cfg.enable_mkldnn()
+        cfg.enable_tensorrt_engine(workspace_size=1 << 20)
+        cfg.enable_profile()
+        pred = create_predictor(cfg)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        h.copy_from_cpu(np.ones((2, 4), np.float32))
+        assert pred.run()
+
+    def test_unset_input_and_output_errors(self, tmp_path):
+        _net, prefix = _save_model(tmp_path)
+        pred = create_predictor(Config(prefix))
+        with pytest.raises(RuntimeError, match="not set"):
+            pred.run()
+        t = PredictorTensor("x")
+        with pytest.raises(RuntimeError, match="no value"):
+            t.copy_to_cpu()
