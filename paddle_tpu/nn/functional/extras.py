@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from paddle_tpu.core import Tensor, apply1
 
 __all__ = ["grid_sample", "affine_grid", "temporal_shift",
+           "linear_chain_crf", "viterbi_decode",
            "bilinear_tensor_product", "hsigmoid_loss", "diag_embed", "erf",
            # aliases
            "roi_align", "roi_pool", "yolo_box", "prior_box", "box_coder",
@@ -311,3 +312,101 @@ def sequence_conv(input, lengths, weight, bias=None, context_length=3,
         return jnp.where(mask, out, 0.0)
     args = (input, lengths, weight) + ((bias,) if bias is not None else ())
     return apply1(_sc, *args, nondiff=(1,), name="sequence_conv")
+
+
+def linear_chain_crf(emission, transition, label, length=None, name=None):
+    """Linear-chain CRF negative log-likelihood (reference:
+    operators/linear_chain_crf_op.h).  Layout matches the reference:
+    ``transition`` is [K+2, K] — row 0 start scores, row 1 stop scores,
+    rows 2.. the [K, K] transition matrix.  Inputs are padded-dense:
+    emission [B, T, K], label [B, T], length [B] (None = full T).
+    Returns per-sequence NLL [B, 1]; differentiable in emission and
+    transition (the forward algorithm is a lax.scan of logsumexps).
+    """
+    from paddle_tpu.core import Tensor as _T
+    if length is None:
+        import numpy as _np
+        length = _T(jnp.full((emission.shape[0],), emission.shape[1],
+                             jnp.int64))
+
+    def _nll(em, trans, lbl, lens):
+        B, T, K = em.shape
+        start, stop, A = trans[0], trans[1], trans[2:]
+        lbl = lbl.astype(jnp.int32)
+        lens = lens.astype(jnp.int32)
+        # -- partition function: forward algorithm over time ------------
+        alpha0 = start[None, :] + em[:, 0]                    # [B, K]
+
+        def step(alpha, t):
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, :, None] + A[None], axis=1) + em[:, t]
+            keep = (t < lens)[:, None]
+            return jnp.where(keep, nxt, alpha), None
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        logZ = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=1)
+        # -- gold path score --------------------------------------------
+        t_idx = jnp.arange(T)[None, :]
+        valid = t_idx < lens[:, None]                         # [B, T]
+        em_score = jnp.sum(jnp.where(
+            valid, jnp.take_along_axis(em, lbl[:, :, None],
+                                       axis=2)[:, :, 0], 0.0), axis=1)
+        prev, nxt = lbl[:, :-1], lbl[:, 1:]
+        trans_valid = t_idx[:, 1:] < lens[:, None]
+        tr_score = jnp.sum(jnp.where(trans_valid, A[prev, nxt], 0.0),
+                           axis=1)
+        last = jnp.take_along_axis(lbl, (lens - 1)[:, None], axis=1)[:, 0]
+        gold = em_score + tr_score + start[lbl[:, 0]] + stop[last]
+        return (logZ - gold)[:, None]
+    return apply1(_nll, emission, transition, label, length,
+                  nondiff=(2, 3), name="linear_chain_crf")
+
+
+def viterbi_decode(emission, transition, length=None,
+                   include_start_end_tag=True, name=None):
+    """Viterbi best path (reference: operators/crf_decoding_op.h; also
+    the paddle.text.viterbi_decode surface).  Same [K+2, K] transition
+    layout as linear_chain_crf.  Returns (scores [B], path [B, T]) with
+    positions past each length zeroed."""
+    from paddle_tpu.core import Tensor as _T
+    if length is None:
+        length = _T(jnp.full((emission.shape[0],), emission.shape[1],
+                             jnp.int64))
+
+    def _vit(em, trans, lens):
+        B, T, K = em.shape
+        start, stop, A = trans[0], trans[1], trans[2:]
+        lens = lens.astype(jnp.int32)
+        alpha0 = start[None, :] + em[:, 0]
+
+        def step(alpha, t):
+            cand = alpha[:, :, None] + A[None]            # [B, K, K]
+            best = jnp.max(cand, axis=1) + em[:, t]
+            bp = jnp.argmax(cand, axis=1).astype(jnp.int32)
+            keep = (t < lens)[:, None]
+            return jnp.where(keep, best, alpha), \
+                jnp.where(keep, bp, jnp.broadcast_to(
+                    jnp.arange(K, dtype=jnp.int32)[None, :], (B, K)))
+        alpha, bps = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        final = alpha + stop[None, :]
+        scores = jnp.max(final, axis=1)
+        last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)
+
+        # backtrace: walk bps [T-1, B, K] from each sequence's end
+        def back(tag, bt):
+            prev = bt[jnp.arange(tag.shape[0]), tag]
+            return prev, tag
+        _, tags_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+        # tags_rev[t] is the tag at t+1; prepend the traced first tag
+        first = bps[0][jnp.arange(B), tags_rev[0]] if T > 1 else last_tag
+        # simpler: recompute full path via scan carrying position masks
+        path = jnp.concatenate(
+            [first[None] if T > 1 else last_tag[None],
+             tags_rev.reshape(T - 1, B) if T > 1 else
+             jnp.zeros((0, B), jnp.int32)], axis=0).T      # [B, T]
+        t_idx = jnp.arange(T)[None, :]
+        return scores, jnp.where(t_idx < lens[:, None], path, 0)
+    from paddle_tpu.core import apply
+    scores, path = apply(_vit, emission, transition, length, nondiff=(2,),
+                         name="viterbi_decode")
+    path.stop_gradient = True
+    return scores, path

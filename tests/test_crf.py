@@ -1,0 +1,123 @@
+"""Linear-chain CRF (linear_chain_crf_op.h forward NLL + crf_decoding_op.h
+viterbi) verified against brute-force path enumeration."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad
+
+RNG = np.random.default_rng(0)
+
+
+def _brute(em, trans, lens):
+    """All-paths logZ + best path by enumeration (tiny K, T)."""
+    B, T, K = em.shape
+    start, stop, A = trans[0], trans[1], trans[2:]
+    logZ, best_scores, best_paths = [], [], []
+    for b in range(B):
+        L = int(lens[b])
+        scores = {}
+        for path in itertools.product(range(K), repeat=L):
+            s = start[path[0]] + em[b, 0, path[0]] + stop[path[-1]]
+            for t in range(1, L):
+                s += A[path[t - 1], path[t]] + em[b, t, path[t]]
+            scores[path] = s
+        vals = np.array(list(scores.values()))
+        logZ.append(np.log(np.exp(vals - vals.max()).sum()) + vals.max())
+        bp = max(scores, key=scores.get)
+        best_scores.append(scores[bp])
+        best_paths.append(list(bp) + [0] * (T - L))
+    return np.array(logZ), np.array(best_scores), np.array(best_paths)
+
+
+def _score_gold(em, trans, labels, lens):
+    start, stop, A = trans[0], trans[1], trans[2:]
+    out = []
+    for b in range(em.shape[0]):
+        L = int(lens[b])
+        y = labels[b]
+        s = start[y[0]] + em[b, 0, y[0]] + stop[y[L - 1]]
+        for t in range(1, L):
+            s += A[y[t - 1], y[t]] + em[b, t, y[t]]
+        out.append(s)
+    return np.array(out)
+
+
+class TestLinearChainCRF:
+    def test_nll_matches_enumeration(self):
+        B, T, K = 3, 4, 3
+        em = RNG.standard_normal((B, T, K)).astype(np.float64)
+        trans = RNG.standard_normal((K + 2, K)).astype(np.float64)
+        lens = np.array([4, 2, 3], np.int64)
+        labels = RNG.integers(0, K, size=(B, T)).astype(np.int64)
+        nll = F.linear_chain_crf(
+            paddle.to_tensor(em), paddle.to_tensor(trans),
+            paddle.to_tensor(labels), paddle.to_tensor(lens)).numpy()[:, 0]
+        logZ, _, _ = _brute(em, trans, lens)
+        gold = _score_gold(em, trans, labels, lens)
+        np.testing.assert_allclose(nll, logZ - gold, rtol=1e-6)
+
+    def test_grad_check(self):
+        B, T, K = 2, 3, 2
+        em = RNG.standard_normal((B, T, K))
+        trans = RNG.standard_normal((K + 2, K))
+        labels = RNG.integers(0, K, size=(B, T)).astype(np.int64)
+        lens = np.array([3, 2], np.int64)
+        check_grad(lambda e, tr: F.linear_chain_crf(
+            e, tr, paddle.to_tensor(labels), paddle.to_tensor(lens)),
+            [em, trans], wrt=(0, 1))
+
+    def test_training_improves_likelihood(self):
+        paddle.seed(0)
+        B, T, K = 8, 5, 4
+        em_w = paddle.create_parameter([B, T, K], "float32")
+        trans = paddle.create_parameter([K + 2, K], "float32")
+        labels = paddle.to_tensor(
+            RNG.integers(0, K, size=(B, T)).astype(np.int64))
+        lens = paddle.to_tensor(np.full((B,), T, np.int64))
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=[em_w, trans])
+        losses = []
+        for _ in range(30):
+            loss = F.linear_chain_crf(em_w, trans, labels, lens).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.2
+
+
+class TestViterbi:
+    def test_matches_enumeration(self):
+        B, T, K = 3, 4, 3
+        em = RNG.standard_normal((B, T, K)).astype(np.float64)
+        trans = RNG.standard_normal((K + 2, K)).astype(np.float64)
+        lens = np.array([4, 2, 3], np.int64)
+        scores, path = F.viterbi_decode(
+            paddle.to_tensor(em), paddle.to_tensor(trans),
+            paddle.to_tensor(lens))
+        _, bscores, bpaths = _brute(em, trans, lens)
+        np.testing.assert_allclose(scores.numpy(), bscores, rtol=1e-6)
+        np.testing.assert_array_equal(path.numpy(), bpaths)
+
+    def test_decode_recovers_training_labels(self):
+        """After CRF training, viterbi should decode the trained labels."""
+        paddle.seed(0)
+        B, T, K = 4, 5, 3
+        em_w = paddle.create_parameter([B, T, K], "float32")
+        trans = paddle.create_parameter([K + 2, K], "float32")
+        labels = RNG.integers(0, K, size=(B, T)).astype(np.int64)
+        lens = paddle.to_tensor(np.full((B,), T, np.int64))
+        opt = paddle.optimizer.Adam(learning_rate=0.2,
+                                    parameters=[em_w, trans])
+        for _ in range(60):
+            loss = F.linear_chain_crf(em_w, trans,
+                                      paddle.to_tensor(labels), lens).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        _, path = F.viterbi_decode(em_w, trans, lens)
+        assert (path.numpy() == labels).mean() > 0.9
