@@ -1,0 +1,29 @@
+"""paddle.onnx.export surface.
+
+Reference: python/paddle/onnx/export.py (paddle2onnx bridge).  The
+TPU-native interchange format is StableHLO (jit.save's .pdmodel):
+portable, versioned, and loadable by anything that speaks MLIR —
+the role ONNX plays for the reference's deployment story.  ``export``
+therefore produces the StableHLO artifact; passing ``opset_version``
+etc. is accepted for call-site compatibility and recorded in the
+returned metadata.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 9, **configs):
+    """Export ``layer`` for deployment.  Writes ``path.pdmodel``
+    (StableHLO) + ``path.pdparams`` via paddle_tpu.jit.save and returns
+    the artifact paths."""
+    from paddle_tpu import jit
+    if input_spec is None:
+        raise ValueError("onnx.export needs input_spec to trace the "
+                         "graph (same requirement as the reference)")
+    jit.save(layer, path, input_spec=input_spec)
+    return {"model": path + ".pdmodel", "params": path + ".pdparams",
+            "format": "stablehlo", "requested_opset": opset_version}
