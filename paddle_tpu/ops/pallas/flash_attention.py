@@ -62,12 +62,23 @@ def supported(q_shape, k_shape, no_mask: bool, causal: bool = False) -> bool:
         return False
     # the grid floors seq/block: a remainder would leave trailing queries
     # unwritten and trailing keys ignored, so block divisibility is required
-    block_q = min(BLOCK_Q, sq)
-    block_k = min(BLOCK_K, sk)
+    block_q = _pick_block(BLOCK_Q, sq)
+    block_k = _pick_block(BLOCK_K, sk)
     if sq % block_q or sk % block_k:
         return False
     return sq % _MIN_BLOCK == 0 and sk % _MIN_BLOCK == 0 and sq >= _MIN_BLOCK \
         and sk >= _MIN_BLOCK
+
+
+
+def _pick_block(pref: int, seq: int) -> int:
+    """Largest block <= pref that divides seq, halving down to _MIN_BLOCK
+    (keeps e.g. seq=384 on the kernel path instead of silently falling
+    back to the O(S^2) XLA reference)."""
+    b = min(pref, seq)
+    while b > _MIN_BLOCK and seq % b:
+        b //= 2
+    return max(b, _MIN_BLOCK)
 
 
 # ---------------------------------------------------------------------------
@@ -134,8 +145,8 @@ def _flash_fwd(q, k, v, scale, causal):
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(BLOCK_Q, sq)
-    block_k = min(BLOCK_K, sk)
+    block_q = _pick_block(BLOCK_Q, sq)
+    block_k = _pick_block(BLOCK_K, sk)
     n_kb = sk // block_k
 
     # fold batch and heads; put seq last-but-one for tiling
@@ -276,8 +287,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal):
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(BLOCK_Q, sq)
-    block_k = min(BLOCK_K, sk)
+    block_q = _pick_block(BLOCK_Q, sq)
+    block_k = _pick_block(BLOCK_K, sk)
     n_qb = sq // block_q
     n_kb = sk // block_k
     off = sk - sq
