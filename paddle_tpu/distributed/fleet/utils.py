@@ -1,0 +1,107 @@
+"""fleet.utils — user-facing recompute (activation checkpointing).
+
+Reference: python/paddle/distributed/fleet/utils/recompute.py
+(RecomputeFunction — forward runs without storing intermediates, backward
+replays the block under the saved RNG state and differentiates through
+the replay).
+
+Tape mapping: the block executes once under ``no_grad`` (no per-op
+TapeNodes / residuals held) and registers ONE TapeNode.  Its pullback,
+invoked at backward time, replays ``function`` with the tape ON and runs
+the reverse sweep over that fresh sub-tape — so gradients reach both the
+explicit tensor args *and* any parameters the closure captures (Layer
+weights), exactly like the reference's replayed dygraph backward.  RNG
+state is snapshotted/restored so dropout masks match (preserve_rng_state).
+Inside ``jit``/``TrainStep`` use ``TrainStep(recompute=True)`` instead
+(jax.checkpoint is the in-trace form).
+"""
+from __future__ import annotations
+
+import weakref
+
+from paddle_tpu.core import (Tensor, TapeNode, _is_float_dtype, enable_grad,
+                             is_grad_enabled, no_grad)
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args)`` without keeping its activations; backward
+    replays it.  Returns the function's outputs (Tensor or tuple).
+    Not composable with ``paddle.grad(create_graph=True)`` through the
+    checkpointed block (same restriction as the reference)."""
+    from paddle_tpu.tensor.random import default_generator
+
+    grad_pos = [i for i, a in enumerate(args)
+                if isinstance(a, Tensor) and not a.stop_gradient
+                and _is_float_dtype(a.dtype)]
+    rng_state = default_generator.get_state() if preserve_rng_state else None
+
+    def run_block(track: bool):
+        wrapped = []
+        leaf_map = []
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                t = Tensor(a._data,
+                           stop_gradient=not (track and i in grad_pos))
+                wrapped.append(t)
+                if i in grad_pos:
+                    leaf_map.append(t)
+            else:
+                wrapped.append(a)
+        if rng_state is not None:
+            saved = default_generator.get_state()
+            default_generator.set_state(rng_state)
+        try:
+            if track:
+                with enable_grad():
+                    out = function(*wrapped, **kwargs)
+            else:
+                with no_grad():
+                    out = function(*wrapped, **kwargs)
+        finally:
+            if rng_state is not None:
+                default_generator.set_state(saved)
+        return out, leaf_map
+
+    out, _ = run_block(track=False)
+    seq = isinstance(out, (tuple, list))
+    out_list = list(out) if seq else [out]
+    # track whenever grads are on: even with no differentiable *args*,
+    # closure-captured parameters still need the replayed backward
+    track = is_grad_enabled()
+    outs = [Tensor(o._data if isinstance(o, Tensor) else o,
+                   stop_gradient=not track) for o in out_list]
+    if not track:
+        return tuple(outs) if seq else outs[0]
+
+    def deferred_vjp(cot):
+        # THE recompute: replay with the tape on, then reverse-sweep the
+        # sub-tape.  Closure-captured parameters accumulate into their
+        # .grad during this sweep (the reference's replayed backward);
+        # grads of the explicit args are captured and handed back to the
+        # outer engine.
+        from paddle_tpu.autograd import _run_engine
+        out2, leaves = run_block(track=True)
+        outs2 = list(out2) if isinstance(out2, (tuple, list)) else [out2]
+        cots = list(cot) if isinstance(cot, (tuple, list)) else [cot]
+        roots, root_grads = [], []
+        for o, c in zip(outs2, cots):
+            if isinstance(o, Tensor) and o._node is not None:
+                roots.append(o)
+                root_grads.append(c)
+        capture = {id(t): None for t in leaves}
+        _run_engine(roots, root_grads, retain_graph=False,
+                    accumulate_into_grad=True, capture=capture)
+        return tuple(capture[id(t)] for t in leaves)
+
+    node = TapeNode(
+        deferred_vjp, [args[i] for i in grad_pos],
+        [weakref.ref(t) for t in outs], name="recompute",
+        out_is_seq=seq,
+        out_avals=[(t._data.shape, t._data.dtype) for t in outs])
+    for idx, t in enumerate(outs):
+        t._node = node
+        t._out_index = idx
+        t.is_leaf_ = False
+    return tuple(outs) if seq else outs[0]
