@@ -31,10 +31,13 @@ import math
 import jax
 import jax.numpy as jnp
 
-# swept on one chip at S=8192/D=128 fwd+bwd: 256/256 ≈ 2× faster than
-# 512/512 and beats every 128/512 mix (VMEM residency sweet spot)
-BLOCK_Q = 256
-BLOCK_K = 256
+# Block choice: isolated fp32 fwd+bwd sweeps at S=8192 prefer 256/256,
+# but in-model (bf16 + remat + optimizer, GPT-2 and 8k-GPT train steps)
+# 512/512 measures ~20% faster end-to-end — bf16 tiles halve VMEM
+# pressure, so the larger block wins where it matters.  _pick_block
+# halves toward _MIN_BLOCK for sequences 512 doesn't divide.
+BLOCK_Q = 512
+BLOCK_K = 512
 _MIN_BLOCK = 128
 
 # tests flip this to run the kernels in interpreter mode on CPU
