@@ -35,9 +35,12 @@ def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
     grad_pos = [i for i, a in enumerate(args)
                 if isinstance(a, Tensor) and not a.stop_gradient
                 and _is_float_dtype(a.dtype)]
+    # snapshot BEFORE the primary forward; the forward itself advances the
+    # generator normally (two recomputed dropout blocks must not correlate)
+    # and only the backward REPLAY rewinds to this state
     rng_state = default_generator.get_state() if preserve_rng_state else None
 
-    def run_block(track: bool):
+    def run_block(track: bool, replay: bool):
         wrapped = []
         leaf_map = []
         for i, a in enumerate(args):
@@ -49,8 +52,9 @@ def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
                     leaf_map.append(t)
             else:
                 wrapped.append(a)
-        if rng_state is not None:
-            saved = default_generator.get_state()
+        saved = default_generator.get_state() if replay and \
+            rng_state is not None else None
+        if saved is not None:
             default_generator.set_state(rng_state)
         try:
             if track:
@@ -60,11 +64,11 @@ def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
                 with no_grad():
                     out = function(*wrapped, **kwargs)
         finally:
-            if rng_state is not None:
+            if saved is not None:
                 default_generator.set_state(saved)
         return out, leaf_map
 
-    out, _ = run_block(track=False)
+    out, _ = run_block(track=False, replay=False)
     seq = isinstance(out, (tuple, list))
     out_list = list(out) if seq else [out]
     # track whenever grads are on: even with no differentiable *args*,
@@ -76,23 +80,33 @@ def recompute(function, *args, preserve_rng_state: bool = True, **kwargs):
         return tuple(outs) if seq else outs[0]
 
     def deferred_vjp(cot):
-        # THE recompute: replay with the tape on, then reverse-sweep the
-        # sub-tape.  Closure-captured parameters accumulate into their
-        # .grad during this sweep (the reference's replayed backward);
-        # grads of the explicit args are captured and handed back to the
-        # outer engine.
+        # THE recompute: replay with the tape on (RNG rewound so masks
+        # match the primary forward), then reverse-sweep the sub-tape.
+        # Closure-captured parameters accumulate into their .grad during
+        # this sweep (the reference's replayed backward); grads of the
+        # explicit args are captured and handed back to the outer engine.
+        # retain_graph=True so nodes the closure shares with the OUTER
+        # graph (non-leaf captures) are not freed out from under it.
         from paddle_tpu.autograd import _run_engine
-        out2, leaves = run_block(track=True)
+        out2, leaves = run_block(track=True, replay=True)
         outs2 = list(out2) if isinstance(out2, (tuple, list)) else [out2]
         cots = list(cot) if isinstance(cot, (tuple, list)) else [cot]
+        capture = {id(t): None for t in leaves}
         roots, root_grads = [], []
         for o, c in zip(outs2, cots):
-            if isinstance(o, Tensor) and o._node is not None:
+            if not isinstance(o, Tensor):
+                continue
+            if o._node is not None:
                 roots.append(o)
                 root_grads.append(c)
-        capture = {id(t): None for t in leaves}
-        _run_engine(roots, root_grads, retain_graph=False,
-                    accumulate_into_grad=True, capture=capture)
+            elif id(o) in capture:
+                # output is a pass-through of an input: its cotangent
+                # feeds that leaf directly
+                prev = capture[id(o)]
+                capture[id(o)] = c if prev is None else prev + c
+        if roots:
+            _run_engine(roots, root_grads, retain_graph=True,
+                        accumulate_into_grad=True, capture=capture)
         return tuple(capture[id(t)] for t in leaves)
 
     node = TapeNode(

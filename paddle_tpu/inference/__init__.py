@@ -37,7 +37,10 @@ class Config:
         self._flags: Dict[str, object] = {}
 
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
-        self.__init__(prog_file, params_file)
+        p = prog_file or ""
+        if p.endswith(".pdmodel"):
+            p = p[: -len(".pdmodel")]
+        self.model_prefix = p          # paths only; knobs stay configured
 
     def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
                        device_id: int = 0):

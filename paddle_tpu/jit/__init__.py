@@ -472,7 +472,13 @@ def save(layer, path, input_spec=None, **configs):
             try:
                 exp = jax_export.export(jax.jit(pure))(
                     *param_shapes, *buffer_shapes, *spec_shapes(True))
-            except Exception:                  # noqa: BLE001
+            except Exception as e:             # noqa: BLE001
+                import warnings
+                warnings.warn(
+                    f"jit.save: shape-polymorphic export failed ({e!r}); "
+                    "falling back to a CONCRETE batch-1 export — the "
+                    "loaded model will only accept the saved shapes",
+                    stacklevel=2)
                 exp = jax_export.export(jax.jit(pure))(
                     *param_shapes, *buffer_shapes, *spec_shapes(False))
             with open(path + ".pdmodel", "wb") as f:

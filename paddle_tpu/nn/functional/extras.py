@@ -364,9 +364,11 @@ def linear_chain_crf(emission, transition, label, length=None, name=None):
 def viterbi_decode(emission, transition, length=None,
                    include_start_end_tag=True, name=None):
     """Viterbi best path (reference: operators/crf_decoding_op.h; also
-    the paddle.text.viterbi_decode surface).  Same [K+2, K] transition
-    layout as linear_chain_crf.  Returns (scores [B], path [B, T]) with
-    positions past each length zeroed."""
+    the paddle.text.viterbi_decode surface).  With
+    ``include_start_end_tag=True`` the transition uses the same [K+2, K]
+    layout as linear_chain_crf (row 0 start, row 1 stop); with False it
+    is a plain [K, K] matrix and start/stop scores are zero.  Returns
+    (scores [B], path [B, T]) with positions past each length zeroed."""
     from paddle_tpu.core import Tensor as _T
     if length is None:
         length = _T(jnp.full((emission.shape[0],), emission.shape[1],
@@ -374,7 +376,12 @@ def viterbi_decode(emission, transition, length=None,
 
     def _vit(em, trans, lens):
         B, T, K = em.shape
-        start, stop, A = trans[0], trans[1], trans[2:]
+        if include_start_end_tag:
+            start, stop, A = trans[0], trans[1], trans[2:]
+        else:
+            start = jnp.zeros((trans.shape[1],), trans.dtype)
+            stop = start
+            A = trans
         lens = lens.astype(jnp.int32)
         alpha0 = start[None, :] + em[:, 0]
 

@@ -72,7 +72,8 @@ def _pool(x, kernel, stride, padding, n, channel_last, mode, ceil_mode,
     return apply1(_run, x, name=name)
 
 
-def _max_pool2d_with_mask(x, kernel, stride, padding, name):
+def _max_pool2d_with_mask(x, kernel, stride, padding, name,
+                          ceil_mode=False):
     """Max pool that also returns the argmax as flattened H*W input
     indices (reference: operators/pool_with_index_op — the mask consumed
     by max_unpool2d).  NCHW only; windows are materialised as kh*kw
@@ -85,11 +86,19 @@ def _max_pool2d_with_mask(x, kernel, stride, padding, name):
         raise ValueError("return_mask needs explicit int padding")
     (pt, pb), (pl, pr) = pad
 
+    def _n_out(size, p0, p1, k, s):
+        span = size + p0 + p1 - k
+        return (-(-span // s) if ceil_mode else span // s) + 1
+
     def _run(a):
         N, C, H, W = a.shape
-        oh = (H + pt + pb - kh) // sh + 1
-        ow = (W + pl + pr - kw) // sw + 1
-        padded = jnp.pad(a, [(0, 0), (0, 0), (pt, pb), (pl, pr)],
+        oh = _n_out(H, pt, pb, kh, sh)
+        ow = _n_out(W, pl, pr, kw, sw)
+        # ceil mode may need the bottom/right padding widened so every
+        # window has backing data (-inf filled, never the argmax)
+        pb_e = max(pb, (oh - 1) * sh + kh - H - pt)
+        pr_e = max(pr, (ow - 1) * sw + kw - W - pl)
+        padded = jnp.pad(a, [(0, 0), (0, 0), (pt, pb_e), (pl, pr_e)],
                          constant_values=-jnp.inf)
         wins, gidx = [], []
         for i in range(kh):
@@ -116,13 +125,20 @@ def _max_pool2d_with_mask(x, kernel, stride, padding, name):
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     if return_mask:
-        from paddle_tpu.tensor.manipulation import reshape, squeeze, unsqueeze
+        from paddle_tpu.tensor.manipulation import squeeze, transpose, unsqueeze
+        if data_format == "NLC":
+            x = transpose(x, [0, 2, 1])
         k = _tuplify(kernel_size, 1) + (1,)
         s = _tuplify(stride if stride is not None else kernel_size, 1) + (1,)
         p = _tuplify(padding, 1) + (0,)
         out, mask = _max_pool2d_with_mask(unsqueeze(x, -1), k, s, list(p),
-                                          "max_pool1d")
-        return squeeze(out, -1), squeeze(mask, -1)
+                                          "max_pool1d",
+                                          ceil_mode=ceil_mode)
+        out, mask = squeeze(out, -1), squeeze(mask, -1)
+        if data_format == "NLC":
+            out = transpose(out, [0, 2, 1])
+            mask = transpose(mask, [0, 2, 1])
+        return out, mask
     return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
                  "max", ceil_mode, True, "max_pool1d")
 
@@ -133,7 +149,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
         if data_format != "NCHW":
             raise ValueError("return_mask supports NCHW")
         return _max_pool2d_with_mask(x, kernel_size, stride, padding,
-                                     "max_pool2d")
+                                     "max_pool2d", ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
                  "max", ceil_mode, True, "max_pool2d")
 
