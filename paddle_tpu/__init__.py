@@ -128,3 +128,132 @@ def set_flags(flags):
 
 def summary_(*a, **k):  # placeholder to avoid name clash
     raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# fluid-era top-level compat surface (the reference's paddle/__init__.py
+# re-exports these; kept as thin aliases so 2.0-era scripts import clean)
+# ---------------------------------------------------------------------------
+from paddle_tpu.hapi import callbacks  # noqa: E402,F401
+from paddle_tpu.framework.selected_rows import SelectedRows as _SR  # noqa: E402
+
+LoDTensor = Tensor          # LoD collapsed into explicit ragged encodings
+VarBase = Tensor
+LoDTensorArray = list
+commit = "tpu-native"
+full_version = __version__
+
+elementwise_add = tensor.add
+elementwise_sub = tensor.subtract
+elementwise_div = tensor.divide
+elementwise_floordiv = tensor.floor_divide
+elementwise_mod = tensor.remainder
+elementwise_pow = tensor.pow
+reduce_sum = tensor.sum
+reduce_mean = tensor.mean
+reduce_max = tensor.max
+reduce_min = tensor.min
+reduce_prod = tensor.prod
+fill_constant = tensor.full
+crop_tensor = tensor.crop
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(_jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rank(input):
+    return tensor.rank(input)
+
+
+def shape(input):
+    from paddle_tpu.core import Tensor as _T
+    import numpy as _np
+    return _T(_np.asarray(input.shape, _np.int32))
+
+
+def has_nan(x):
+    return tensor.logic.is_nan_any(x) if hasattr(tensor.logic, "is_nan_any") \
+        else apply1_has(_jnp.isnan, x)
+
+
+def has_inf(x):
+    return apply1_has(_jnp.isinf, x)
+
+
+def apply1_has(fn, x):
+    from paddle_tpu.core import apply1
+    return apply1(lambda a: fn(a).any(), x, name="has_check")
+
+
+def tanh_(x):
+    x._data = _jnp.tanh(x._data)
+    return x
+
+
+def scatter_(x, index, updates, overwrite=True):
+    i = index._data if hasattr(index, "_data") else index
+    u = updates._data if hasattr(updates, "_data") else updates
+    x._data = (x._data.at[i].set(u) if overwrite
+               else x._data.at[i].add(u))
+    return x
+
+
+def get_tensor_from_selected_rows(x):
+    from paddle_tpu.core import Tensor as _T
+    return _T(x.to_dense()) if isinstance(x, _SR) else x
+
+
+def in_dygraph_mode():
+    return in_dynamic_mode()
+
+
+def enable_dygraph(place=None):
+    disable_static()
+
+
+def disable_dygraph():
+    enable_static()
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from paddle_tpu.tensor.creation import full as _full
+    t = _full(shape, value, dtype=dtype)
+    t.stop_gradient = not persistable
+    return t
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)
+
+
+def get_cudnn_version():
+    return None          # no cuDNN here; XLA owns kernel selection
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """static data layer → InputSpec (the capture-tier equivalent)."""
+    from paddle_tpu.static import InputSpec
+    return InputSpec(shape, dtype=dtype, name=name)
