@@ -172,18 +172,17 @@ def shape(input):
     return _T(_np.asarray(input.shape, _np.int32))
 
 
+def _has_any(fn, x):
+    from paddle_tpu.core import apply1
+    return apply1(lambda a: fn(a).any(), x, name="has_check")
+
+
 def has_nan(x):
-    return tensor.logic.is_nan_any(x) if hasattr(tensor.logic, "is_nan_any") \
-        else apply1_has(_jnp.isnan, x)
+    return _has_any(_jnp.isnan, x)
 
 
 def has_inf(x):
-    return apply1_has(_jnp.isinf, x)
-
-
-def apply1_has(fn, x):
-    from paddle_tpu.core import apply1
-    return apply1(lambda a: fn(a).any(), x, name="has_check")
+    return _has_any(_jnp.isinf, x)
 
 
 def tanh_(x):
