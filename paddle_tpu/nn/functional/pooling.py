@@ -257,11 +257,34 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        from paddle_tpu.tensor.manipulation import squeeze, unsqueeze
+        L = int(x.shape[-1])
+        o = output_size if isinstance(output_size, int) else output_size[0]
+        if L % o:
+            raise NotImplementedError(
+                "adaptive_max_pool1d(return_mask=True) needs input length "
+                "divisible by output_size (uniform windows)")
+        out, mask = _max_pool2d_with_mask(unsqueeze(x, -1), (L // o, 1),
+                                          (L // o, 1), [(0, 0), (0, 0)],
+                                          "adaptive_max_pool1d")
+        return squeeze(out, -1), squeeze(mask, -1)
     return _adaptive_pool(x, output_size, 1, "max", False,
                           "adaptive_max_pool1d")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        H, W = int(x.shape[-2]), int(x.shape[-1])
+        oh, ow = _tuplify(output_size, 2)
+        if H % oh or W % ow:
+            raise NotImplementedError(
+                "adaptive_max_pool2d(return_mask=True) needs input dims "
+                "divisible by output_size (uniform windows)")
+        return _max_pool2d_with_mask(x, (H // oh, W // ow),
+                                     (H // oh, W // ow),
+                                     [(0, 0), (0, 0)],
+                                     "adaptive_max_pool2d")
     return _adaptive_pool(x, output_size, 2, "max", False,
                           "adaptive_max_pool2d")
 

@@ -57,3 +57,14 @@ def test_max_pool1d_mask():
                                             return_indices=True)
     np.testing.assert_allclose(o.numpy(), to.numpy(), rtol=1e-6)
     np.testing.assert_array_equal(m.numpy(), tm.numpy())
+
+
+def test_adaptive_mask_matches_torch():
+    x = RNG.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    o, m = F.adaptive_max_pool2d(paddle.to_tensor(x), 4, return_mask=True)
+    to, tm = torch.nn.functional.adaptive_max_pool2d(
+        torch.tensor(x), 4, return_indices=True)
+    np.testing.assert_allclose(o.numpy(), to.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(m.numpy(), tm.numpy())
+    with pytest.raises(NotImplementedError, match="divisible"):
+        F.adaptive_max_pool2d(paddle.to_tensor(x), 3, return_mask=True)
