@@ -154,8 +154,12 @@ reduce_mean = tensor.mean
 reduce_max = tensor.max
 reduce_min = tensor.min
 reduce_prod = tensor.prod
-fill_constant = tensor.full
 crop_tensor = tensor.crop
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """fluid.layers.fill_constant argument order (shape, dtype, value)."""
+    return tensor.full(shape, value, dtype=dtype)
 
 
 def broadcast_shape(x_shape, y_shape):
@@ -163,7 +167,9 @@ def broadcast_shape(x_shape, y_shape):
 
 
 def rank(input):
-    return tensor.rank(input)
+    import numpy as _np
+    from paddle_tpu.core import Tensor as _T
+    return _T(_np.int64(input.ndim))
 
 
 def shape(input):
@@ -185,17 +191,40 @@ def has_inf(x):
     return _has_any(_jnp.isinf, x)
 
 
-def tanh_(x):
-    x._data = _jnp.tanh(x._data)
+def _inplace_apply(x, fn, *args, **kwargs):
+    """In-place op semantics that stay on the tape: the op consumes a
+    clone carrying x's old graph position, then x adopts the tracked
+    result — so later backward sees the op (the role of the reference's
+    inplace version counters).  Leaf tensors that require grad keep a
+    data-only update (differentiating through in-place mutation of a
+    leaf is rejected by the reference/torch too)."""
+    from paddle_tpu.core import Tensor as _T
+    if x._node is None and not x.stop_gradient:
+        out = fn(x, *args, **kwargs)
+        x._data = out._data
+        return x
+    pre = _T(x._data, stop_gradient=x.stop_gradient)
+    pre._node = x._node
+    pre._out_index = getattr(x, "_out_index", 0)
+    pre.is_leaf_ = getattr(x, "is_leaf_", True)
+    out = fn(pre, *args, **kwargs)
+    x._data = out._data
+    x._node = out._node
+    x._out_index = getattr(out, "_out_index", 0)
+    x.is_leaf_ = getattr(out, "is_leaf_", True)
+    x.stop_gradient = out.stop_gradient
     return x
+
+
+def tanh_(x):
+    return _inplace_apply(x, tensor.tanh)
 
 
 def scatter_(x, index, updates, overwrite=True):
-    i = index._data if hasattr(index, "_data") else index
-    u = updates._data if hasattr(updates, "_data") else updates
-    x._data = (x._data.at[i].set(u) if overwrite
-               else x._data.at[i].add(u))
-    return x
+    # same semantics as tensor.scatter (overwrite=False zeroes target rows
+    # before accumulating, per scatter_op.h), applied in place
+    return _inplace_apply(x, tensor.scatter, index, updates,
+                          overwrite=overwrite)
 
 
 def get_tensor_from_selected_rows(x):
@@ -219,7 +248,8 @@ def create_global_var(shape, value, dtype, persistable=False,
                       force_cpu=False, name=None):
     from paddle_tpu.tensor.creation import full as _full
     t = _full(shape, value, dtype=dtype)
-    t.stop_gradient = not persistable
+    t.stop_gradient = True     # global vars (counters, lr) are never
+    t.persistable = persistable  # grad-tracked; persistable is metadata
     return t
 
 

@@ -36,6 +36,18 @@ def _norm_pad(padding, n):
     return [tuple(int(q) for q in p) for p in padding]
 
 
+def _ceil_extra_pad(size, p0, p1, k, s):
+    """Extra high-side padding for ceil_mode, with the reference's clamp:
+    the last window must start inside input+left-padding (pooling.cc
+    AdjustPoolSize semantics — torch/paddle agree)."""
+    span = size + p0 + p1 - k
+    n_floor = span // s + 1
+    n_ceil = -(-span // s) + 1
+    if n_ceil > n_floor and (n_ceil - 1) * s < size + p0:
+        return (n_ceil - 1) * s + k - (size + p0 + p1)
+    return 0
+
+
 def _pool(x, kernel, stride, padding, n, channel_last, mode, ceil_mode,
           exclusive, name):
     k = _tuplify(kernel, n)
@@ -44,6 +56,12 @@ def _pool(x, kernel, stride, padding, n, channel_last, mode, ceil_mode,
 
     def _run(a):
         nd = a.ndim
+        if ceil_mode and not isinstance(pad, str):
+            spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+            for i, (size, (p0, p1)) in enumerate(zip(spatial, pad)):
+                extra = _ceil_extra_pad(size, p0, p1, k[i], s[i])
+                if extra:
+                    pad[i] = (p0, p1 + extra)
         if channel_last:
             dims = (1,) + k + (1,)
             strides = (1,) + s + (1,)
@@ -88,7 +106,11 @@ def _max_pool2d_with_mask(x, kernel, stride, padding, name,
 
     def _n_out(size, p0, p1, k, s):
         span = size + p0 + p1 - k
-        return (-(-span // s) if ceil_mode else span // s) + 1
+        n = (-(-span // s) if ceil_mode else span // s) + 1
+        # ceil-mode clamp: last window must start inside input+left pad
+        if ceil_mode and n > span // s + 1 and (n - 1) * s >= size + p0:
+            n -= 1
+        return n
 
     def _run(a):
         N, C, H, W = a.shape
@@ -276,7 +298,11 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     if return_mask:
         H, W = int(x.shape[-2]), int(x.shape[-1])
-        oh, ow = _tuplify(output_size, 2)
+        if isinstance(output_size, (list, tuple)):
+            oh = H if output_size[0] is None else int(output_size[0])
+            ow = W if output_size[1] is None else int(output_size[1])
+        else:
+            oh = ow = int(output_size)
         if H % oh or W % ow:
             raise NotImplementedError(
                 "adaptive_max_pool2d(return_mask=True) needs input dims "
