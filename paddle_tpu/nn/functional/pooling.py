@@ -52,16 +52,17 @@ def _pool(x, kernel, stride, padding, n, channel_last, mode, ceil_mode,
           exclusive, name):
     k = _tuplify(kernel, n)
     s = _tuplify(stride if stride is not None else kernel, n)
-    pad = _norm_pad(padding, n)
+    base_pad = _norm_pad(padding, n)
 
     def _run(a):
         nd = a.ndim
+        pad = base_pad
         if ceil_mode and not isinstance(pad, str):
             spatial = a.shape[1:-1] if channel_last else a.shape[2:]
-            for i, (size, (p0, p1)) in enumerate(zip(spatial, pad)):
-                extra = _ceil_extra_pad(size, p0, p1, k[i], s[i])
-                if extra:
-                    pad[i] = (p0, p1 + extra)
+            # build a fresh list — _run may re-execute (e.g. under remat)
+            # and must not accumulate onto the closed-over padding
+            pad = [(p0, p1 + _ceil_extra_pad(size, p0, p1, k[i], s[i]))
+                   for i, (size, (p0, p1)) in enumerate(zip(spatial, pad))]
         if channel_last:
             dims = (1,) + k + (1,)
             strides = (1,) + s + (1,)
