@@ -1,21 +1,41 @@
-"""Flash attention — Pallas TPU kernels, forward AND backward.
+"""Flash attention — Pallas TPU kernels, forward AND backward, with masks.
 
-Replaces (and exceeds) the reference's fused attention inference kernels
-(paddle/fluid/operators/fused/multihead_matmul_op.cu,
-fused_embedding_eltwise_layernorm) with a training-capable blockwise
-online-softmax attention: the S×S score matrix never leaves VMEM, so HBM
-traffic is O(S·D) instead of O(S²) in BOTH directions.
+Replaces (and exceeds) the reference's fused attention kernels
+(paddle/fluid/operators/fused/multihead_matmul_op.cu — which takes a
+bias_qk mask input, and fused_embedding_eltwise_layernorm) with a
+training-capable blockwise online-softmax attention: the S×S score matrix
+never leaves VMEM, so HBM traffic is O(S·D) instead of O(S²) in BOTH
+directions.
+
+Masking (all composable with causal):
+  - ``bias``: additive float mask, broadcastable (B|1, H|1, Sq|1, Sk).
+    Loaded tile-wise; for the common padding shape (B, 1, 1, Sk) the
+    extra HBM traffic is O(B·Sk) — negligible.  Bool masks are converted
+    by the dispatcher to 0/-inf additive form.  d(bias) is computed by a
+    dedicated reduction kernel (dead-code-eliminated under jit when the
+    mask does not require grad — the usual case).
+  - ``q_segment_ids``/``kv_segment_ids``: (B, Sq)/(B, Sk) int ids for
+    packed sequences; q attends to k iff ids match.  O(B·S) memory where
+    a materialised packed mask would be O(B·S²).
 
 Forward: grid (batch*heads, q_blocks, kv_blocks); the kv axis is the
 innermost, sequentially-executed grid axis, so running (max, sum-exp, acc)
 state lives in VMEM scratch.  The per-row logsumexp is written out as a
 residual for the backward.
 
-Backward: two kernels, both recomputing p-tiles from (q, k, lse):
+Backward: three kernels, all recomputing p-tiles from (q, k, lse, mask):
   - dq:     grid (bh, q_blocks, kv_blocks), dq accumulates in VMEM over kv.
   - dk/dv:  grid (bh, kv_blocks, q_blocks), dk/dv accumulate over q.
+  - dbias:  grid (g, kv_blocks, q_blocks, r) where g indexes the bias'
+    own batch*head extent and r sweeps the broadcast (reduced) b/h
+    extent; ds tiles accumulate in VMEM over the innermost reduction
+    axes.  Only traced when a bias is present; DCE'd when unused.
 The softmax-jacobian row term delta = rowsum(dO * O) is an O(S·D) XLA
 precompute.  This is the standard FlashAttention-2 backward dataflow.
+
+Rows with no visible key (fully masked) produce output 0 with zero
+gradients (lse = -inf); the XLA fallback's uniform-attention behaviour on
+such rows is an artifact of its -1e30 clamp, not a semantic to preserve.
 
 Causal masking is END-ALIGNED (query i sees keys j with j <= i + sk - sq),
 matching the XLA fallback's ``tril(k=sk-sq)`` convention; ``supported()``
@@ -43,13 +63,40 @@ _MIN_BLOCK = 128
 # tests flip this to run the kernels in interpreter mode on CPU
 _INTERPRET = False
 
+_NEG_INF = float("-inf")
+
 
 def _backend_is_tpu() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def supported(q_shape, k_shape, no_mask: bool, causal: bool = False) -> bool:
-    if not no_mask:
+def _canon_bias_shape(bias_shape, b, h, sq, sk):
+    """Canonicalise a broadcastable mask/bias shape to (Bb, Hb, Sqb, Sk).
+
+    Returns the 4-tuple, or None if the shape can't ride the kernel
+    (each dim must be 1 or full; the key dim must be full).
+    """
+    s = tuple(int(d) for d in bias_shape)
+    if len(s) > 4 or len(s) < 1:
+        return None
+    s = (1,) * (4 - len(s)) + s
+    bb, hb, sqb, skb = s
+    if skb != sk:
+        return None
+    if bb not in (1, b) or hb not in (1, h) or sqb not in (1, sq):
+        return None
+    return (bb, hb, sqb, skb)
+
+
+def supported(q_shape, k_shape, no_mask: bool = True, causal: bool = False,
+              bias_shape=None, segments: bool = False) -> bool:
+    """Can the Pallas kernel serve this attention call?
+
+    ``no_mask`` is the legacy round-2 argument: a mask used to force the
+    XLA fallback.  Now a mask is fine as long as it is expressible as a
+    canonical additive bias (``bias_shape``) and/or segment ids.
+    """
+    if not no_mask and bias_shape is None and not segments:
         return False
     if not (_backend_is_tpu() or _INTERPRET):
         return False
@@ -62,6 +109,9 @@ def supported(q_shape, k_shape, no_mask: bool, causal: bool = False) -> bool:
         # no visible key; semantics degenerate — use the XLA path
         return False
     if d % 128 != 0 and d not in (64,):
+        return False
+    if bias_shape is not None and \
+            _canon_bias_shape(bias_shape, b, h, sq, sk) is None:
         return False
     # the grid floors seq/block: a remainder would leave trailing queries
     # unwritten and trailing keys ignored, so block divisibility is required
@@ -84,14 +134,48 @@ def _pick_block(pref: int, seq: int) -> int:
     return max(b, _MIN_BLOCK)
 
 
+def _bias_g_map(bb, hb, h):
+    """bh (= b*h + head) → block index into the folded (Bb*Hb, ...) bias."""
+    if bb == 1 and hb == 1:
+        return lambda bh: 0
+    if bb == 1:
+        return lambda bh: bh % h       # bias indexed by head only
+    if hb == 1:
+        return lambda bh: bh // h      # bias indexed by batch only
+    return lambda bh: bh
+
+
+def _mask_tile(s, bias_ref, qs_ref, ks_ref):
+    """Apply bias/segment tiles to a (bq, bk) score tile."""
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    if qs_ref is not None:
+        s = jnp.where(qs_ref[0] == ks_ref[0], s, _NEG_INF)
+    return s
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_k, block_q, n_kb, off):
+def _fwd_kernel(*args, scale, causal, block_k, block_q, n_kb, off,
+                has_bias, has_segs):
     from jax.experimental import pallas as pl
+
+    n_in = 3 + (1 if has_bias else 0) + (2 if has_segs else 0)
+    q_ref, k_ref, v_ref = args[:3]
+    i = 3
+    bias_ref = None
+    qs_ref = ks_ref = None
+    if has_bias:
+        bias_ref = args[i]
+        i += 1
+    if has_segs:
+        qs_ref, ks_ref = args[i], args[i + 1]
+        i += 2
+    o_ref, lse_ref = args[n_in], args[n_in + 1]
+    m_scr, l_scr, acc_scr = args[n_in + 2:]
 
     qi = pl.program_id(1)
     kb = pl.program_id(2)
@@ -115,6 +199,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         k = k_ref[0].astype(jnp.float32)               # (bk, d)
         v = v_ref[0].astype(jnp.float32)
         s = q @ k.T                                    # (bq, bk)
+        s = _mask_tile(s, bias_ref, qs_ref, ks_ref)
         if causal:
             q_idx = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
@@ -138,10 +223,44 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         out = acc_scr[...] / jnp.maximum(l, 1e-30)
         o_ref[0] = out.astype(o_ref.dtype)
         # logsumexp residual; rows with zero mass get -inf (p rebuild → 0)
-        lse_ref[0] = m_scr[...] + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0] = jnp.where(
+            l > 0.0, m_scr[...] + jnp.log(jnp.maximum(l, 1e-30)), -jnp.inf)
 
 
-def _flash_fwd(q, k, v, scale, causal):
+def _mask_specs(pl, b, h, sqb, g_map, block_q, block_k, has_bias, has_segs,
+                order):
+    """Block specs for (bias?, qseg?, kseg?) under grid order
+    'qk' = (bh, qi, kb) or 'kq' = (bh, kb, qi)."""
+    specs = []
+    if order == "qk":
+        pick = lambda f: (lambda bh, qi, kb: f(bh, qi, kb))
+    else:
+        pick = lambda f: (lambda bh, kb, qi: f(bh, qi, kb))
+    if has_bias:
+        bq_b = block_q if sqb > 1 else 1
+        specs.append(pl.BlockSpec(
+            (1, bq_b, block_k),
+            pick(lambda bh, qi, kb: (g_map(bh), qi if sqb > 1 else 0, kb))))
+    if has_segs:
+        specs.append(pl.BlockSpec(
+            (1, block_q, 1), pick(lambda bh, qi, kb: (bh // h, qi, 0))))
+        specs.append(pl.BlockSpec(
+            (1, 1, block_k), pick(lambda bh, qi, kb: (bh // h, 0, kb))))
+    return specs
+
+
+def _mask_inputs(bias, qseg, kseg):
+    ins = []
+    if bias is not None:
+        bb, hb, sqb, sk = bias.shape
+        ins.append(bias.reshape(bb * hb, sqb, sk))
+    if qseg is not None:
+        ins.append(qseg[:, :, None])
+        ins.append(kseg[:, None, :])
+    return ins
+
+
+def _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal):
     """Returns (out (B,S,H,D), lse (B*H, Sq, 1) float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -151,6 +270,13 @@ def _flash_fwd(q, k, v, scale, causal):
     block_q = _pick_block(BLOCK_Q, sq)
     block_k = _pick_block(BLOCK_K, sk)
     n_kb = sk // block_k
+    has_bias = bias is not None
+    has_segs = qseg is not None
+    if has_bias:
+        bb, hb, sqb, _ = bias.shape
+        g_map = _bias_g_map(bb, hb, h)
+    else:
+        sqb, g_map = 1, None
 
     # fold batch and heads; put seq last-but-one for tiling
     qt = jnp.einsum("bshd->bhsd", q).reshape(b * h, sq, d)
@@ -159,7 +285,8 @@ def _flash_fwd(q, k, v, scale, causal):
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, block_q=block_q, n_kb=n_kb,
-                               off=sk - sq)
+                               off=sk - sq, has_bias=has_bias,
+                               has_segs=has_segs)
     # Mosaic rejects 64-bit types; the framework enables x64 globally, so
     # pin 32-bit mode for the kernel trace (index maps would emit i64)
     with jax.enable_x64(False):
@@ -173,7 +300,8 @@ def _flash_fwd(q, k, v, scale, causal):
                              lambda bh, qi, kb: (bh, kb, 0)),
                 pl.BlockSpec((1, block_k, d),
                              lambda bh, qi, kb: (bh, kb, 0)),
-            ],
+            ] + _mask_specs(pl, b, h, sqb, g_map, block_q, block_k,
+                            has_bias, has_segs, "qk"),
             out_specs=[
                 pl.BlockSpec((1, block_q, d),
                              lambda bh, qi, kb: (bh, qi, 0)),
@@ -190,7 +318,7 @@ def _flash_fwd(q, k, v, scale, causal):
                 pltpu.VMEM((block_q, d), jnp.float32),
             ],
             interpret=_INTERPRET,
-        )(qt, kt, vt)
+        )(qt, kt, vt, *_mask_inputs(bias, qseg, kseg))
     return jnp.einsum("bhsd->bshd", out.reshape(b, h, sq, d)), lse
 
 
@@ -199,9 +327,11 @@ def _flash_fwd(q, k, v, scale, causal):
 # ---------------------------------------------------------------------------
 
 
-def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off):
+def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off,
+               bias_ref=None, qs_ref=None, ks_ref=None):
     """Recompute the (bq, bk) probability tile from saved lse."""
     s = (q @ k.T) * scale
+    s = _mask_tile(s, bias_ref, qs_ref, ks_ref)
     if causal:
         q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -210,9 +340,30 @@ def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off):
     return jnp.where(jnp.isfinite(s) & jnp.isfinite(lse), p, 0.0)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_scr, *, scale, causal, block_q, block_k, n_kb, off):
+def _split_bwd_args(args, has_bias, has_segs, n_out):
+    """(q, k, v, do, lse, delta, bias?, qs?, ks?) + outs + scratch."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = args[:6]
+    i = 6
+    bias_ref = qs_ref = ks_ref = None
+    if has_bias:
+        bias_ref = args[i]
+        i += 1
+    if has_segs:
+        qs_ref, ks_ref = args[i], args[i + 1]
+        i += 2
+    outs = args[i:i + n_out]
+    scratch = args[i + n_out:]
+    return (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            bias_ref, qs_ref, ks_ref, outs, scratch)
+
+
+def _bwd_dq_kernel(*args, scale, causal, block_q, block_k, n_kb, off,
+                   has_bias, has_segs):
     from jax.experimental import pallas as pl
+
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, qs_ref,
+     ks_ref, (dq_ref,), (acc_scr,)) = _split_bwd_args(
+        args, has_bias, has_segs, 1)
 
     qi = pl.program_id(1)
     kb = pl.program_id(2)
@@ -235,7 +386,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         lse = lse_ref[0]                               # (bq, 1)
         delta = delta_ref[0]
         p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
-                       off)
+                       off, bias_ref, qs_ref, ks_ref)
         dp = do @ v.T                                  # (bq, bk)
         ds = p * (dp - delta)
         acc_scr[...] += (ds @ k) * scale
@@ -245,10 +396,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, n_qb, off):
+def _bwd_dkv_kernel(*args, scale, causal, block_q, block_k, n_qb, off,
+                    has_bias, has_segs):
     from jax.experimental import pallas as pl
+
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, qs_ref,
+     ks_ref, (dk_ref, dv_ref), (dk_scr, dv_scr)) = _split_bwd_args(
+        args, has_bias, has_segs, 2)
 
     kb = pl.program_id(1)
     qi = pl.program_id(2)
@@ -272,7 +426,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0]
         delta = delta_ref[0]
         p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
-                       off)
+                       off, bias_ref, qs_ref, ks_ref)
         dv_scr[...] += p.T @ do
         dp = do @ v.T
         ds = p * (dp - delta)
@@ -284,7 +438,54 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale, causal):
+def _bwd_dbias_kernel(*args, scale, causal, block_q, block_k, n_qb, n_r,
+                      off, sq_full, has_segs):
+    """ds accumulated over the bias' broadcast extents.
+
+    Grid (g, kb, qi, r): r sweeps the reduced batch*head extent; when the
+    bias has no query dim (sq_full=False) qi is reduced as well.  Both
+    reduction axes are innermost, so output-block revisits are
+    consecutive — accumulate in VMEM, write on the last visit.
+    """
+    from jax.experimental import pallas as pl
+
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, qs_ref,
+     ks_ref, (db_ref,), (db_scr,)) = _split_bwd_args(args, True, has_segs, 1)
+
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    r = pl.program_id(3)
+
+    first = (r == 0) if sq_full else jnp.logical_and(r == 0, qi == 0)
+    last = (r == n_r - 1) if sq_full else \
+        jnp.logical_and(r == n_r - 1, qi == n_qb - 1)
+
+    @pl.when(first)
+    def _init():
+        db_scr[...] = jnp.zeros_like(db_scr)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
+                   off, bias_ref, qs_ref, ks_ref)
+    dp = do @ v.T
+    ds = p * (dp - delta)
+    if sq_full:
+        db_scr[...] += ds
+    else:
+        db_scr[...] += jnp.sum(ds, axis=0, keepdims=True)
+
+    @pl.when(last)
+    def _finish():
+        db_ref[0] = db_scr[...].astype(db_ref.dtype)
+
+
+def _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, do, scale, causal,
+               want_dbias=True):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -295,6 +496,13 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal):
     n_qb = sq // block_q
     n_kb = sk // block_k
     off = sk - sq
+    has_bias = bias is not None
+    has_segs = qseg is not None
+    if has_bias:
+        bb, hb, sqb, _ = bias.shape
+        g_map = _bias_g_map(bb, hb, h)
+    else:
+        sqb, g_map = 1, None
 
     qt = jnp.einsum("bshd->bhsd", q).reshape(b * h, sq, d)
     kt = jnp.einsum("bshd->bhsd", k).reshape(b * h, sk, d)
@@ -314,26 +522,32 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal):
     row_spec_t = pl.BlockSpec((1, block_q, 1),
                               lambda bh, kb, qi: (bh, qi, 0))
 
+    mask_ins = _mask_inputs(bias, qseg, kseg)
+
     with jax.enable_x64(False):
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k, n_kb=n_kb,
-                              off=off),
+                              off=off, has_bias=has_bias, has_segs=has_segs),
             grid=(b * h, n_qb, n_kb),
-            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+            in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+            + _mask_specs(pl, b, h, sqb, g_map, block_q, block_k,
+                          has_bias, has_segs, "qk"),
             out_specs=q_spec,
             out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
             interpret=_INTERPRET,
-        )(qt, kt, vt, dot, lse, delta)
+        )(qt, kt, vt, dot, lse, delta, *mask_ins)
 
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k, n_qb=n_qb,
-                              off=off),
+                              off=off, has_bias=has_bias, has_segs=has_segs),
             grid=(b * h, n_kb, n_qb),
             in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
-                      row_spec_t],
+                      row_spec_t]
+            + _mask_specs(pl, b, h, sqb, g_map, block_q, block_k,
+                          has_bias, has_segs, "kq"),
             out_specs=[k_spec_t, k_spec_t],
             out_shape=[
                 jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
@@ -342,18 +556,90 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal):
             scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                             pltpu.VMEM((block_k, d), jnp.float32)],
             interpret=_INTERPRET,
-        )(qt, kt, vt, dot, lse, delta)
+        )(qt, kt, vt, dot, lse, delta, *mask_ins)
+
+        dbias = None
+        if has_bias and want_dbias:
+            dbias = _dbias_call(pl, pltpu, qt, kt, vt, dot, lse, delta,
+                                mask_ins, bias, qseg is not None, b, h, sq,
+                                sk, d, block_q, block_k, scale, causal, off)
 
     unfold = lambda x, s: jnp.einsum(
         "bhsd->bshd", x.reshape(b, h, s, d))
-    return unfold(dq, sq), unfold(dk, sk), unfold(dv, sk)
+    return (unfold(dq, sq), unfold(dk, sk), unfold(dv, sk), dbias)
 
 
-def _xla_reference(q, k, v, scale, causal):
+def _dbias_call(pl, pltpu, qt, kt, vt, dot, lse, delta, mask_ins, bias,
+                has_segs, b, h, sq, sk, d, block_q, block_k, scale, causal,
+                off):
+    """ds reduced over the bias' broadcast dims.  bh = g·mg + r·mr maps the
+    (bias-extent, reduction-extent) grid coordinates back to batch*head."""
+    bb, hb, sqb, _ = bias.shape
+    sq_full = sqb > 1
+    n_qb = sq // block_q
+    n_kb = sk // block_k
+    if bb == 1 and hb == 1:
+        mg, mr, n_r = 0, 1, b * h
+    elif bb == 1:
+        mg, mr, n_r = 1, h, b          # g = head, reduce over batch
+    elif hb == 1:
+        mg, mr, n_r = h, 1, h          # g = batch, reduce over heads
+    else:
+        mg, mr, n_r = 1, 0, 1
+
+    bh_of = lambda g, r: g * mg + r * mr
+    dspec = lambda f: pl.BlockSpec((1, block_q, d), f)
+    kspec = lambda f: pl.BlockSpec((1, block_k, d), f)
+    rspec = lambda f: pl.BlockSpec((1, block_q, 1), f)
+    in_specs = [
+        dspec(lambda g, kb, qi, r: (bh_of(g, r), qi, 0)),       # q
+        kspec(lambda g, kb, qi, r: (bh_of(g, r), kb, 0)),       # k
+        kspec(lambda g, kb, qi, r: (bh_of(g, r), kb, 0)),       # v
+        dspec(lambda g, kb, qi, r: (bh_of(g, r), qi, 0)),       # do
+        rspec(lambda g, kb, qi, r: (bh_of(g, r), qi, 0)),       # lse
+        rspec(lambda g, kb, qi, r: (bh_of(g, r), qi, 0)),       # delta
+        pl.BlockSpec((1, block_q if sq_full else 1, block_k),
+                     lambda g, kb, qi, r: (g, qi if sq_full else 0, kb)),
+    ]
+    if has_segs:
+        in_specs.append(pl.BlockSpec(
+            (1, block_q, 1),
+            lambda g, kb, qi, r: (bh_of(g, r) // h, qi, 0)))
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k),
+            lambda g, kb, qi, r: (bh_of(g, r) // h, 0, kb)))
+
+    bq_b = block_q if sq_full else 1
+    out_spec = pl.BlockSpec(
+        (1, bq_b, block_k),
+        lambda g, kb, qi, r: (g, qi if sq_full else 0, kb))
+
+    db = pl.pallas_call(
+        functools.partial(_bwd_dbias_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_qb=n_qb,
+                          n_r=n_r, off=off, sq_full=sq_full,
+                          has_segs=has_segs),
+        grid=(bb * hb, n_kb, n_qb, n_r),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((bb * hb, sqb, sk), bias.dtype),
+        scratch_shapes=[pltpu.VMEM((bq_b, block_k), jnp.float32)],
+        interpret=_INTERPRET,
+    )(qt, kt, vt, dot, lse, delta, *mask_ins)
+    return db.reshape(bb, hb, sqb, sk)
+
+
+def _xla_reference(q, k, v, scale, causal, bias=None, q_seg=None,
+                   kv_seg=None):
     qh = jnp.einsum("bshd->bhsd", q)
     kh = jnp.einsum("bshd->bhsd", k)
     vh = jnp.einsum("bshd->bhsd", v)
     s = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+    if bias is not None:
+        s = s + bias
+    if q_seg is not None:
+        seg = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
+        s = jnp.where(seg, s, -1e30)
     if causal:
         sq_, sk_ = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((sq_, sk_), dtype=bool), k=sk_ - sq_)
@@ -363,26 +649,98 @@ def _xla_reference(q, k, v, scale, causal):
     return jnp.einsum("bhsd->bshd", o)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, scale=None):
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    out, _ = _flash_fwd(q, k, v, scale, causal)
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper + public dispatcher
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _flash(q, k, v, bias, qseg, kseg, causal, scale):
+    out, _ = _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal)
     return out
 
 
-def _fa_fwd(q, k, v, causal, scale):
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    out, lse = _flash_fwd(q, k, v, scale, causal)
-    return out, (q, k, v, out, lse)
+def _fa_fwd(q, k, v, bias, qseg, kseg, causal, scale):
+    out, lse = _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal)
+    return out, (q, k, v, bias, qseg, kseg, out, lse)
 
 
 def _fa_bwd(causal, scale, res, g):
-    q, k, v, o, lse = res
+    q, k, v, bias, qseg, kseg, o, lse = res
+    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, g,
+                                   scale, causal)
+    dseg = None if qseg is None else jnp.zeros_like(qseg)
+    dkseg = None if kseg is None else jnp.zeros_like(kseg)
+    return (dq, dk, dv, dbias, dseg, dkseg)
+
+
+_flash.defvjp(_fa_fwd, _fa_bwd)
+
+
+# bias-nondiff variant: identical forward, but the backward skips the
+# dbias reduction kernel entirely.  Under jit the diff'able variant's
+# unused dbias would be DCE'd anyway, but the eager tape executes bwd
+# rules eagerly — padding masks (never trained) must not pay the extra
+# O(S²)-tile sweep there.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _flash_nodbias(q, k, v, bias, qseg, kseg, causal, scale):
+    out, _ = _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal)
+    return out
+
+
+def _fa_bwd_nodbias(causal, scale, res, g):
+    q, k, v, bias, qseg, kseg, o, lse = res
+    dq, dk, dv, _ = _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, g,
+                               scale, causal, want_dbias=False)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dseg = None if qseg is None else jnp.zeros_like(qseg)
+    dkseg = None if kseg is None else jnp.zeros_like(kseg)
+    return (dq, dk, dv, dbias, dseg, dkseg)
+
+
+_flash_nodbias.defvjp(_fa_fwd, _fa_bwd_nodbias)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, bias=None,
+                    q_segment_ids=None, kv_segment_ids=None,
+                    bias_grad=True):
+    """Blockwise attention with optional additive bias / segment masking.
+
+    ``bias``: float additive mask broadcastable to (B, H, Sq, Sk) (each
+    leading dim full or 1; key dim full), or a bool mask of the same
+    shapes (True = attend).  ``*_segment_ids``: (B, S) int ids; q·k pairs
+    with different ids are masked (packed-sequence attention).
+    ``bias_grad=False`` promises the bias cotangent is unneeded (padding
+    masks): its gradient is returned as zeros and the dbias kernel never
+    runs — callers with learned biases (e.g. relative-position) keep the
+    default.
+    """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_bwd(q, k, v, o, lse, g, scale, causal)
-
-
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+    b, sq, h, _ = q.shape
+    sk = k.shape[1]
+    if bias is not None:
+        canon = _canon_bias_shape(bias.shape, b, h, sq, sk)
+        if canon is None:
+            raise ValueError(
+                f"flash_attention: bias shape {tuple(bias.shape)} is not "
+                f"broadcastable-canonical for q{tuple(q.shape)}/"
+                f"k{tuple(k.shape)}")
+        if bias.dtype == jnp.bool_:
+            bias = jnp.where(bias, 0.0, _NEG_INF).astype(jnp.float32)
+        elif bias.dtype != jnp.bfloat16:
+            # Mosaic rejects 64-bit inputs (x64 is on framework-wide) and
+            # _mask_tile computes in f32 anyway
+            bias = bias.astype(jnp.float32)
+        bias = bias.reshape(canon)
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("flash_attention: pass both segment-id arrays or "
+                         "neither")
+    if q_segment_ids is not None:
+        # float32 internally: custom_vjp cotangents for int arrays are
+        # awkward (float0); exact for ids < 2^24
+        q_segment_ids = q_segment_ids.astype(jnp.float32)
+        kv_segment_ids = kv_segment_ids.astype(jnp.float32)
+    impl = _flash if bias_grad else _flash_nodbias
+    return impl(q, k, v, bias, q_segment_ids, kv_segment_ids, bool(causal),
+                float(scale))

@@ -76,7 +76,7 @@ class TestInterpretMode:
         k = jnp.asarray(rng.standard_normal((B, sk, H, D)).astype(np.float32))
         v = jnp.asarray(rng.standard_normal((B, sk, H, D)).astype(np.float32))
         scale = 1.0 / np.sqrt(D)
-        out, lse = fa._flash_fwd(q, k, v, scale, causal)
+        out, lse = fa._flash_fwd(q, k, v, None, None, None, scale, causal)
         ref = fa._xla_reference(q, k, v, scale, causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
@@ -109,3 +109,195 @@ class TestInterpretMode:
                                 causal=True)
         assert fa.supported((1, 128, 2, 64), (1, 256, 2, 64), True,
                             causal=True)
+
+    def test_supported_mask_shapes(self):
+        q = (2, 256, 4, 64)
+        # canonical padding mask (B,1,1,Sk) rides the kernel now
+        assert fa.supported(q, q, False, bias_shape=(2, 1, 1, 256))
+        assert fa.supported(q, q, False, bias_shape=(1, 4, 256, 256))
+        assert fa.supported(q, q, False, bias_shape=(2, 4, 256, 256))
+        assert fa.supported(q, q, False, bias_shape=(256,))
+        # key dim must be full; odd broadcast extents rejected
+        assert not fa.supported(q, q, False, bias_shape=(2, 1, 1, 128))
+        assert not fa.supported(q, q, False, bias_shape=(3, 1, 1, 256))
+        # mask present but inexpressible → XLA path
+        assert not fa.supported(q, q, False)
+        # segments alone are fine
+        assert fa.supported(q, q, False, segments=True)
+
+
+def _rand_qkv(rng, b, sq, sk, h, d, dtype=np.float32):
+    q = jnp.asarray(rng.standard_normal((b, sq, h, d)).astype(dtype))
+    k = jnp.asarray(rng.standard_normal((b, sk, h, d)).astype(dtype))
+    v = jnp.asarray(rng.standard_normal((b, sk, h, d)).astype(dtype))
+    return q, k, v
+
+
+class TestMaskedInterpret:
+    """Masked kernel paths (bias tiles, segment ids, dbias) in interpret
+    mode — parity vs the XLA reference, forward and backward."""
+
+    def setup_method(self):
+        fa._INTERPRET = True
+        self._blocks = (fa.BLOCK_Q, fa.BLOCK_K)
+        fa.BLOCK_Q = fa.BLOCK_K = 128
+
+    def teardown_method(self):
+        fa._INTERPRET = False
+        fa.BLOCK_Q, fa.BLOCK_K = self._blocks
+
+    @pytest.mark.parametrize("bias_shape", [
+        (2, 1, 1, 256), (1, 2, 256, 256), (2, 2, 256, 256), (1, 1, 1, 256)])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bias_forward_backward(self, bias_shape, causal):
+        rng = np.random.default_rng(3)
+        B, S, H, D = 2, 256, 2, 64
+        q, k, v = _rand_qkv(rng, B, S, S, H, D)
+        bias = jnp.asarray(
+            rng.standard_normal(bias_shape).astype(np.float32))
+        scale = 1.0 / np.sqrt(D)
+
+        def loss_flash(q, k, v, bias):
+            return (fa.flash_attention(q, k, v, causal=causal, scale=scale,
+                                       bias=bias) ** 2).sum()
+
+        def loss_ref(q, k, v, bias):
+            return (fa._xla_reference(q, k, v, scale, causal,
+                                      bias=bias) ** 2).sum()
+
+        np.testing.assert_allclose(
+            np.asarray(loss_flash(q, k, v, bias)),
+            np.asarray(loss_ref(q, k, v, bias)), rtol=2e-4)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+        for a, b, name in zip(gf, gr, ["dq", "dk", "dv", "dbias"]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-4, err_msg=name)
+
+    def test_padding_bool_mask_matches_xla(self):
+        """(B,1,1,Sk) bool padding mask built from per-sample lengths —
+        the standard padded-batch BERT layout."""
+        rng = np.random.default_rng(4)
+        B, S, H, D = 2, 256, 2, 64
+        q, k, v = _rand_qkv(rng, B, S, S, H, D)
+        lens = np.array([200, 131])
+        mask = jnp.asarray(np.arange(S)[None, :] < lens[:, None]
+                           ).reshape(B, 1, 1, S)
+        scale = 1.0 / np.sqrt(D)
+        out = fa.flash_attention(q, k, v, scale=scale, bias=mask)
+        ref = fa._xla_reference(q, k, v, scale, False,
+                                bias=jnp.where(mask, 0.0, -1e30))
+        # compare only valid query rows (padded queries attend nothing in
+        # the kernel semantic; XLA's -1e30 clamp makes them uniform)
+        for bi, ln in enumerate(lens):
+            np.testing.assert_allclose(np.asarray(out)[bi, :ln],
+                                       np.asarray(ref)[bi, :ln],
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_fully_masked_rows_zero(self):
+        rng = np.random.default_rng(5)
+        B, S, H, D = 1, 256, 1, 64
+        q, k, v = _rand_qkv(rng, B, S, S, H, D)
+        mask = jnp.zeros((B, 1, 1, S), dtype=bool).at[:, :, :, :5].set(True)
+        out = fa.flash_attention(q, k, v, bias=mask)
+        # valid rows finite; the mask only hides keys, so all query rows
+        # see 5 keys — but a row-hiding mask zeroes outputs:
+        rowmask = jnp.zeros((B, 1, S, S), dtype=bool)
+        out2 = fa.flash_attention(q, k, v, bias=rowmask)
+        assert np.all(np.asarray(out2) == 0.0)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_segment_ids(self, causal):
+        """Packed sequences: parity vs XLA with the materialised mask."""
+        rng = np.random.default_rng(6)
+        B, S, H, D = 2, 256, 2, 64
+        q, k, v = _rand_qkv(rng, B, S, S, H, D)
+        segs = np.repeat(np.arange(4), 64)[None, :].repeat(B, 0)
+        segs = jnp.asarray(segs.astype(np.int32))
+        scale = 1.0 / np.sqrt(D)
+
+        def loss_flash(q, k, v):
+            return (fa.flash_attention(q, k, v, causal=causal, scale=scale,
+                                       q_segment_ids=segs,
+                                       kv_segment_ids=segs) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (fa._xla_reference(q, k, v, scale, causal, q_seg=segs,
+                                      kv_seg=segs) ** 2).sum()
+
+        np.testing.assert_allclose(np.asarray(loss_flash(q, k, v)),
+                                   np.asarray(loss_ref(q, k, v)), rtol=2e-4)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-4,
+                                       err_msg=f"d{name}")
+
+    def test_bias_bf16(self):
+        rng = np.random.default_rng(7)
+        B, S, H, D = 1, 256, 2, 64
+        q, k, v = _rand_qkv(rng, B, S, S, H, D)
+        q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        bias = jnp.asarray(rng.standard_normal((B, 1, 1, S))
+                           .astype(np.float32))
+        out = fa.flash_attention(q, k, v, bias=bias)
+        ref = fa._xla_reference(q.astype(jnp.float32),
+                                k.astype(jnp.float32),
+                                v.astype(jnp.float32),
+                                1.0 / np.sqrt(D), False, bias=bias)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                                   np.asarray(ref), rtol=3e-2, atol=3e-2)
+
+    def test_sdpa_routes_mask_to_kernel(self):
+        """nn.functional.scaled_dot_product_attention with a mask must hit
+        the kernel path (not the O(S²) fallback) when shapes allow."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(8)
+        B, S, H, D = 2, 256, 2, 64
+        q = paddle.to_tensor(rng.standard_normal((B, S, H, D))
+                             .astype(np.float32))
+        kk = paddle.to_tensor(rng.standard_normal((B, S, H, D))
+                              .astype(np.float32))
+        vv = paddle.to_tensor(rng.standard_normal((B, S, H, D))
+                              .astype(np.float32))
+        mask = paddle.to_tensor(
+            (np.arange(S)[None, :] < 200).reshape(1, 1, 1, S))
+        calls = []
+        orig = fa.flash_attention
+
+        def spy(*a, **kw):
+            calls.append(kw)
+            return orig(*a, **kw)
+        fa.flash_attention = spy
+        try:
+            out = F.scaled_dot_product_attention(q, kk, vv, attn_mask=mask)
+        finally:
+            fa.flash_attention = orig
+        assert calls, "masked sdpa fell back to the XLA path"
+        assert calls[0].get("bias_grad") is False
+        ref = fa._xla_reference(
+            q._data, kk._data, vv._data, 1.0 / np.sqrt(D), False,
+            bias=jnp.where(mask._data, 0.0, -1e30))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    def test_functional_flash_attention_segments(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(9)
+        B, S, H, D = 1, 256, 2, 64
+        q = paddle.to_tensor(rng.standard_normal((B, S, H, D))
+                             .astype(np.float32))
+        segs = paddle.to_tensor(
+            np.repeat(np.arange(2), 128)[None, :].astype(np.int32))
+        out = F.flash_attention(q, q, q, causal=True, q_segment_ids=segs,
+                                kv_segment_ids=segs)
+        ref = fa._xla_reference(q._data, q._data, q._data,
+                                1.0 / np.sqrt(D), True,
+                                q_seg=segs._data, kv_seg=segs._data)
+        np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
