@@ -42,6 +42,15 @@ class CompiledStrategy:
         optimizer = maybe_swap_optimizer(optimizer, self)
         kwargs = dict(self.step_kwargs)
         kwargs.update(overrides)
+        if self.strategy.pipeline and hasattr(
+                getattr(model, "config", None), "schedule_mode"):
+            # propagate the pipeline schedule to the model (reference:
+            # section_worker.cc schedule_mode, set via pipeline_configs);
+            # the model's loss routes to the fused 1F1B program when 1
+            mode = self.strategy.pipeline_configs.get("schedule_mode",
+                                                      "1F1B")
+            model.config.schedule_mode = 1 if str(mode).upper() in (
+                "1F1B", "1") else 0
         dp_meta_kw = {k: v for k, v in kwargs.items()
                       if k in ("amp_level", "amp_dtype", "recompute")}
         if "LocalSGDOptimizer" in self.applied_meta_list or \

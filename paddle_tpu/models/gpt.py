@@ -43,7 +43,8 @@ class GPTConfig:
                  num_heads=16, ffn_size: Optional[int] = None,
                  max_seq_len=1024, initializer_range=0.02,
                  remat: bool = True, n_microbatches: int = 1,
-                 use_flash_attention: bool = True, seed: int = 0):
+                 use_flash_attention: bool = True, seed: int = 0,
+                 schedule_mode: int = 0):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -55,6 +56,11 @@ class GPTConfig:
         self.n_microbatches = n_microbatches
         self.use_flash_attention = use_flash_attention
         self.seed = seed
+        # pipeline schedule under pp>1 (reference section_worker.cc:115
+        # schedule_mode): 0 = F-then-B via autodiff, 1 = interleaved 1F1B
+        # (O(P·mb) activation memory) — training loss must then go through
+        # gpt_loss, which routes to the fused pipeline+loss program
+        self.schedule_mode = schedule_mode
 
     @property
     def head_dim(self):
@@ -151,14 +157,23 @@ def _mark(x, *spec):
     return constrain(x, *spec, strip=("sp",))
 
 
-def _attention(cfg: GPTConfig, q, k, v):
-    """(B, S, nh, hd) causal attention; picks ring / flash / XLA."""
+def _attention(cfg: GPTConfig, q, k, v, manual_sp=False):
+    """(B, S, nh, hd) causal attention; picks ring / flash / XLA.
+
+    ``manual_sp``: the caller is already inside a shard_map whose manual
+    set includes ``sp`` (the pipeline trunk) — run the ring attention
+    body directly on the local sequence shard instead of opening a
+    nested shard_map (sp×pp composition)."""
     mesh = get_mesh()
     scale = 1.0 / math.sqrt(cfg.head_dim)
+    if manual_sp:
+        from paddle_tpu.parallel.ring_attention import ring_attention_manual
+        axes = tuple(a for a in ("dp", "pp", "sp")
+                     if mesh.shape.get(a, 1) > 1)
+        return ring_attention_manual(q, k, v, causal=True, scale=scale,
+                                     n=mesh.shape["sp"], manual_axes=axes)
     if mesh.shape.get("sp", 1) > 1 and mesh.shape.get("pp", 1) == 1:
-        # ring attention owns its shard_map region; under pipeline (pp>1)
-        # the trunk is already inside one, so attention runs full-sequence
-        # per stage instead (sp×pp composition: round-2 work)
+        # ring attention owns its shard_map region at the top level
         from paddle_tpu.parallel.ring_attention import ring_attention
         return ring_attention(q, k, v, causal=True, scale=scale, mesh=mesh)
     if cfg.use_flash_attention:
@@ -173,20 +188,10 @@ def _attention(cfg: GPTConfig, q, k, v):
     return _xla_attention(q, k, v, None, scale, True)
 
 
-def _gpt_forward(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
-                 prj_w, prj_b, ln2_w, ln2_b, fc_w, fc_b, out_w, out_b,
-                 lnf_w, lnf_b, ids):
-    mesh = get_mesh()
-    B, S = ids.shape
+def _make_stage(cfg: GPTConfig, manual_sp: bool):
+    """Build the trunk stage function (scan over the stage's layer slice).
+    Shared by forward (F-then-B) and the fused 1F1B loss program."""
     H, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
-
-    x = wte[ids] + wpe[:S][None, :, :]
-    x = _mark(x, "dp", "sp", None)
-
-    stacked = {"ln1_w": ln1_w, "ln1_b": ln1_b, "qkv_w": qkv_w,
-               "qkv_b": qkv_b, "prj_w": prj_w, "prj_b": prj_b,
-               "ln2_w": ln2_w, "ln2_b": ln2_b, "fc_w": fc_w, "fc_b": fc_b,
-               "out_w": out_w, "out_b": out_b}
 
     def layer(x, lp):
         b, s = x.shape[:2]   # local (microbatch) shape, not the global B,S
@@ -197,7 +202,7 @@ def _gpt_forward(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
         q = q.reshape(b, s, nh, hd)
         k = k.reshape(b, s, nh, hd)
         v = v.reshape(b, s, nh, hd)
-        a = _attention(cfg, q, k, v).reshape(b, s, H)
+        a = _attention(cfg, q, k, v, manual_sp=manual_sp).reshape(b, s, H)
         x = x + a @ lp["prj_w"] + lp["prj_b"]
         h2 = _ln(x, lp["ln2_w"], lp["ln2_b"])
         ff = jax.nn.gelu(h2 @ lp["fc_w"] + lp["fc_b"], approximate=True)
@@ -212,12 +217,38 @@ def _gpt_forward(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
                               local_params)
         return out
 
-    if mesh.shape.get("pp", 1) > 1:
+    return stage_fn
+
+
+def _stack_params(ln1_w, ln1_b, qkv_w, qkv_b, prj_w, prj_b, ln2_w, ln2_b,
+                  fc_w, fc_b, out_w, out_b):
+    return {"ln1_w": ln1_w, "ln1_b": ln1_b, "qkv_w": qkv_w,
+            "qkv_b": qkv_b, "prj_w": prj_w, "prj_b": prj_b,
+            "ln2_w": ln2_w, "ln2_b": ln2_b, "fc_w": fc_w, "fc_b": fc_b,
+            "out_w": out_w, "out_b": out_b}
+
+
+def _gpt_forward(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
+                 prj_w, prj_b, ln2_w, ln2_b, fc_w, fc_b, out_w, out_b,
+                 lnf_w, lnf_b, ids):
+    mesh = get_mesh()
+    B, S = ids.shape
+
+    x = wte[ids] + wpe[:S][None, :, :]
+    x = _mark(x, "dp", "sp", None)
+
+    stacked = _stack_params(ln1_w, ln1_b, qkv_w, qkv_b, prj_w, prj_b,
+                            ln2_w, ln2_b, fc_w, fc_b, out_w, out_b)
+    pp = mesh.shape.get("pp", 1)
+    sp = mesh.shape.get("sp", 1)
+    stage_fn = _make_stage(cfg, manual_sp=(pp > 1 and sp > 1))
+
+    if pp > 1:
         from paddle_tpu.parallel.pipeline import pipeline_forward
         x = pipeline_forward(stage_fn, stacked, x,
-                             n_microbatches=max(cfg.n_microbatches,
-                                                mesh.shape["pp"]),
-                             mesh=mesh)
+                             n_microbatches=max(cfg.n_microbatches, pp),
+                             mesh=mesh,
+                             seq_axis="sp" if sp > 1 else None)
     else:
         x = stage_fn(stacked, x)
 
@@ -226,9 +257,75 @@ def _gpt_forward(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
     return _mark(logits, "dp", "sp", "mp")
 
 
+def _gpt_1f1b_loss(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
+                   prj_w, prj_b, ln2_w, ln2_b, fc_w, fc_b, out_w, out_b,
+                   lnf_w, lnf_b, ids, label_ids):
+    """Fused pipeline+loss program under the 1F1B schedule: the head (final
+    LN + tied logits + CE) runs on the LAST stage at B-time, which is what
+    lets forward and backward interleave (reference section_worker.cc:115
+    schedule_mode 1 with the loss section on the last device)."""
+    from paddle_tpu.parallel.pipeline import make_pipeline_train_1f1b
+    mesh = get_mesh()
+    B, S = ids.shape
+    pp = mesh.shape.get("pp", 1)
+    sp = mesh.shape.get("sp", 1)
+
+    x = wte[ids] + wpe[:S][None, :, :]
+    x = _mark(x, "dp", "sp", None)
+    stacked = _stack_params(ln1_w, ln1_b, qkv_w, qkv_b, prj_w, prj_b,
+                            ln2_w, ln2_b, fc_w, fc_b, out_w, out_b)
+    stage_fn = _make_stage(cfg, manual_sp=(pp > 1 and sp > 1))
+    head = {"wte": wte, "lnf_w": lnf_w, "lnf_b": lnf_b}
+
+    # pre-shifted next-token labels with a -1 sentinel on the (global)
+    # final position: the shift never crosses an sp shard boundary, and
+    # the weight mask falls out of the sentinel
+    labels = jnp.concatenate(
+        [label_ids[:, 1:], jnp.full((B, 1), -1, label_ids.dtype)], axis=1)
+
+    # memoize the built schedule per (config, mesh, seq-len): the builder
+    # wraps a fresh jax.jit each time, so eager callers would otherwise
+    # retrace/recompile every step
+    key = (mesh, S, cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+           cfg.remat, cfg.use_flash_attention,
+           max(cfg.n_microbatches, pp))
+    loss_fn = _1F1B_CACHE.get(key)
+    if loss_fn is None:
+        if len(_1F1B_CACHE) > 16:   # bound the mesh/jit refs it pins
+            _1F1B_CACHE.clear()
+        def head_loss(hp, y, lab):
+            # local-sum / GLOBAL-denominator (make_pipeline_train_1f1b's
+            # sp contract): each sp shard sums its slice; the schedule
+            # psums the shards
+            h = _ln(y, hp["lnf_w"], hp["lnf_b"])
+            lg = (h @ hp["wte"].T).astype(jnp.float32)
+            w = (lab >= 0).astype(jnp.float32)
+            tg = jnp.maximum(lab, 0)
+            logz = jax.scipy.special.logsumexp(lg, axis=-1)
+            gold = jnp.take_along_axis(lg, tg[..., None], axis=-1)[..., 0]
+            return jnp.sum((logz - gold) * w) / (y.shape[0] * (S - 1))
+
+        loss_fn = make_pipeline_train_1f1b(
+            stage_fn, head_loss, max(cfg.n_microbatches, pp), mesh=mesh,
+            seq_axis="sp" if sp > 1 else None)
+        _1F1B_CACHE[key] = loss_fn
+    return loss_fn(stacked, head, x, labels)
+
+
+_1F1B_CACHE: dict = {}
+
+
 def gpt_loss(model, input_ids, labels):
     """Causal-LM cross entropy (f32 logits softmax); labels == input
-    tokens, shifted internally."""
+    tokens, shifted internally.  Under pp>1 with schedule_mode=1 the whole
+    pipeline+loss runs as one interleaved 1F1B program."""
+    cfg = getattr(model, "config", None)
+    if cfg is not None and getattr(cfg, "schedule_mode", 0) == 1 and \
+            get_mesh().shape.get("pp", 1) > 1:
+        params = [model._parameters[n] for n in _PARAM_ORDER]
+        fn = partial(_gpt_1f1b_loss, cfg)
+        return apply1(fn, *params, input_ids, labels,
+                      name="gpt_loss_1f1b")
     logits = model(input_ids)
 
     def ce(logits, ids):

@@ -54,6 +54,22 @@ def _shard_map(f, mesh, in_specs, out_specs, manual_axes=None):
                      check_rep=False, **kwargs)
 
 
+def _psum(x, axis):
+    """psum with a CPU-only bf16→f32 boundary: XLA:CPU's
+    AllReducePromotion pass crashes on bf16 all-reduce ("Invalid binary
+    instruction opcode copy", hlo_instruction.cc) — promote by hand there.
+    On TPU the bf16 reduce rides ICI at half the bytes, untouched."""
+    if jax.default_backend() == "cpu" and x.dtype == jnp.bfloat16:
+        return lax.psum(x.astype(jnp.float32), axis).astype(jnp.bfloat16)
+    return lax.psum(x, axis)
+
+
+def _pmean(x, axis):
+    if jax.default_backend() == "cpu" and x.dtype == jnp.bfloat16:
+        return lax.pmean(x.astype(jnp.float32), axis).astype(jnp.bfloat16)
+    return lax.pmean(x, axis)
+
+
 def _pvary(x, axis_names):
     """Mark a replicated value as device-varying along ``axis_names`` (newer
     jax tracks varying-manual-axes through shard_map scans).  Axes are cast
@@ -78,12 +94,16 @@ def _pvary(x, axis_names):
 
 def pipeline_forward(stage_fn: Callable, stacked_params, x,
                      n_microbatches: int, mesh: Optional[Mesh] = None,
-                     pp_axis: str = "pp", data_axes=("dp",)):
+                     pp_axis: str = "pp", data_axes=("dp",),
+                     seq_axis: Optional[str] = None):
     """Run ``x`` through a pipelined layer stack; returns activations with
     the same global shape as ``x``.  Mesh axes other than pp/data stay
-    GSPMD-auto inside the region (tensor parallelism composes); sequence
-    parallelism inside the pipeline is not supported — use ring attention
-    at the top level (pp==1) instead."""
+    GSPMD-auto inside the region (tensor parallelism composes).  With
+    ``seq_axis`` set (sp×pp composition), dim 1 of ``x`` is sharded over
+    that axis and it joins the manual set — the stage function must then
+    handle sequence-sharded activations itself (e.g. ring attention via
+    ``ring_attention_manual``, which runs inside this region's manual
+    axes rather than opening a nested shard_map)."""
     mesh = mesh or get_mesh()
     n_stages = mesh.shape.get(pp_axis, 1)
 
@@ -93,12 +113,16 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x,
         return stage_fn(stacked_params, x)
 
     data_axes = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
-    batch_spec = P(data_axes if data_axes else None)
+    seq = seq_axis if (seq_axis and mesh.shape.get(seq_axis, 1) > 1) else None
+    if seq:
+        batch_spec = P(data_axes if data_axes else None, seq)
+    else:
+        batch_spec = P(data_axes if data_axes else None)
 
     param_specs = jax.tree_util.tree_map(
         lambda _: P(pp_axis), stacked_params)
 
-    manual = {pp_axis} | set(data_axes)
+    manual = {pp_axis} | set(data_axes) | ({seq} if seq else set())
     fn = partial(_pipeline_body, stage_fn, n_stages, n_microbatches, pp_axis,
                  tuple(sorted(manual)))
     mapped = _shard_map(fn, mesh, in_specs=(param_specs, batch_spec),
@@ -140,7 +164,7 @@ def _pipeline_body(stage_fn, n_stages, n_micro, axis_name, manual_axes,
                                jnp.arange(n_micro + n_stages - 1))
     # result lives on the last stage; broadcast (masked psum) so every stage
     # returns the same shard — out_specs treats pp as replicated
-    outputs = lax.psum(
+    outputs = _psum(
         jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
         axis_name)
     return outputs.reshape((batch,) + x.shape[1:])
@@ -176,7 +200,9 @@ def _b_sched(stage, t, n_stages, n_micro):
 def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
                              n_microbatches: int,
                              mesh: Optional[Mesh] = None,
-                             pp_axis: str = "pp", data_axes=("dp",)):
+                             pp_axis: str = "pp", data_axes=("dp",),
+                             seq_axis: Optional[str] = None,
+                             unconditional: Optional[bool] = None):
     """Build a differentiable 1F1B pipelined loss (reference:
     paddle/fluid/framework/section_worker.cc:115-160, schedule_mode 1).
 
@@ -197,10 +223,39 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
     Returns ``loss_fn(stacked_params, head_params, x, labels) -> scalar``
     wrapped in a custom_vjp whose gradients were computed *during* the
     schedule (self-computed-gradient pattern), so it composes with
-    ``jax.grad`` of the surrounding training step.  Tensor parallelism
-    inside the stages is not supported (the per-tick ops run under
-    runtime conds that must stay collective-free); compose 1F1B with
-    dp/sharding only — matching the reference's PipelineOptimizer scope.
+    ``jax.grad`` of the surrounding training step.
+
+    Composition (beyond the reference PipelineOptimizer's pp×dp scope,
+    sharding_optimizer.py:115-138 reaches pp×mp by program rewrite):
+    - Tensor parallelism: mesh axes not listed here (e.g. ``mp``) stay
+      GSPMD-auto inside the region, so stage-internal matmuls may be
+      mp-sharded.
+    - Sequence parallelism: with ``seq_axis``, dim 1 of x/labels is
+      sharded over it and the stage/head functions run on sequence
+      shards (ring attention via ``ring_attention_manual``).  The
+      head_loss_fn contract under sp: return local-sum over its
+      sequence shard divided by the GLOBAL per-microbatch denominator —
+      the schedule psums the shards, so the same callable computes the
+      true loss both inside the region (local slice) and in the eval
+      primal (full sequence).
+
+    Two scheduler implementations, auto-selected (``unconditional``):
+    - cond-based (dp/sharding-only meshes): each tick runs at most one
+      op under ``lax.cond`` — minimum FLOPs, but collectives must not
+      appear inside the conds: different pp stages take different
+      branches, so devices would issue collectives in divergent global
+      orders, which corrupts or deadlocks the matched-instance
+      collective runtime (measured on XLA:CPU: auto-mp inserted
+      allgathers deadlock the pp ppermute rendezvous; manual sp ring
+      ppermutes silently mispair instances and corrupt activations).
+    - branch-free/masked (any mesh with in-stage collectives — mp, sp):
+      EVERY stage runs one F and one B every tick on clipped indices,
+      with invalid slots masked out of the accumulators (``jnp.where``,
+      never ``lax.cond``), so every device issues the identical
+      collective sequence — the schedule that actually fits SPMD
+      hardware.  Costs the bubble twice ((M+2P-2) double-ticks vs
+      2(M+P-1) single-ticks) and an unconditional per-tick head eval;
+      still O(P·mb) activation memory (a 2P-1-slot buffer).
     ``labels`` are feed data and are never differentiated through; their
     cotangent is zero by construction.
     """
@@ -208,10 +263,27 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
     P_ = mesh.shape.get(pp_axis, 1)
     M = n_microbatches
     data = tuple(a for a in data_axes if mesh.shape.get(a, 1) > 1)
+    seq = seq_axis if (seq_axis and mesh.shape.get(seq_axis, 1) > 1) else None
     dp_size = 1
     for a in data:
         dp_size *= mesh.shape[a]
-    batch_spec = P(data if data else None)
+    if seq:
+        batch_spec = P(data if data else None, seq)
+    else:
+        batch_spec = P(data if data else None)
+    if unconditional is None:
+        # any mesh axis with in-region collectives (auto axes like mp, or
+        # manual seq) forces the branch-free scheduler — see docstring
+        extra = [a for a, s in mesh.shape.items()
+                 if s > 1 and a != pp_axis and a not in data and a != seq]
+        unconditional = bool(extra) or seq is not None
+    elif not unconditional and seq is not None:
+        raise ValueError(
+            "make_pipeline_train_1f1b: the cond-based scheduler "
+            "(unconditional=False) cannot carry a seq_axis — in-stage ring "
+            "collectives inside divergent lax.cond branches mispair "
+            "collective instances and silently corrupt activations; use "
+            "the branch-free scheduler (unconditional=True/None)")
 
     def _microbatch_loss(head_params, y, labels):
         """mean over dp_size*M of per-microbatch head loss — the exact
@@ -244,11 +316,145 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
             lambda _: P(pp_axis), stacked_params)
         repl = jax.tree_util.tree_map(lambda _: P(), head_params)
 
+        def finalize(dparams, dhead, dx_all, loss_acc, batch, xb_shape):
+            """Shared tail: collect loss/grads onto every device with the
+            normalisations both schedulers share."""
+            loss = _psum(loss_acc, pp_axis) / M
+            dhead = jax.tree_util.tree_map(
+                lambda g: _psum(g, pp_axis), dhead)
+            if seq:
+                # head_loss returns local-sum/global-denominator per shard
+                # (see docstring): the shard losses SUM to the true loss,
+                # and trunk/head grads from disjoint sequence slices sum
+                # likewise (params are seq-replicated)
+                loss = _psum(loss, seq)
+                dparams = jax.tree_util.tree_map(
+                    lambda g: _psum(g, seq), dparams)
+                dhead = jax.tree_util.tree_map(
+                    lambda g: _psum(g, seq), dhead)
+            # dx was only written on stage 0 (zeros elsewhere): the psum
+            # both collects it and proves pp-replication for the out_spec
+            dx = _psum(dx_all.reshape((batch,) + xb_shape[1:]), pp_axis)
+            # dx stays per-dp-shard (no pmean), so fold the 1/dp factor of
+            # the dp-mean loss in here explicitly
+            dx = dx / dp_size
+            scale = 1.0 / M
+            dparams = jax.tree_util.tree_map(lambda g: g * scale, dparams)
+            dhead = jax.tree_util.tree_map(lambda g: g * scale, dhead)
+            dx = dx * scale
+            for a in data:
+                loss = _pmean(loss, a)
+                dparams = jax.tree_util.tree_map(
+                    lambda g: _pmean(g, a), dparams)
+                dhead = jax.tree_util.tree_map(
+                    lambda g: _pmean(g, a), dhead)
+            return loss, dparams, dhead, dx
+
+        def body_masked(local_params, head_p, xb, yb):
+            """Branch-free 1F1B: every stage runs one F and one B every
+            tick on index-clipped data; invalid results are masked out of
+            the accumulators with jnp.where.  No lax.cond anywhere, so
+            every device issues the identical collective sequence — safe
+            for in-stage mp (auto) and sp (ring) collectives.
+
+            Timetable: F(m) on stage s at tick u = s + m; B(m) on stage s
+            at u = 2(P-1) - s + m (cooldown mirror of warmup).  The F
+            input needs no buffering — stage s-1 produced it last tick
+            and the unconditional ppermute lands it exactly on time; a
+            (2P-1)-slot ring buffer keeps activations alive until B.
+            """
+            stage = lax.axis_index(pp_axis)
+            batch = xb.shape[0]
+            mb = batch // M
+            axes = (pp_axis,) + data + ((seq,) if seq else ())
+            vary = lambda t: jax.tree_util.tree_map(
+                lambda a: _pvary(a, axes), t)
+            local_params = vary(local_params)
+            head_p = vary(head_p)
+            mbs = vary(xb.reshape((M, mb) + xb.shape[1:]))
+            lbs = vary(yb.reshape((M, mb) + yb.shape[1:]))
+
+            fwd_perm = [(i, i + 1) for i in range(P_ - 1)]
+            bwd_perm = [(i + 1, i) for i in range(P_ - 1)]
+            act_shape = (mb,) + xb.shape[1:]
+            Q = 2 * P_ - 1
+
+            dparams0 = jax.tree_util.tree_map(jnp.zeros_like, local_params)
+            dhead0 = jax.tree_util.tree_map(jnp.zeros_like, head_p)
+            is_last = stage == P_ - 1
+
+            def tick(carry, u):
+                buf, fwd_in, bwd_in, dparams, dhead, dx_all, loss_acc = carry
+
+                # ---- forward op (always) ----
+                mF = u - stage
+                okF = (mF >= 0) & (mF < M)
+                mFc = jnp.clip(mF, 0, M - 1)
+                val = jnp.where(
+                    stage == 0,
+                    lax.dynamic_index_in_dim(mbs, mFc, 0, False), fwd_in)
+                slotF = mFc % Q
+                prev = lax.dynamic_index_in_dim(buf, slotF, 0, False)
+                buf = lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(okF, val, prev), slotF, 0)
+                y = stage_fn(local_params, val)
+
+                # ---- backward op (always) ----
+                mB = u - (2 * (P_ - 1) - stage)
+                okB = (mB >= 0) & (mB < M)
+                mBc = jnp.clip(mB, 0, M - 1)
+                inp_b = lax.dynamic_index_in_dim(buf, mBc % Q, 0, False)
+                lab_mb = lax.dynamic_index_in_dim(lbs, mBc, 0, False)
+                y_b, svjp = jax.vjp(
+                    lambda p, i: stage_fn(p, i), local_params, inp_b)
+
+                def head_fn(hp, yy):
+                    # f32 boundary keeps the seed dtype stable for bf16
+                    return head_loss_fn(hp, yy, lab_mb).astype(jnp.float32)
+                loss_m, hvjp = jax.vjp(head_fn, head_p, y_b)
+                dhp_t, dy_head = hvjp(vary(jnp.ones((), jnp.float32)))
+                seed = jnp.where(is_last, dy_head, bwd_in)
+                dp_t, dinp = svjp(seed)
+
+                okB_last = okB & is_last
+                dparams = jax.tree_util.tree_map(
+                    lambda acc, g: acc + jnp.where(okB, g, 0), dparams, dp_t)
+                dhead = jax.tree_util.tree_map(
+                    lambda acc, g: acc + jnp.where(okB_last, g, 0),
+                    dhead, dhp_t)
+                loss_acc = loss_acc + jnp.where(okB_last, loss_m, 0.0)
+                dxprev = lax.dynamic_index_in_dim(dx_all, mBc, 0, False)
+                dx_all = lax.dynamic_update_index_in_dim(
+                    dx_all, jnp.where(okB & (stage == 0), dinp, dxprev),
+                    mBc, 0)
+
+                # ---- ring sends (always) ----
+                fwd_next = lax.ppermute(y, pp_axis, fwd_perm)
+                bwd_next = lax.ppermute(dinp, pp_axis, bwd_perm)
+                return (buf, fwd_next, bwd_next, dparams, dhead, dx_all,
+                        loss_acc), None
+
+            n_ticks = M + 2 * (P_ - 1)
+            zero_act = jnp.zeros(act_shape, xb.dtype)
+            carry0 = (
+                vary(jnp.zeros((Q,) + act_shape, xb.dtype)),
+                vary(zero_act),
+                vary(zero_act),
+                vary(dparams0),
+                vary(dhead0),
+                vary(jnp.zeros((M,) + act_shape, xb.dtype)),
+                vary(jnp.zeros((), jnp.float32)),
+            )
+            (_, _, _, dparams, dhead, dx_all, loss_acc), _ = lax.scan(
+                tick, carry0, jnp.arange(n_ticks))
+            return finalize(dparams, dhead, dx_all, loss_acc, batch,
+                            xb.shape)
+
         def body(local_params, head_p, xb, yb):
             stage = lax.axis_index(pp_axis)
             batch = xb.shape[0]
             mb = batch // M
-            axes = (pp_axis,) + data
+            axes = (pp_axis,) + data + ((seq,) if seq else ())
             vary = lambda t: jax.tree_util.tree_map(
                 lambda a: _pvary(a, axes), t)
             # promote every input to fully-varying on the manual axes:
@@ -367,32 +573,12 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
             (_, _, _, dparams, dhead, dx_all, loss_acc), _ = lax.scan(
                 tick, carry0, jnp.arange(n_ticks))
 
-            # loss lives on the last stage; grads of head only there too —
-            # broadcast over pp, average over data axes
-            loss = lax.psum(loss_acc, pp_axis) / M
-            dhead = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, pp_axis), dhead)
-            # dx was only written on stage 0 (zeros elsewhere): the psum
-            # both collects it and proves pp-replication for the out_spec
-            dx = lax.psum(dx_all.reshape((batch,) + xb.shape[1:]), pp_axis)
-            # dx stays per-dp-shard (no pmean), so fold the 1/dp factor of
-            # the dp-mean loss in here explicitly
-            dx = dx / dp_size
-            scale = 1.0 / M
-            dparams = jax.tree_util.tree_map(lambda g: g * scale, dparams)
-            dhead = jax.tree_util.tree_map(lambda g: g * scale, dhead)
-            dx = dx * scale
-            for a in data:
-                loss = lax.pmean(loss, a)
-                dparams = jax.tree_util.tree_map(
-                    lambda g: lax.pmean(g, a), dparams)
-                dhead = jax.tree_util.tree_map(
-                    lambda g: lax.pmean(g, a), dhead)
-            return loss, dparams, dhead, dx
+            return finalize(dparams, dhead, dx_all, loss_acc, batch,
+                            xb.shape)
 
-        manual = {pp_axis} | set(data)
+        manual = {pp_axis} | set(data) | ({seq} if seq else set())
         mapped = _shard_map(
-            body, mesh,
+            body_masked if unconditional else body, mesh,
             in_specs=(param_specs, repl, batch_spec, batch_spec),
             out_specs=(P(), param_specs, repl, batch_spec),
             manual_axes=manual)
@@ -407,7 +593,8 @@ def make_pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
                 f"global batch {x.shape[0]} not divisible by dp_size*"
                 f"n_microbatches = {dp_size}*{M}")
         y = pipeline_forward(stage_fn, stacked_params, x, M, mesh=mesh,
-                             pp_axis=pp_axis, data_axes=data_axes)
+                             pp_axis=pp_axis, data_axes=data_axes,
+                             seq_axis=seq_axis)
         return _microbatch_loss(head_params, y, labels)
 
     def fwd(stacked_params, head_params, x, labels):

@@ -25,7 +25,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.parallel.mesh import get_mesh
 
-__all__ = ["ring_attention", "ring_attention_local"]
+__all__ = ["ring_attention", "ring_attention_local",
+           "ring_attention_manual"]
 
 
 from paddle_tpu.parallel.pipeline import _pvary, _shard_map
@@ -51,6 +52,24 @@ def ring_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
     mapped = _shard_map(fn, mesh, in_specs=(spec, spec, spec),
                         out_specs=spec, manual_axes=manual)
     return mapped(q, k, v)
+
+
+def ring_attention_manual(q, k, v, causal=True, scale=None, sp_axis="sp",
+                          n=None, manual_axes=None):
+    """Ring attention for use INSIDE an existing shard_map manual region
+    whose manual set includes ``sp_axis`` (e.g. the pipeline trunk:
+    sp×pp composition runs this per stage instead of opening a nested
+    shard_map).  q/k/v are the LOCAL (sequence-sharded) arrays."""
+    from paddle_tpu.parallel.mesh import get_mesh
+    if n is None:
+        n = get_mesh().shape[sp_axis]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if n <= 1:
+        return _local_attention(q, k, v, causal, scale, 0, 0, q.shape[1])
+    axes = tuple(manual_axes) if manual_axes else (sp_axis,)
+    return _ring_body(n, sp_axis, axes, causal, scale, q.shape[1] * n,
+                      q, k, v)
 
 
 def _ring_body(n, axis_name, manual_axes, causal, scale, global_s, q, k, v):
