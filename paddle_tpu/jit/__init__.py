@@ -208,16 +208,24 @@ def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):
     """Decorator/wrapper parity with paddle.jit.to_static."""
     def decorate(obj):
+        # AST pass first (reference: program_translator.py:756 →
+        # DygraphToStaticAst): native if/while/for over tensors become the
+        # dual-regime control-flow APIs, so the functional capture below
+        # can trace them (lax.cond / lax.while_loop) — no-op when the
+        # source has no such statements or can't be rewritten
+        from paddle_tpu.jit.dy2static import convert_to_static
         if isinstance(obj, Layer):
-            sf = StaticFunction(obj.forward, layer=obj,
+            sf = StaticFunction(convert_to_static(obj.forward), layer=obj,
                                 input_spec=input_spec)
             obj.forward = sf
             return obj
         # plain function or bound method
         layer = getattr(obj, "__self__", None)
         if isinstance(layer, Layer):
-            return StaticFunction(obj, layer=layer, input_spec=input_spec)
-        return StaticFunction(obj, layer=None, input_spec=input_spec)
+            return StaticFunction(convert_to_static(obj), layer=layer,
+                                  input_spec=input_spec)
+        return StaticFunction(convert_to_static(obj), layer=None,
+                              input_spec=input_spec)
     if function is not None:
         return decorate(function)
     return decorate

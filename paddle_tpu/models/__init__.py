@@ -12,4 +12,4 @@ from paddle_tpu.models.gpt import (  # noqa: F401
 from paddle_tpu.models.bert import (  # noqa: F401
     Bert, BertConfig, bert_base, bert_tiny, bert_pretrain_loss, Ernie,
     ErnieConfig)
-from paddle_tpu.models.rank import WideDeep, DeepFM  # noqa: F401
+from paddle_tpu.models.rank import WideDeep, DeepFM, WideDeepHost  # noqa: F401

@@ -31,7 +31,8 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_size: Optional[int] = None,
                  max_seq_len=512, type_vocab_size=2,
-                 initializer_range=0.02, remat: bool = True, seed: int = 0):
+                 initializer_range=0.02, remat: bool = True, seed: int = 0,
+                 use_flash_attention: bool = True):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -42,6 +43,7 @@ class BertConfig:
         self.initializer_range = initializer_range
         self.remat = remat
         self.seed = seed
+        self.use_flash_attention = use_flash_attention
 
     @property
     def head_dim(self):
@@ -170,20 +172,42 @@ def _bert_forward(cfg, has_tt, has_mask, wte, wpe, wtt, emb_ln_w, emb_ln_b,
 
     scale = 1.0 / math.sqrt(hd)
 
+    def _flash_ok(b, s):
+        if not cfg.use_flash_attention:
+            return False
+        try:
+            from paddle_tpu.ops.pallas import flash_attention as _fa
+            return _fa.supported(
+                (b, s, nh, hd), (b, s, nh, hd), bias is None,
+                bias_shape=None if bias is None else tuple(bias.shape))
+        except Exception:
+            return False
+
     def layer(x, lp):
         b, s = x.shape[:2]
         qkv = x @ lp["qkv_w"] + lp["qkv_b"]
         qkv = _mark(qkv, "dp", None, "mp")
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-        if bias is not None:
-            scores = scores + bias
-        p = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(x.dtype)
-        a = jnp.einsum("bhqk,bhkd->bhqd", p, v).transpose(0, 2, 1, 3)
-        a = a.reshape(b, s, H)
+        if _flash_ok(b, s):
+            # Pallas flash kernel, (B,S,H,D) layout; the padding mask rides
+            # as (B,1,1,S) bias tiles so padded batches stay O(S·D)
+            from paddle_tpu.ops.pallas import flash_attention as _fa
+            a = _fa.flash_attention(
+                q.reshape(b, s, nh, hd), k.reshape(b, s, nh, hd),
+                v.reshape(b, s, nh, hd), scale=scale, bias=bias,
+                bias_grad=False)
+            a = a.reshape(b, s, H)
+        else:
+            q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            if bias is not None:
+                scores = scores + bias
+            p = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(
+                x.dtype)
+            a = jnp.einsum("bhqk,bhkd->bhqd", p, v).transpose(0, 2, 1, 3)
+            a = a.reshape(b, s, H)
         # post-LN (original BERT): LN(x + sublayer(x))
         x = _ln(x + a @ lp["prj_w"] + lp["prj_b"], lp["ln1_w"], lp["ln1_b"])
         ff = jax.nn.gelu(x @ lp["fc_w"] + lp["fc_b"], approximate=True)
@@ -204,9 +228,12 @@ def _bert_forward(cfg, has_tt, has_mask, wte, wpe, wtt, emb_ln_w, emb_ln_b,
     return _mark(mlm_logits, "dp", None, "mp"), nsp_logits
 
 
-def bert_pretrain_loss(model, input_ids, mlm_labels, nsp_labels):
-    """MLM (ignore_index=-100) + NSP cross entropy."""
-    mlm_logits, nsp_logits = model(input_ids)
+def bert_pretrain_loss(model, input_ids, mlm_labels, nsp_labels,
+                       attention_mask=None):
+    """MLM (ignore_index=-100) + NSP cross entropy.  ``attention_mask``
+    (B, S), 1 = real token: the padded-batch pretrain layout."""
+    mlm_logits, nsp_logits = model(input_ids,
+                                   attention_mask=attention_mask)
 
     def loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels):
         lg = mlm_logits.astype(jnp.float32)

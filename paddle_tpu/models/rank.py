@@ -16,7 +16,7 @@ import paddle_tpu.nn as nn
 from paddle_tpu.core import Tensor
 from paddle_tpu.distributed.ps import ShardedEmbedding
 
-__all__ = ["WideDeep", "DeepFM"]
+__all__ = ["WideDeep", "DeepFM", "WideDeepHost"]
 
 
 class WideDeep(nn.Layer):
@@ -75,3 +75,37 @@ class DeepFM(nn.Layer):
         deep_in = paddle.concat(
             [paddle.reshape(emb, [B, -1]), dense_x], axis=1)
         return fm1 + fm2 + self.deep(deep_in)
+
+
+class WideDeepHost(nn.Layer):
+    """Wide&Deep over EXTERNALLY pulled embedding rows — the host-PS tier.
+
+    The reference's Wide&Deep configs feed distributed_lookup_table ops
+    whose rows arrive from the PS (pull) rather than from a device
+    parameter; this model is that shape: ``forward(rows, dense_x)`` where
+    ``rows`` (B, F, E+1) carries the deep embedding (first E dims) and the
+    wide/linear slot (last dim) from ONE pulled table, so a single
+    pull/push pair serves both towers.  Train with
+    ``paddle_tpu.distributed.ps.PSTrainStep``.
+    """
+
+    def __init__(self, embedding_dim: int = 64, num_fields: int = 26,
+                 dense_dim: int = 13, hidden=(1024, 512, 256)):
+        super().__init__()
+        self.num_fields = num_fields
+        self.embedding_dim = embedding_dim
+        dims = [num_fields * embedding_dim + dense_dim, *hidden]
+        layers = []
+        for i in range(len(hidden)):
+            layers += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        layers += [nn.Linear(dims[-1], 1)]
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, rows, dense_x):
+        """rows (B, F, E+1) pulled float rows, dense_x (B, D)."""
+        B = rows.shape[0]
+        emb = rows[:, :, :self.embedding_dim]
+        wide = rows[:, :, self.embedding_dim:]
+        deep_in = paddle.concat(
+            [paddle.reshape(emb, [B, -1]), dense_x], axis=1)
+        return self.deep(deep_in) + paddle.sum(wide, axis=1)

@@ -108,6 +108,14 @@ def supported(q_shape, k_shape, no_mask: bool = True, causal: bool = False,
         # end-aligned causal with more queries than keys leaves rows with
         # no visible key; semantics degenerate — use the XLA path
         return False
+    if not _INTERPRET and not causal and sq < 1024 and sk < 1024:
+        # empirical dispatch crossover (BERT-base class, bf16, one chip):
+        # XLA's fused attention wins short non-causal sequences (S=128:
+        # 146k vs 97k tok/s in-model; S=512: 104k vs 97k), the kernel wins
+        # from S≈2048 (58.8k vs 53.4k) and dominates at 8k+ where the XLA
+        # path hits its O(S²) HBM cliff.  Causal configs always take the
+        # kernel — block skipping halves the work (S=1024 in-model win).
+        return False
     if d % 128 != 0 and d not in (64,):
         return False
     if bias_shape is not None and \
