@@ -22,6 +22,7 @@ dispatch; the performance path is whole-step capture via ``paddle_tpu.jit``
 from __future__ import annotations
 
 import contextlib
+import functools
 import threading
 import weakref
 from typing import Any, Callable, Optional, Sequence
@@ -598,6 +599,162 @@ def _is_tracer(x) -> bool:
 _amp_hook = [None]
 
 
+# --- eager dispatch cache ---------------------------------------------------
+# The reference generated per-op C++ fast paths (core.ops,
+# pybind/op_function_generator.cc) so eager dispatch didn't pay python
+# overhead per op.  Here the per-op cost is the ``jax.vjp`` re-trace; this
+# cache plays the core.ops role: the (forward, vjp) pair is jit-compiled once
+# per semantic op and reused.  ``jax.vjp``'s pullback is a pytree (a VJP
+# Partial), so it can be *returned from* a jitted forward and *passed into* a
+# jitted caller — both sides run compiled after the first hit.
+#
+# Keying: most functional ops hand ``apply`` a fresh closure per call
+# (config baked into cells), so identity keying would never hit.  Instead the
+# key is (code object, closure cell values, defaults, kwargs, arg layout,
+# grad positions) — semantically equal closures share an entry.  Anything
+# non-hashable in cells/args (arrays, per-call RNG keys, mutable objects)
+# makes the call uncacheable and it falls back to the direct path.
+
+_OP_CACHE: dict = {}
+_OP_CACHE_MAX = 1024
+_UNCACHEABLE = object()
+
+
+class _Unhashable(Exception):
+    pass
+
+
+def _hash_token(v, depth=0):
+    if v is None or isinstance(v, (bool, int, float, str, bytes, type)):
+        return v
+    if isinstance(v, (tuple, list)):
+        return ("t", isinstance(v, tuple),
+                tuple(_hash_token(x, depth) for x in v))
+    if isinstance(v, dict):
+        return ("d", tuple(sorted(
+            (k, _hash_token(x, depth)) for k, x in v.items())))
+    if isinstance(v, functools.partial):
+        return ("p", _fn_token(v.func, depth), _hash_token(v.args, depth),
+                _hash_token(v.keywords, depth))
+    if isinstance(v, np.dtype):
+        return ("dt", str(v))
+    if callable(v) and depth < 4:
+        return _fn_token(v, depth + 1)
+    raise _Unhashable
+
+
+def _fn_token(fn, depth=0):
+    if isinstance(fn, functools.partial):
+        return ("p", _fn_token(fn.func, depth), _hash_token(fn.args, depth),
+                _hash_token(fn.keywords, depth))
+    if getattr(fn, "__self__", None) is not None:
+        raise _Unhashable          # bound method: self not part of code/cells
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # builtin / PjitFunction singletons (jnp.matmul, jax.nn.relu):
+        # identity is stable because the key tuple holds a strong ref.
+        # Restrict to module-level names — a callable object minted per
+        # call would key by identity and jit-compile on every call.
+        if "<locals>" in getattr(fn, "__qualname__", "<locals>"):
+            raise _Unhashable
+        try:
+            hash(fn)
+        except TypeError:
+            raise _Unhashable from None
+        return ("f", fn)
+    cells = tuple(_hash_token(c.cell_contents, depth)
+                  for c in (fn.__closure__ or ()))
+    dflts = _hash_token(fn.__defaults__ or (), depth)
+    return ("c", code, cells, dflts)
+
+
+def _op_cache_key(fn, args, tensor_pos, grad_pos, kwargs):
+    """Returns (key, runtime_pos) or None if the call can't be cached."""
+    try:
+        runtime_pos = []
+        arg_sig = []
+        tp = set(tensor_pos)
+        for i, a in enumerate(args):
+            if i in tp or isinstance(a, (jax.Array,)) or (
+                    hasattr(a, "shape") and hasattr(a, "dtype")
+                    and hasattr(a, "__array__")):
+                runtime_pos.append(i)
+                arg_sig.append((i, "rt"))
+            else:
+                arg_sig.append((i, _hash_token(a)))
+        key = (_fn_token(fn), tuple(arg_sig), tuple(grad_pos),
+               _hash_token(kwargs))
+        return key, runtime_pos
+    except _Unhashable:
+        return None
+
+
+# compiled pullback caller — caches per (vjp jaxpr treedef, cotangent treedef)
+_vjp_call = jax.jit(lambda v, c: v(c))
+
+
+def _build_op_entry(fn, kwargs, args_template, runtime_pos, grad_pos):
+    rt = set(runtime_pos)
+    static_args = [None if i in rt else a
+                   for i, a in enumerate(args_template)]
+
+    if grad_pos:
+        def fwd(rt_arrays):
+            full = list(static_args)
+            for p, a in zip(runtime_pos, rt_arrays):
+                full[p] = a
+
+            def pure(*darrs):
+                f2 = list(full)
+                for p, d in zip(grad_pos, darrs):
+                    f2[p] = d
+                return fn(*f2, **kwargs)
+
+            return jax.vjp(pure, *[full[p] for p in grad_pos])
+    else:
+        def fwd(rt_arrays):
+            full = list(static_args)
+            for p, a in zip(runtime_pos, rt_arrays):
+                full[p] = a
+            return fn(*full, **kwargs)
+    return jax.jit(fwd)
+
+
+def _cached_dispatch(fn, frozen, tensor_pos, grad_pos, kwargs):
+    """Try the compiled fast path.  Returns (out, vjp_fn_or_None) or None to
+    signal the caller to take the direct path."""
+    from paddle_tpu.framework.flags import flag
+    if not flag("eager_op_jit_cache"):
+        return None
+    keyed = _op_cache_key(fn, frozen, tensor_pos, grad_pos, kwargs)
+    if keyed is None:
+        return None
+    key, runtime_pos = keyed
+    for p in runtime_pos:
+        if _is_tracer(frozen[p]):
+            return None            # inside an outer trace: no nested jit
+    entry = _OP_CACHE.get(key)
+    if entry is _UNCACHEABLE:
+        return None
+    if entry is None:
+        if len(_OP_CACHE) >= _OP_CACHE_MAX:
+            for _ in range(_OP_CACHE_MAX // 8):
+                _OP_CACHE.pop(next(iter(_OP_CACHE)))
+        entry = _build_op_entry(fn, kwargs, frozen, runtime_pos, grad_pos)
+        _OP_CACHE[key] = entry
+    rt_arrays = [frozen[p] for p in runtime_pos]
+    try:
+        res = entry(rt_arrays)
+    except Exception:
+        # value-dependent python control flow etc. — never try again
+        _OP_CACHE[key] = _UNCACHEABLE
+        return None
+    if grad_pos:
+        out, vjp = res
+        return out, (lambda cts, _v=vjp: _vjp_call(_v, cts))
+    return res, None
+
+
 def _nan_inf_guard(name: str, out):
     """FLAGS_check_nan_inf watcher (reference:
     framework/details/nan_inf_utils.h:28 CheckOpHasNanOrInf, called from
@@ -651,7 +808,11 @@ def apply(fn: Callable, *args, name: str = "", nondiff: Sequence[int] = (),
         frozen[i] = frozen[i]._data
 
     if not track:
-        out = fn(*frozen, **kwargs)
+        cached = _cached_dispatch(fn, frozen, tensor_pos, (), kwargs)
+        if cached is not None:
+            out = cached[0]
+        else:
+            out = fn(*frozen, **kwargs)
         _nan_inf_guard(name or getattr(fn, "__name__", "op"), out)
         return _wrap_outputs(out, stop_gradient=True)
 
@@ -663,7 +824,11 @@ def apply(fn: Callable, *args, name: str = "", nondiff: Sequence[int] = (),
             full[i] = arr
         return fn(*full, **kwargs)
 
-    out, vjp_fn = jax.vjp(pure, *grad_arrays)
+    cached = _cached_dispatch(fn, frozen, tensor_pos, tuple(grad_pos), kwargs)
+    if cached is not None:
+        out, vjp_fn = cached
+    else:
+        out, vjp_fn = jax.vjp(pure, *grad_arrays)
     _nan_inf_guard(name or getattr(fn, "__name__", "op"), out)
     outs = _wrap_outputs(out, stop_gradient=False)
     node = TapeNode(vjp_fn, [args[i] for i in grad_pos],

@@ -370,34 +370,25 @@ class PSTrainStep:
 
     def _make_step(self, ids_shape):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
-        grad_clip = getattr(opt, "_grad_clip", None)
 
         def step(params, opt_states, buffers, key, lr, rows_u, inv,
                  *inputs):
-            from paddle_tpu.autograd import no_grad
-            from paddle_tpu.jit import _GeneratorKeyGuard
+            from paddle_tpu.jit import (apply_functional_update,
+                                        functional_loss_call)
 
             def lf(p, ru):
+                # the pulled unique rows re-gathered per slot on device;
+                # the gather VJP sums duplicate-id grads for free
                 rows = ru.astype(jnp.float32)[inv].reshape(
                     tuple(ids_shape) + (ru.shape[-1],))
-                tensors = [Tensor(i) for i in inputs]
-                with _GeneratorKeyGuard(key):
-                    with model._swapped_state(p, buffers):
-                        with no_grad():
-                            loss = loss_fn(model, Tensor(rows), *tensors)
-                        new_buffers = {n: b._data
-                                       for n, b in model.named_buffers()
-                                       if b is not None}
-                arr = loss._data if isinstance(loss, Tensor) else loss
-                return arr.astype(jnp.float32), new_buffers
+                return functional_loss_call(
+                    model, loss_fn, p, buffers, key, inputs,
+                    lead_tensors=(Tensor(rows),))
 
             (loss, new_buffers), (grads, drows_u) = jax.value_and_grad(
                 lf, argnums=(0, 1), has_aux=True)(params, rows_u)
-            if grad_clip is not None and hasattr(grad_clip,
-                                                 "functional_clip"):
-                grads = grad_clip.functional_clip(grads)
-            new_params, new_states = opt.functional_update(
-                params, grads, opt_states, lr=lr)
+            new_params, new_states = apply_functional_update(
+                opt, grads, params, opt_states, lr)
             return new_params, new_states, new_buffers, loss, drows_u
 
         donate = (0, 1) if self.donate else ()

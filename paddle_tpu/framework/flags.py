@@ -69,4 +69,8 @@ define_flag("use_bf16_matmul", True,
 define_flag("cudnn_deterministic", False,
             "accepted for compat; XLA on TPU is deterministic by default")
 define_flag("max_inplace_grad_add", 0, "compat no-op")
+define_flag("eager_op_jit_cache", True,
+            "compiled (fwd, vjp) fast path for eager op dispatch, keyed on "
+            "op semantics — plays the reference's generated core.ops role "
+            "(pybind/op_function_generator.cc)")
 define_flag("conv_workspace_size_limit", 512, "compat no-op")

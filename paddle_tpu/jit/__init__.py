@@ -236,6 +236,45 @@ def not_to_static(func):
     return func
 
 
+def functional_loss_call(model, loss_fn, params, buffers, key, inputs,
+                         lead_tensors=(), amp=False,
+                         amp_dtype=jnp.bfloat16):
+    """The shared functional core of every captured train step: evaluate
+    ``loss_fn(model, *lead_tensors, *inputs)`` with ``params``/``buffers``
+    swapped into the model, the RNG key installed for the trace, and the
+    tape off.  Returns ``(loss_f32, new_buffers)``.  Used by TrainStep,
+    ShardedTrainStep stages and PSTrainStep so clip/donation/AMP semantics
+    cannot fork between them."""
+    if amp:
+        params = {
+            n: (p.astype(amp_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) and
+                p.ndim >= 1 else p)
+            for n, p in params.items()}
+        inputs = [i.astype(amp_dtype)
+                  if jnp.issubdtype(i.dtype, jnp.floating) else i
+                  for i in inputs]
+    tensors = [Tensor(i) for i in inputs]
+    with _GeneratorKeyGuard(key):
+        with model._swapped_state(params, buffers):
+            with no_grad():
+                loss = loss_fn(model, *lead_tensors, *tensors)
+            new_buffers = {n: b._data
+                           for n, b in model.named_buffers()
+                           if b is not None}
+    loss_arr = loss._data if isinstance(loss, Tensor) else loss
+    return loss_arr.astype(jnp.float32), new_buffers
+
+
+def apply_functional_update(opt, grads, params, opt_states, lr):
+    """Clip (if the optimizer carries a functional clip) + functional
+    optimizer update — the tail every captured step shares."""
+    grad_clip = getattr(opt, "_grad_clip", None)
+    if grad_clip is not None and hasattr(grad_clip, "functional_clip"):
+        grads = grad_clip.functional_clip(grads)
+    return opt.functional_update(params, grads, opt_states, lr=lr)
+
+
 class TrainStep:
     """One fused XLA training step: forward + grad + optimizer update.
 
@@ -276,30 +315,11 @@ class TrainStep:
         opt = self.optimizer
         amp = self.amp_level in ("O1", "O2")
         amp_dtype = self.amp_dtype
-        grad_clip = getattr(opt, "_grad_clip", None)
 
         def loss_from(params, buffers, key, inputs):
-            if amp:
-                cast_params = {
-                    n: (p.astype(amp_dtype)
-                        if jnp.issubdtype(p.dtype, jnp.floating) and
-                        p.ndim >= 1 else p)
-                    for n, p in params.items()}
-                inputs = [i.astype(amp_dtype)
-                          if jnp.issubdtype(i.dtype, jnp.floating) else i
-                          for i in inputs]
-            else:
-                cast_params = params
-            tensors = [Tensor(i) for i in inputs]
-            with _GeneratorKeyGuard(key):
-                with model._swapped_state(cast_params, buffers):
-                    with no_grad():
-                        loss = loss_fn(model, *tensors)
-                    new_buffers = {n: b._data
-                                   for n, b in model.named_buffers()
-                                   if b is not None}
-            loss_arr = loss._data if isinstance(loss, Tensor) else loss
-            return loss_arr.astype(jnp.float32), new_buffers
+            return functional_loss_call(
+                model, loss_fn, params, buffers, key, inputs,
+                amp=amp, amp_dtype=amp_dtype)
 
         if self.recompute:
             # Recompute meta-optimizer parity (reference:
@@ -331,11 +351,8 @@ class TrainStep:
                 (loss, new_buffers), grads = jax.value_and_grad(
                     lambda p: loss_from(p, buffers, key, list(inputs)),
                     has_aux=True)(params)
-            if grad_clip is not None and hasattr(grad_clip,
-                                                 "functional_clip"):
-                grads = grad_clip.functional_clip(grads)
-            new_params, new_states = opt.functional_update(
-                params, grads, opt_states, lr=lr)
+            new_params, new_states = apply_functional_update(
+                opt, grads, params, opt_states, lr)
             return new_params, new_states, new_buffers, loss
 
         donate = (0, 1, 2) if self.donate else ()

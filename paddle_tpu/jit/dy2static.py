@@ -28,6 +28,7 @@ import builtins
 import functools
 import inspect
 import textwrap
+from collections import Counter
 from typing import Callable, List, Sequence, Set
 
 __all__ = ["convert_to_static", "run_if", "run_while", "loop_cont",
@@ -202,6 +203,26 @@ def _incoming_reads(nodes: Sequence[ast.AST]) -> Set[str]:
     return incoming
 
 
+class _LoadCounter(ast.NodeVisitor):
+    """Name-Load site counts, descending into every scope (a nested
+    lambda/def closing over a local still reads it)."""
+
+    def __init__(self):
+        self.counts: Counter = Counter()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.counts[node.id] += 1
+        self.generic_visit(node)
+
+
+def _count_loads(nodes) -> Counter:
+    c = _LoadCounter()
+    for n in (nodes if isinstance(nodes, (list, tuple)) else [nodes]):
+        c.visit(n)
+    return c.counts
+
+
 class _EscapeScanner(ast.NodeVisitor):
     """True if the statements can't be outlined into a branch function:
     control-flow escapes, scope statements, or non-name stores."""
@@ -270,16 +291,16 @@ def _jst_call(fn: str, args: List[ast.AST]) -> ast.Call:
 
 class _Transformer(ast.NodeTransformer):
     def __init__(self, global_names: Set[str],
-                 local_names: Set[str] = frozenset()):
+                 local_names: Set[str] = frozenset(),
+                 fn_loads: Counter = None):
         self.skip = (set(global_names) | set(dir(builtins)) | {"_jst"}) \
             - set(local_names)
         self.count = 0
         self.changed = False
-
-    def _locals(self, reads: Set[str], writes: Set[str]):
-        loc = sorted((reads | writes) - self.skip)
-        outs = sorted(writes - self.skip)
-        return loc, outs
+        # Load-site counts over the WHOLE original function: a name whose
+        # every load lies inside one converted region is invisible outside
+        # it and can stay local to the generated body/branch functions.
+        self.fn_loads = fn_loads if fn_loads is not None else Counter()
 
     def _grab(self, params: List[str]) -> ast.Call:
         return _jst_call("grab", [
@@ -293,24 +314,41 @@ class _Transformer(ast.NodeTransformer):
         return ast.List(elts=[ast.Constant(value=n) for n in names],
                         ctx=ast.Load())
 
+    def _region_locals(self, node, writes, incoming):
+        """Names written in the region, never read before the write inside
+        it, and whose every Load site in the function lies inside the
+        region — pure temporaries that stay local to the generated
+        functions instead of becoming carries/outputs."""
+        sub = _count_loads(node)
+        return {w for w in writes
+                if w not in incoming and self.fn_loads[w] == sub[w]}
+
     # -- if ---------------------------------------------------------------
     def visit_If(self, node: ast.If):
-        self.generic_visit(node)
         body, orelse = node.body, node.orelse or []
         if _escapes(body) or _escapes(orelse):
+            self.generic_visit(node)
             return node
+        # analyze the ORIGINAL region before children are rewritten —
+        # converted children read their operands through grab(locals()),
+        # which static analysis cannot see
         _, w_body = _names(body)
         _, w_else = _names(orelse)
         writes = (w_body | w_else) - self.skip
-        if not writes:
-            return node
         incoming = (_incoming_reads(body) | _incoming_reads(orelse)) \
             - self.skip
+        local_tmp = self._region_locals(node, writes, incoming)
+        writes -= local_tmp
+        if not writes:
+            self.generic_visit(node)
+            return node
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse or []
         params = sorted(incoming | writes)
         outs = sorted(writes)
         # written in only one branch → the other returns the incoming
         # value, which must therefore exist (runtime-checked under trace)
-        need_init = sorted((w_body ^ w_else) - self.skip)
+        need_init = sorted(((w_body ^ w_else) - self.skip) - local_tmp)
         self.changed = True
         i = self.count = self.count + 1
         ret = ast.Return(value=_name_tuple(outs, ast.Load))
@@ -334,13 +372,23 @@ class _Transformer(ast.NodeTransformer):
 
     # -- while ------------------------------------------------------------
     def visit_While(self, node: ast.While):
-        self.generic_visit(node)
         if node.orelse or _escapes(node.body) or _escapes([node.test]):
+            self.generic_visit(node)
             return node
-        reads, writes = _names(node.body + [node.test])
-        loc, outs = self._locals(reads, writes)
-        if not outs:
+        # original-region analysis (see visit_If); the loop carry is what
+        # the test reads plus what the body reads before writing, plus
+        # writes someone outside the loop can observe — a temp written
+        # before every read and loaded nowhere else stays body-local
+        test_reads, _ = _names([node.test])
+        _, writes = _names(node.body)
+        writes -= self.skip
+        required = (test_reads | _incoming_reads(node.body)) - self.skip
+        local_tmp = self._region_locals(node, writes, required)
+        if not (writes - local_tmp):
+            self.generic_visit(node)
             return node
+        self.generic_visit(node)
+        loc = sorted(required | (writes - local_tmp))
         self.changed = True
         i = self.count = self.count + 1
         tdef = ast.FunctionDef(
@@ -439,7 +487,8 @@ def convert_to_static(fn: Callable) -> Callable:
         # locals — co_varnames wins over the whole skip set
         tr = _Transformer(
             set(inner.__globals__) | set(inner.__code__.co_freevars),
-            local_names=set(inner.__code__.co_varnames))
+            local_names=set(inner.__code__.co_varnames),
+            fn_loads=_count_loads(fdef))
         tree = tr.visit(tree)
         if not tr.changed:
             return fn

@@ -1,0 +1,113 @@
+"""Eager dispatch cache (core._OP_CACHE): the core.ops fast-path role.
+
+Reference role: pybind/op_function_generator.cc generated per-op C++ entry
+points so eager dispatch skipped python overhead; here the per-op cost is
+the ``jax.vjp`` re-trace, and the cache compiles the (fwd, vjp) pair once
+per semantic op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import core
+from paddle_tpu.framework.flags import set_flags
+
+
+@pytest.fixture(autouse=True)
+def _cache_on():
+    set_flags({"eager_op_jit_cache": True})
+    yield
+    set_flags({"eager_op_jit_cache": True})
+
+
+def _rand(shape, seed=0, dtype=np.float32):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).standard_normal(shape).astype(dtype),
+        stop_gradient=False)
+
+
+def _grads_of(fn, *tensors):
+    out = fn(*tensors)
+    out.sum().backward()
+    return [t.grad.numpy().copy() for t in tensors]
+
+
+def test_cached_matches_uncached_fwd_bwd():
+    configs = [
+        (lambda a, b: F.linear(a, b), [(8, 16), (16, 4)]),
+        (lambda a, b: F.conv2d(a, b, padding=1), [(2, 3, 8, 8),
+                                                  (4, 3, 3, 3)]),
+        (lambda a: F.softmax(a, axis=-1), [(4, 10)]),
+        (lambda a: F.gelu(a), [(32,)]),
+    ]
+    for fn, shapes in configs:
+        set_flags({"eager_op_jit_cache": True})
+        ts1 = [_rand(s, seed=i) for i, s in enumerate(shapes)]
+        o1 = fn(*ts1)
+        g1 = _grads_of(fn, *[_rand(s, seed=i) for i, s in enumerate(shapes)])
+        set_flags({"eager_op_jit_cache": False})
+        o2 = fn(*[_rand(s, seed=i) for i, s in enumerate(shapes)])
+        g2 = _grads_of(fn, *[_rand(s, seed=i) for i, s in enumerate(shapes)])
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_cache_hits_across_calls_same_config():
+    x = _rand((4, 8), seed=1)
+    F.relu(x)
+    n0 = len(core._OP_CACHE)
+    for i in range(5):
+        F.relu(_rand((4, 8), seed=i))
+    assert len(core._OP_CACHE) == n0  # same semantic op -> one entry
+
+
+def test_distinct_configs_get_distinct_entries():
+    x = _rand((2, 3, 8, 8), seed=0)
+    w = _rand((4, 3, 3, 3), seed=1)
+    F.conv2d(x, w, padding=2)
+    n0 = len(core._OP_CACHE)
+    F.conv2d(x, w, padding=2, dilation=2)   # different closure cell value
+    assert len(core._OP_CACHE) == n0 + 1
+
+
+def test_shape_change_reuses_entry():
+    # jit handles shape polymorphism inside one entry
+    w = _rand((16, 4), seed=3)
+    F.linear(_rand((8, 16), seed=1), w)
+    n0 = len(core._OP_CACHE)
+    F.linear(_rand((32, 16), seed=2), w)
+    assert len(core._OP_CACHE) == n0
+
+
+def test_dropout_not_frozen_by_cache():
+    x = paddle.to_tensor(np.ones((64, 64), np.float32))
+    a = F.dropout(x, p=0.5, training=True).numpy()
+    b = F.dropout(x, p=0.5, training=True).numpy()
+    assert not np.array_equal(a, b)  # per-call RNG key -> uncacheable
+
+
+def test_value_dependent_fn_falls_back():
+    import jax.numpy as jnp
+
+    def branchy(a):
+        if float(a.sum()) > 0:      # concretization error under jit
+            return a * 2.0
+        return a * 3.0
+
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    out = core.apply1(branchy, x, name="branchy")
+    np.testing.assert_allclose(out.numpy(), np.full((4,), 2.0))
+    # second call goes straight to fallback (key marked uncacheable)
+    out2 = core.apply1(branchy, paddle.to_tensor(-np.ones((4,), np.float32)))
+    np.testing.assert_allclose(out2.numpy(), np.full((4,), -3.0))
+
+
+def test_double_backward_unaffected():
+    x = _rand((6,), seed=7)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad([y], [x], create_graph=True)
+    (ggx,) = paddle.grad([gx.sum()], [x])
+    np.testing.assert_allclose(ggx.numpy(), 6 * x.numpy(), rtol=1e-5)

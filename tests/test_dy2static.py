@@ -179,3 +179,55 @@ def test_empty_range_does_not_clobber_target():
 
     g = convert_to_static(f)
     assert g(paddle.to_tensor(np.ones((1,), np.float32))) == 10
+
+
+def test_while_body_local_temp_traced():
+    # a temp written before every read inside the loop body must not
+    # become a loop carry (it has no value before the loop)
+    def f(s):
+        while paddle.sum(s) < 10:
+            t = s * 2
+            s = s + t
+        return s
+
+    g = convert_to_static(f)
+    out = g(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [27.0])      # 1→3→9→27
+
+    # same function under a jit trace (the carry path)
+    from paddle_tpu import jit
+
+    gg = jit.to_static(f)
+    out2 = gg(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(out2.numpy(), [27.0])
+
+
+def test_if_branch_local_temp_traced():
+    def f(x):
+        if paddle.mean(x) > 0:
+            t = x * 2
+            y = t + 1
+        else:
+            y = x - 1
+        return y
+
+    g = convert_to_static(f)
+    np.testing.assert_allclose(
+        g(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [3.0])
+    np.testing.assert_allclose(
+        g(paddle.to_tensor(np.array([-1.0], np.float32))).numpy(), [-2.0])
+
+
+def test_body_local_read_after_loop_still_required():
+    # t is read AFTER the loop → it must stay a carry and hence must
+    # exist before the loop; here it does, so values flow correctly
+    def f(s):
+        t = s * 0
+        while paddle.sum(s) < 10:
+            t = s * 2
+            s = s + t
+        return s + t
+
+    g = convert_to_static(f)
+    out = g(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [45.0])      # 27 + 18

@@ -29,6 +29,9 @@ import time
 
 import numpy as np
 
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 BUILTIN_SUITE = [
     {"name": "matmul_1k", "op": "paddle_tpu.matmul",
      "args": [{"shape": [1024, 1024], "dtype": "float32"},
@@ -97,8 +100,70 @@ def run_one(cfg, warmup=3, iters=10):
             "ms": round(dt * 1e3, 4)}
 
 
+def eager_vs_jit_bench(iters=30, batch=64):
+    """Quantify eager dispatch overhead: a LeNet fwd+bwd+SGD step timed
+    (a) eager with the compiled (fwd,vjp) dispatch cache off,
+    (b) eager with it on (the core.ops fast-path role,
+        reference pybind/op_function_generator.cc), and
+    (c) fully captured as one XLA computation (jit.TrainStep).
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.vision.models import LeNet
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((batch, 1, 28, 28))
+                         .astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, size=(batch,)).astype(np.int64))
+
+    def loss_fn(model, xb, yb):
+        import paddle_tpu.nn.functional as F
+        return F.cross_entropy(model(xb), yb)
+
+    def eager_step(model, opt):
+        loss = loss_fn(model, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    results = {}
+    for mode in ("eager_nocache", "eager_cached", "trainstep_jit"):
+        model = LeNet()
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        set_flags({"eager_op_jit_cache": mode != "eager_nocache"})
+        if mode == "trainstep_jit":
+            step = jit.TrainStep(model, loss_fn, opt)
+            run = lambda: step(x, y)                       # noqa: E731
+        else:
+            run = lambda: eager_step(model, opt)           # noqa: E731
+        for _ in range(5):
+            loss = run()
+        _sync(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = run()
+        _sync(loss)
+        results[mode] = (time.perf_counter() - t0) / iters * 1e3
+    set_flags({"eager_op_jit_cache": True})
+    out = {"name": "lenet_step_dispatch", "batch": batch,
+           "eager_nocache_ms": round(results["eager_nocache"], 3),
+           "eager_cached_ms": round(results["eager_cached"], 3),
+           "trainstep_jit_ms": round(results["trainstep_jit"], 3),
+           "cache_speedup": round(
+               results["eager_nocache"] / results["eager_cached"], 2),
+           "jit_speedup_vs_eager": round(
+               results["eager_nocache"] / results["trainstep_jit"], 2)}
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--eager", action="store_true",
+                    help="run the eager-vs-jit dispatch benchmark")
     ap.add_argument("--config", help="JSON list of op configs")
     ap.add_argument("--save", help="write results JSON here")
     ap.add_argument("--compare", help="baseline JSON to gate against")
@@ -106,6 +171,13 @@ def main(argv=None):
                     help="allowed relative slowdown vs baseline")
     ap.add_argument("--iters", type=int, default=10)
     a = ap.parse_args(argv)
+
+    if a.eager:
+        r = eager_vs_jit_bench(iters=a.iters if a.iters != 10 else 30)
+        if a.save:
+            with open(a.save, "w") as f:
+                json.dump([r], f, indent=1)
+        return 0
 
     suite = BUILTIN_SUITE
     if a.config:
