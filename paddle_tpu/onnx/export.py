@@ -1,0 +1,554 @@
+"""jaxpr -> ONNX (opset 13) conversion.
+
+Reference: python/paddle/onnx/export.py (the paddle2onnx bridge walks the
+inference Program op-by-op and emits ONNX nodes).  TPU-native version:
+the layer's eval-mode forward is captured as a jaxpr (the same functional
+capture jit.save uses) and each jax primitive is lowered to ONNX ops —
+parameters/buffers become initializers, jit/custom_jvp sub-jaxprs are
+inlined, matmuls lower through a general dot_general -> MatMul
+canonicalization, convs/pools map dimension numbers onto Conv/MaxPool/
+AveragePool.  bfloat16 is widened to float32 (every ONNX consumer reads
+f32; bf16 support is spotty).
+
+The produced file is a real ONNX ModelProto — parse it with
+``paddle_tpu.onnx.load_model`` or any onnx tool; ``paddle_tpu.onnx.
+run_model`` executes it with numpy for validation.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.extend.core as _jex_core
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.onnx import proto
+
+_BF16 = "bfloat16"
+
+
+def _np_of(aval_dtype):
+    return np.float32 if str(aval_dtype) == _BF16 else \
+        np.dtype(str(aval_dtype))
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self._n = 0
+        self._env: Dict[object, str] = {}
+
+    # -- naming / wiring ----------------------------------------------------
+    def fresh(self, hint="v") -> str:
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def const(self, arr, hint="c") -> str:
+        arr = np.asarray(arr)
+        if str(arr.dtype) == _BF16 or arr.dtype == np.dtype("V2"):
+            arr = np.asarray(jnp.asarray(arr).astype(jnp.float32))
+        name = self.fresh(hint)
+        self.initializers.append(proto.tensor_proto(name, arr))
+        return name
+
+    def resolve(self, var) -> str:
+        if isinstance(var, _jex_core.Literal):
+            return self.const(var.val, "lit")
+        return self._env[var]
+
+    def bind(self, var, name: str):
+        self._env[var] = name
+
+    def emit(self, op, inputs, n_out=1, attrs=None, hint=None):
+        outs = [self.fresh(hint or op.lower()) for _ in range(n_out)]
+        self.nodes.append(proto.node(op, list(inputs), outs,
+                                     name=outs[0] + "_node", attrs=attrs))
+        return outs[0] if n_out == 1 else outs
+
+    # -- jaxpr walk ---------------------------------------------------------
+    def convert_jaxpr(self, jaxpr, consts):
+        for cv, cval in zip(jaxpr.constvars, consts):
+            self.bind(cv, self.const(np.asarray(cval), "const"))
+        for eqn in jaxpr.eqns:
+            self.eqn(eqn)
+
+    def _inline(self, eqn, closed):
+        inner = getattr(closed, "jaxpr", closed)
+        consts = getattr(closed, "consts", [])
+        for iv, outer in zip(inner.invars, eqn.invars):
+            self.bind(iv, self.resolve(outer))
+        self.convert_jaxpr(inner, consts)
+        for ov, inner_ov in zip(eqn.outvars, inner.outvars):
+            if type(ov).__name__ != "DropVar":
+                self.bind(ov, self.resolve(inner_ov))
+
+    def eqn(self, eqn):
+        p = eqn.primitive.name
+        handler = getattr(self, "p_" + p, None)
+        if handler is None:
+            handler = _SIMPLE.get(p)
+            if handler is None:
+                raise NotImplementedError(
+                    f"ONNX export: jax primitive '{p}' has no lowering "
+                    f"(eqn: {eqn})")
+            ins = [self.resolve(v) for v in eqn.invars]
+            out = self.emit(handler, ins, hint=p)
+            self.bind(eqn.outvars[0], out)
+            return
+        handler(eqn)
+
+    # -- composite / structural --------------------------------------------
+    def p_jit(self, eqn):
+        self._inline(eqn, eqn.params["jaxpr"])
+
+    p_pjit = p_jit
+
+    def p_closed_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"])
+
+    def p_custom_jvp_call(self, eqn):
+        self._inline(eqn, eqn.params["call_jaxpr"])
+
+    def p_custom_vjp_call(self, eqn):
+        cj = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+        self._inline(eqn, cj)
+
+    p_custom_vjp_call_jaxpr = p_custom_vjp_call
+
+    def p_remat(self, eqn):
+        self._inline(eqn, eqn.params["jaxpr"])
+
+    p_checkpoint = p_remat
+
+    def p_stop_gradient(self, eqn):
+        self.bind(eqn.outvars[0], self.resolve(eqn.invars[0]))
+
+    def p_copy(self, eqn):
+        self.bind(eqn.outvars[0], self.resolve(eqn.invars[0]))
+
+    # -- elementwise specials ----------------------------------------------
+    def p_rsqrt(self, eqn):
+        s = self.emit("Sqrt", [self.resolve(eqn.invars[0])])
+        self.bind(eqn.outvars[0], self.emit("Reciprocal", [s]))
+
+    def p_square(self, eqn):
+        x = self.resolve(eqn.invars[0])
+        self.bind(eqn.outvars[0], self.emit("Mul", [x, x]))
+
+    def p_integer_pow(self, eqn):
+        x = self.resolve(eqn.invars[0])
+        dt = _np_of(eqn.invars[0].aval.dtype)
+        y = self.const(np.array(eqn.params["y"], dt), "pow")
+        self.bind(eqn.outvars[0], self.emit("Pow", [x, y]))
+
+    def p_ne(self, eqn):
+        ins = [self.resolve(v) for v in eqn.invars]
+        e = self.emit("Equal", ins)
+        self.bind(eqn.outvars[0], self.emit("Not", [e]))
+
+    def p_rem(self, eqn):
+        ins = [self.resolve(v) for v in eqn.invars]
+        self.bind(eqn.outvars[0],
+                  self.emit("Mod", ins, attrs={"fmod": 1}))
+
+    def p_clamp(self, eqn):
+        lo, x, hi = [self.resolve(v) for v in eqn.invars]
+        self.bind(eqn.outvars[0], self.emit("Clip", [x, lo, hi]))
+
+    def p_select_n(self, eqn):
+        if len(eqn.invars) != 3:
+            raise NotImplementedError("select_n with >2 cases")
+        c, f, t = [self.resolve(v) for v in eqn.invars]
+        self.bind(eqn.outvars[0], self.emit("Where", [c, t, f]))
+
+    def p_convert_element_type(self, eqn):
+        to = proto.onnx_dtype(_np_of(eqn.params["new_dtype"]))
+        x = self.resolve(eqn.invars[0])
+        self.bind(eqn.outvars[0],
+                  self.emit("Cast", [x], attrs={"to": to}))
+
+    def p_iota(self, eqn):
+        dt = _np_of(eqn.params["dtype"])
+        shape = tuple(eqn.params["shape"])
+        dim = eqn.params["dimension"]
+        arr = np.broadcast_to(
+            np.arange(shape[dim], dtype=dt).reshape(
+                [-1 if i == dim else 1 for i in range(len(shape))]),
+            shape).copy()
+        self.bind(eqn.outvars[0], self.const(arr, "iota"))
+
+    # -- shape ops ----------------------------------------------------------
+    def p_reshape(self, eqn):
+        if eqn.params.get("dimensions") is not None:
+            raise NotImplementedError("reshape with dimensions permute")
+        shp = self.const(np.array(eqn.params["new_sizes"], np.int64),
+                         "shape")
+        x = self.resolve(eqn.invars[0])
+        self.bind(eqn.outvars[0], self.emit("Reshape", [x, shp]))
+
+    def p_transpose(self, eqn):
+        x = self.resolve(eqn.invars[0])
+        perm = [int(i) for i in eqn.params["permutation"]]
+        self.bind(eqn.outvars[0],
+                  self.emit("Transpose", [x], attrs={"perm": perm}))
+
+    def p_squeeze(self, eqn):
+        x = self.resolve(eqn.invars[0])
+        axes = self.const(np.array(eqn.params["dimensions"], np.int64),
+                          "axes")
+        self.bind(eqn.outvars[0], self.emit("Squeeze", [x, axes]))
+
+    def p_broadcast_in_dim(self, eqn):
+        x = self.resolve(eqn.invars[0])
+        target = tuple(int(s) for s in eqn.params["shape"])
+        bdims = tuple(int(d) for d in eqn.params["broadcast_dimensions"])
+        src = tuple(eqn.invars[0].aval.shape)
+        if src == target:
+            self.bind(eqn.outvars[0], x)
+            return
+        interim = [1] * len(target)
+        for i, d in enumerate(bdims):
+            interim[d] = src[i]
+        if tuple(interim) != src or len(interim) != len(src):
+            shp = self.const(np.array(interim, np.int64), "shape")
+            x = self.emit("Reshape", [x, shp])
+        if tuple(interim) != target:
+            shp = self.const(np.array(target, np.int64), "shape")
+            x = self.emit("Expand", [x, shp])
+        self.bind(eqn.outvars[0], x)
+
+    def p_concatenate(self, eqn):
+        ins = [self.resolve(v) for v in eqn.invars]
+        self.bind(eqn.outvars[0], self.emit(
+            "Concat", ins, attrs={"axis": int(eqn.params["dimension"])}))
+
+    def p_slice(self, eqn):
+        x = self.resolve(eqn.invars[0])
+        starts = list(eqn.params["start_indices"])
+        ends = list(eqn.params["limit_indices"])
+        steps = list(eqn.params["strides"] or [1] * len(starts))
+        ins = [x,
+               self.const(np.array(starts, np.int64), "starts"),
+               self.const(np.array(ends, np.int64), "ends"),
+               self.const(np.arange(len(starts), dtype=np.int64), "axes"),
+               self.const(np.array(steps, np.int64), "steps")]
+        self.bind(eqn.outvars[0], self.emit("Slice", ins))
+
+    def p_pad(self, eqn):
+        cfg = eqn.params["padding_config"]
+        if any(i != 0 for _, _, i in cfg):
+            raise NotImplementedError("interior padding")
+        if any(lo < 0 or hi < 0 for lo, hi, _ in cfg):
+            raise NotImplementedError("negative (cropping) pads")
+        x = self.resolve(eqn.invars[0])
+        pv = self.resolve(eqn.invars[1])
+        pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+        self.bind(eqn.outvars[0], self.emit(
+            "Pad", [x, self.const(np.array(pads, np.int64), "pads"), pv]))
+
+    # -- reductions ---------------------------------------------------------
+    def p_reduce_sum(self, eqn):
+        x = self.resolve(eqn.invars[0])
+        axes = self.const(np.array(eqn.params["axes"], np.int64), "axes")
+        self.bind(eqn.outvars[0], self.emit(
+            "ReduceSum", [x, axes], attrs={"keepdims": 0}))
+
+    def _reduce_attr(self, eqn, op):
+        x = self.resolve(eqn.invars[0])
+        self.bind(eqn.outvars[0], self.emit(
+            op, [x], attrs={"axes": [int(a) for a in eqn.params["axes"]],
+                            "keepdims": 0}))
+
+    def p_reduce_max(self, eqn):
+        self._reduce_attr(eqn, "ReduceMax")
+
+    def p_reduce_min(self, eqn):
+        self._reduce_attr(eqn, "ReduceMin")
+
+    def p_reduce_prod(self, eqn):
+        self._reduce_attr(eqn, "ReduceProd")
+
+    def p_argmax(self, eqn):
+        self._arg(eqn, "ArgMax")
+
+    def p_argmin(self, eqn):
+        self._arg(eqn, "ArgMin")
+
+    def _arg(self, eqn, op):
+        x = self.resolve(eqn.invars[0])
+        axes = eqn.params["axes"]
+        out = self.emit(op, [x], attrs={"axis": int(axes[0]),
+                                        "keepdims": 0})
+        want = proto.onnx_dtype(_np_of(eqn.params["index_dtype"]))
+        if want != proto.DTYPE_TO_ONNX["int64"]:
+            out = self.emit("Cast", [out], attrs={"to": want})
+        self.bind(eqn.outvars[0], out)
+
+    def p_cumsum(self, eqn):
+        x = self.resolve(eqn.invars[0])
+        ax = self.const(np.array(eqn.params["axis"], np.int64), "axis")
+        self.bind(eqn.outvars[0], self.emit(
+            "CumSum", [x, ax],
+            attrs={"reverse": int(bool(eqn.params.get("reverse", False)))}))
+
+    # -- matmul -------------------------------------------------------------
+    def p_dot_general(self, eqn):
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lhs, rhs = eqn.invars
+        ls, rs = tuple(lhs.aval.shape), tuple(rhs.aval.shape)
+        lfree = [i for i in range(len(ls)) if i not in lc and i not in lb]
+        rfree = [i for i in range(len(rs)) if i not in rc and i not in rb]
+        x = self.resolve(lhs)
+        w = self.resolve(rhs)
+
+        def tr(name, perm):
+            if perm == list(range(len(perm))):
+                return name
+            return self.emit("Transpose", [name], attrs={"perm": perm})
+
+        def rs_(name, shape):
+            return self.emit("Reshape", [
+                name, self.const(np.array(shape, np.int64), "shape")])
+
+        B = int(np.prod([ls[i] for i in lb])) if lb else 1
+        M = int(np.prod([ls[i] for i in lfree])) if lfree else 1
+        K = int(np.prod([ls[i] for i in lc])) if lc else 1
+        N = int(np.prod([rs[i] for i in rfree])) if rfree else 1
+
+        x = tr(x, list(lb) + lfree + list(lc))
+        w = tr(w, list(rb) + list(rc) + rfree)
+        if lb:
+            x = rs_(x, (B, M, K))
+            w = rs_(w, (B, K, N))
+        else:
+            x = rs_(x, (M, K))
+            w = rs_(w, (K, N))
+        mm = self.emit("MatMul", [x, w])
+        out_shape = [ls[i] for i in lb] + [ls[i] for i in lfree] + \
+            [rs[i] for i in rfree]
+        if tuple(out_shape) != ((B, M, N) if lb else (M, N)):
+            mm = rs_(mm, out_shape)
+        self.bind(eqn.outvars[0], mm)
+
+    # -- conv / pooling -----------------------------------------------------
+    def p_conv_general_dilated(self, eqn):
+        P = eqn.params
+        dn = P["dimension_numbers"]
+        if any(d != 1 for d in P["lhs_dilation"]):
+            raise NotImplementedError("transposed conv export")
+        if P.get("batch_group_count", 1) != 1:
+            raise NotImplementedError("batch_group_count > 1")
+        x = self.resolve(eqn.invars[0])
+        w = self.resolve(eqn.invars[1])
+        lhs_spec, rhs_spec, out_spec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+        nd = len(lhs_spec)
+
+        def tr(name, perm):
+            if list(perm) == list(range(nd)):
+                return name
+            return self.emit("Transpose", [name],
+                             attrs={"perm": [int(i) for i in perm]})
+
+        # canonicalize to NC+spatial / OI+spatial
+        x = tr(x, list(lhs_spec))
+        w = tr(w, list(rhs_spec))
+        pads = [int(lo) for lo, _ in P["padding"]] + \
+            [int(hi) for _, hi in P["padding"]]
+        out = self.emit("Conv", [x, w], attrs={
+            "strides": [int(s) for s in P["window_strides"]],
+            "pads": pads,
+            "dilations": [int(d) for d in P["rhs_dilation"]],
+            "group": int(P["feature_group_count"])})
+        # back to the eqn's output layout
+        inv = [0] * nd
+        for i, d in enumerate(out_spec):
+            inv[d] = i
+        self.bind(eqn.outvars[0], tr(out, inv))
+
+    def _pool_common(self, eqn):
+        P = eqn.params
+        wd = list(P["window_dimensions"])
+        ws = list(P["window_strides"])
+        pad = list(P["padding"])
+        bd = P.get("base_dilation")
+        wdl = P.get("window_dilation")
+        if bd is not None and any(d != 1 for d in bd):
+            raise NotImplementedError("pool base_dilation")
+        if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1 or \
+                pad[0] != (0, 0) or pad[1] != (0, 0):
+            raise NotImplementedError(
+                "pooling windows over batch/channel dims")
+        attrs = {
+            "kernel_shape": [int(k) for k in wd[2:]],
+            "strides": [int(s) for s in ws[2:]],
+            "pads": [int(lo) for lo, _ in pad[2:]] +
+                    [int(hi) for _, hi in pad[2:]],
+        }
+        if wdl is not None and any(d != 1 for d in wdl[2:]):
+            attrs["dilations"] = [int(d) for d in wdl[2:]]
+        return attrs
+
+    def p_reduce_window_max(self, eqn):
+        attrs = self._pool_common(eqn)
+        x = self.resolve(eqn.invars[0])
+        self.bind(eqn.outvars[0], self.emit("MaxPool", [x], attrs=attrs))
+
+    def p_reduce_window_sum(self, eqn):
+        attrs = self._pool_common(eqn)
+        attrs["count_include_pad"] = 1
+        x = self.resolve(eqn.invars[0])
+        ap = self.emit("AveragePool", [x], attrs=attrs)
+        scale = float(np.prod(attrs["kernel_shape"]))
+        dt = _np_of(eqn.invars[0].aval.dtype)
+        c = self.const(np.array(scale, dt), "winsize")
+        self.bind(eqn.outvars[0], self.emit("Mul", [ap, c]))
+
+    # -- gather (embedding/take pattern) ------------------------------------
+    def p_gather(self, eqn):
+        dn = eqn.params["dimension_numbers"]
+        slice_sizes = tuple(eqn.params["slice_sizes"])
+        op_shape = tuple(eqn.invars[0].aval.shape)
+        idx_aval = eqn.invars[1].aval
+        csd = tuple(dn.collapsed_slice_dims)
+        sim = tuple(dn.start_index_map)
+        if len(csd) == 1 and sim == csd and \
+                idx_aval.shape and idx_aval.shape[-1] == 1 and \
+                all(s == op_shape[i] for i, s in enumerate(slice_sizes)
+                    if i != csd[0]) and slice_sizes[csd[0]] == 1 and \
+                not getattr(dn, "operand_batching_dims", ()):
+            axis = csd[0]
+            data = self.resolve(eqn.invars[0])
+            idx = self.resolve(eqn.invars[1])
+            ishape = list(idx_aval.shape[:-1]) or [1]
+            idx = self.emit("Reshape", [
+                idx, self.const(np.array(ishape, np.int64), "shape")])
+            if _np_of(idx_aval.dtype) not in (np.int32, np.int64):
+                idx = self.emit("Cast", [idx], attrs={
+                    "to": proto.DTYPE_TO_ONNX["int64"]})
+            out = self.emit("Gather", [data, idx], attrs={"axis": axis})
+            if not tuple(idx_aval.shape[:-1]):
+                # scalar index: output keeps slice dims only
+                pass
+            self.bind(eqn.outvars[0], out)
+            return
+        raise NotImplementedError(
+            f"general gather (dims {dn}, sizes {slice_sizes})")
+
+
+# single-node elementwise/compare lowerings
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "neg": "Neg", "abs": "Abs", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "erf": "Erf",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan",
+    "asin": "Asin", "acos": "Acos", "atan": "Atan",
+    "sinh": "Sinh", "cosh": "Cosh",
+    "eq": "Equal", "lt": "Less", "le": "LessOrEqual",
+    "gt": "Greater", "ge": "GreaterOrEqual",
+    "not": "Not", "and": "And", "or": "Or", "xor": "Xor",
+}
+
+
+def _capture_pure(layer):
+    """(param_names, param_arrays, pure_fn) for layer.eval() forward."""
+    from paddle_tpu.core import Tensor
+    pnames = [n for n, _ in layer.named_parameters()]
+    bnames = [n for n, b in layer.named_buffers() if b is not None]
+    parrs = [np.asarray(p._data) for _, p in layer.named_parameters()]
+    barrs = [np.asarray(b._data) for n, b in layer.named_buffers()
+             if b is not None]
+
+    def pure(ps, bs, xs):
+        pd = dict(zip(pnames, ps))
+        bd = dict(zip(bnames, bs))
+        with layer._swapped_state(pd, bd):
+            out = layer(*[Tensor(x) for x in xs])
+        flat, _ = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda o: isinstance(o, Tensor))
+        return [o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                for o in flat]
+
+    return pnames + bnames, parrs + barrs, pure
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 13, **configs):
+    """Export ``layer``'s eval-mode forward as a real ONNX file at
+    ``path`` (``.onnx`` appended if missing).  ``input_spec``: shapes —
+    InputSpec-likes (with .shape/.dtype), Tensors, or bare shape tuples.
+    Returns metadata including the node-count and the artifact path."""
+    if input_spec is None:
+        raise ValueError("onnx.export needs input_spec to trace the "
+                         "graph (same requirement as the reference)")
+    arrays = []
+    for spec in input_spec:
+        if hasattr(spec, "_data"):
+            arrays.append(np.asarray(spec._data))
+        elif hasattr(spec, "shape"):
+            shape = [1 if (s is None or s == -1) else int(s)
+                     for s in spec.shape]
+            dt = getattr(spec, "dtype", "float32")
+            dt = np.float32 if str(dt) in ("float32", "paddle.float32") \
+                else np.dtype(str(dt).replace("paddle.", ""))
+            arrays.append(np.zeros(shape, dt))
+        else:
+            arrays.append(np.zeros(tuple(spec), np.float32))
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        names, param_arrs, pure = _capture_pure(layer)
+        closed = jax.make_jaxpr(pure)(
+            [jnp.asarray(a) for a in param_arrs],
+            [], [jnp.asarray(a) for a in arrays])
+    finally:
+        if was_training:
+            layer.train()
+
+    conv = _Converter()
+    jaxpr = closed.jaxpr
+    n_params = len(param_arrs)
+    graph_inputs = []
+    # params -> initializers; inputs -> graph inputs
+    for i, v in enumerate(jaxpr.invars):
+        if i < n_params:
+            pname = "param::" + names[i]
+            arr = param_arrs[i]
+            if str(arr.dtype) == _BF16:
+                arr = np.asarray(jnp.asarray(arr).astype(jnp.float32))
+            conv.initializers.append(proto.tensor_proto(pname, arr))
+            conv.bind(v, pname)
+        else:
+            iname = f"input_{i - n_params}"
+            conv.bind(v, iname)
+            graph_inputs.append(proto.value_info(
+                iname, proto.onnx_dtype(_np_of(v.aval.dtype)),
+                v.aval.shape))
+    conv.convert_jaxpr(jaxpr, closed.consts)
+
+    graph_outputs = []
+    out_names = []
+    for i, ov in enumerate(jaxpr.outvars):
+        oname = f"output_{i}"
+        src = conv.resolve(ov)
+        conv.nodes.append(proto.node("Identity", [src], [oname],
+                                     name=f"out_{i}_node"))
+        graph_outputs.append(proto.value_info(
+            oname, proto.onnx_dtype(_np_of(ov.aval.dtype)),
+            ov.aval.shape))
+        out_names.append(oname)
+
+    g = proto.graph(conv.nodes, getattr(layer, "__class__").__name__,
+                    conv.initializers, graph_inputs, graph_outputs)
+    blob = proto.model(g, opset_version=opset_version)
+    if not path.endswith(".onnx"):
+        path = path + ".onnx"
+    with open(path, "wb") as f:
+        f.write(blob)
+    return {"model": path, "format": "onnx", "opset": opset_version,
+            "nodes": len(conv.nodes), "outputs": out_names}

@@ -1,0 +1,331 @@
+"""Minimal ONNX protobuf codec — writer + reader, no deps.
+
+ONNX models are proto3 messages (onnx/onnx.proto).  The environment has
+no ``onnx``/``protobuf`` package, so the wire format is implemented
+directly: varints, length-delimited fields, packed repeated scalars.
+Only the message subset the exporter emits is covered (ModelProto,
+GraphProto, NodeProto, TensorProto, AttributeProto, ValueInfoProto).
+
+Field numbers follow the public onnx.proto schema; the reader is generic
+(field -> wire values) so any conforming ONNX file parses, and the typed
+wrappers pull out what the reference interpreter (runtime.py) needs.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# -- wire-level writer -------------------------------------------------------
+
+_MASK64 = (1 << 64) - 1
+
+
+def _varint(n: int) -> bytes:
+    n &= _MASK64                       # two's-complement for negative int64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def f_bytes(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode("utf-8"))
+
+
+def f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def f_packed_varints(field: int, values) -> bytes:
+    payload = b"".join(_varint(v) for v in values)
+    return f_bytes(field, payload)
+
+
+def f_packed_floats(field: int, values) -> bytes:
+    return f_bytes(field, struct.pack(f"<{len(values)}f", *values))
+
+
+# -- ONNX messages -----------------------------------------------------------
+
+# TensorProto.DataType
+DTYPE_TO_ONNX = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+}
+ONNX_TO_NP = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+    6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+    12: np.uint32, 13: np.uint64,
+}
+
+
+def onnx_dtype(np_dtype) -> int:
+    name = np.dtype(np_dtype).name if str(np_dtype) != "bfloat16" \
+        else "bfloat16"
+    try:
+        return DTYPE_TO_ONNX[name]
+    except KeyError:
+        raise ValueError(f"dtype {np_dtype} has no ONNX mapping") from None
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    # bf16 is exported as f32 (ONNX bf16 raw encoding exists but f32 keeps
+    # every consumer compatible); the converter upcasts before calling
+    arr = np.ascontiguousarray(arr)
+    msg = b"".join(f_varint(1, int(d)) for d in arr.shape)
+    msg += f_varint(2, onnx_dtype(arr.dtype))
+    msg += f_string(8, name)
+    msg += f_bytes(9, arr.tobytes())       # raw_data
+    return msg
+
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_FLOATS, A_INTS, A_STRINGS = \
+    1, 2, 3, 4, 6, 7, 8
+
+
+def attribute(name: str, value) -> bytes:
+    msg = f_string(1, name)
+    if isinstance(value, bool):
+        msg += f_varint(3, int(value)) + f_varint(20, A_INT)
+    elif isinstance(value, int):
+        msg += f_varint(3, value) + f_varint(20, A_INT)
+    elif isinstance(value, float):
+        msg += f_float(2, value) + f_varint(20, A_FLOAT)
+    elif isinstance(value, str):
+        msg += f_bytes(4, value.encode()) + f_varint(20, A_STRING)
+    elif isinstance(value, np.ndarray):
+        msg += f_bytes(5, tensor_proto(name + "_t", value)) + \
+            f_varint(20, A_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            msg += b"".join(_tag(7, 5) + struct.pack("<f", v)
+                            for v in value) + f_varint(20, A_FLOATS)
+        else:
+            # AttributeProto.ints is repeated int64 — onnx emits unpacked
+            msg += b"".join(f_varint(8, int(v)) for v in value) + \
+                f_varint(20, A_INTS)
+    else:
+        raise TypeError(f"attribute {name}: {type(value)}")
+    return msg
+
+
+def node(op_type: str, inputs: List[str], outputs: List[str],
+         name: str = "", attrs: Dict = None) -> bytes:
+    msg = b"".join(f_string(1, i) for i in inputs)
+    msg += b"".join(f_string(2, o) for o in outputs)
+    if name:
+        msg += f_string(3, name)
+    msg += f_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        msg += f_bytes(5, attribute(k, v))
+    return msg
+
+
+def value_info(name: str, elem_type: int, shape) -> bytes:
+    dims = b"".join(f_bytes(1, f_varint(1, int(d))) for d in shape)
+    tshape = f_bytes(2, dims) if shape is not None else b""
+    ttype = f_bytes(1, f_varint(1, elem_type) + tshape)   # tensor_type
+    return f_string(1, name) + f_bytes(2, ttype)
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    msg = b"".join(f_bytes(1, n) for n in nodes)
+    msg += f_string(2, name)
+    msg += b"".join(f_bytes(5, t) for t in initializers)
+    msg += b"".join(f_bytes(11, i) for i in inputs)
+    msg += b"".join(f_bytes(12, o) for o in outputs)
+    return msg
+
+
+def model(graph_msg: bytes, opset_version: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    opset = f_string(1, "") + f_varint(2, opset_version)
+    msg = f_varint(1, 8)                               # ir_version 8
+    msg += f_string(2, producer)
+    msg += f_bytes(7, graph_msg)
+    msg += f_bytes(8, opset)
+    return msg
+
+
+# -- wire-level reader -------------------------------------------------------
+
+
+def parse_message(data: bytes) -> Dict[int, List[Tuple[int, object]]]:
+    """Generic proto parse: field -> list of (wire_type, value)."""
+    fields: Dict[int, List[Tuple[int, object]]] = {}
+    i, n = 0, len(data)
+    while i < n:
+        key, i = _read_varint(data, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(data, i)
+        elif wire == 2:
+            ln, i = _read_varint(data, i)
+            v = data[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack_from("<I", data, i)[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack_from("<Q", data, i)[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append((wire, v))
+    return fields
+
+
+def _read_varint(data: bytes, i: int) -> Tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = data[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _one(fields, num, default=None):
+    vs = fields.get(num)
+    return vs[0][1] if vs else default
+
+
+def _many(fields, num):
+    return [v for _, v in fields.get(num, [])]
+
+
+def decode_tensor(data: bytes):
+    f = parse_message(data)
+    dims = [int(v) for v in _many(f, 1)]
+    dt = int(_one(f, 2, 1))
+    name = _one(f, 8, b"").decode()
+    raw = _one(f, 9)
+    if raw is not None:
+        arr = np.frombuffer(raw, ONNX_TO_NP[dt]).reshape(dims)
+    elif dt == 1:
+        arr = np.array([struct.unpack("<f", struct.pack("<I", v))[0]
+                        if w == 5 else v for w, v in f.get(4, [])],
+                       np.float32).reshape(dims)
+    elif dt in (6, 7):
+        arr = np.array([_signed(v) for v in _many(f, 7 if dt == 7 else 5)],
+                       ONNX_TO_NP[dt]).reshape(dims)
+    else:
+        raise ValueError(f"tensor {name}: no raw_data, dtype {dt}")
+    return name, arr
+
+
+def decode_attribute(data: bytes):
+    f = parse_message(data)
+    name = _one(f, 1, b"").decode()
+    atype = int(_one(f, 20, 0))
+    if atype == A_INT:
+        return name, _signed(int(_one(f, 3, 0)))
+    if atype == A_FLOAT:
+        v = _one(f, 2, 0)
+        return name, struct.unpack("<f", struct.pack("<I", v))[0] \
+            if isinstance(v, int) else float(v)
+    if atype == A_STRING:
+        return name, _one(f, 4, b"").decode()
+    if atype == A_TENSOR:
+        return name, decode_tensor(_one(f, 5))[1]
+    if atype == A_INTS:
+        out = []
+        for wire, v in f.get(8, []):
+            if wire == 2:                       # packed
+                i = 0
+                while i < len(v):
+                    x, i = _read_varint(v, i)
+                    out.append(_signed(x))
+            else:
+                out.append(_signed(v))
+        return name, out
+    if atype == A_FLOATS:
+        out = []
+        for wire, v in f.get(7, []):
+            if wire == 2:
+                out.extend(struct.unpack(f"<{len(v)//4}f", v))
+            else:
+                out.append(struct.unpack("<f", struct.pack("<I", v))[0])
+        return name, out
+    raise ValueError(f"attribute {name}: type {atype}")
+
+
+def decode_node(data: bytes):
+    f = parse_message(data)
+    return {
+        "inputs": [v.decode() for v in _many(f, 1)],
+        "outputs": [v.decode() for v in _many(f, 2)],
+        "name": _one(f, 3, b"").decode(),
+        "op_type": _one(f, 4, b"").decode(),
+        "attrs": dict(decode_attribute(v) for v in _many(f, 5)),
+    }
+
+
+def decode_value_info(data: bytes):
+    f = parse_message(data)
+    name = _one(f, 1, b"").decode()
+    elem_type, shape = 0, []
+    t = _one(f, 2)
+    if t is not None:
+        tt = _one(parse_message(t), 1)
+        if tt is not None:
+            ttf = parse_message(tt)
+            elem_type = int(_one(ttf, 1, 0))
+            sh = _one(ttf, 2)
+            if sh is not None:
+                for d in _many(parse_message(sh), 1):
+                    df = parse_message(d)
+                    shape.append(int(_one(df, 1, -1)))
+    return {"name": name, "elem_type": elem_type, "shape": shape}
+
+
+def decode_graph(data: bytes):
+    f = parse_message(data)
+    return {
+        "nodes": [decode_node(v) for v in _many(f, 1)],
+        "name": _one(f, 2, b"").decode(),
+        "initializers": dict(decode_tensor(v) for v in _many(f, 5)),
+        "inputs": [decode_value_info(v) for v in _many(f, 11)],
+        "outputs": [decode_value_info(v) for v in _many(f, 12)],
+    }
+
+
+def decode_model(data: bytes):
+    f = parse_message(data)
+    opsets = {}
+    for v in _many(f, 8):
+        of = parse_message(v)
+        opsets[_one(of, 1, b"").decode()] = int(_one(of, 2, 0))
+    return {
+        "ir_version": int(_one(f, 1, 0)),
+        "producer_name": _one(f, 2, b"").decode(),
+        "opset_import": opsets,
+        "graph": decode_graph(_one(f, 7, b"")),
+    }

@@ -1,0 +1,297 @@
+"""Reference ONNX interpreter (numpy) — validates exported models.
+
+The environment has no onnxruntime, so exported graphs are validated by
+executing them directly: parse the ModelProto (proto.py) and run the
+nodes in graph order with numpy.  Covers exactly the opset-13 ops the
+exporter emits; it is a correctness oracle, not a deployment runtime.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import numpy as np
+
+from paddle_tpu.onnx import proto
+
+try:
+    from scipy.special import erf as _erf
+except Exception:                                     # pragma: no cover
+    _erf = np.vectorize(math.erf)
+
+
+def _pool_patches(x, kernel, strides, pads, fill):
+    """(N, C, *spatial) -> windows (N, C, *out_spatial, *kernel)."""
+    nd = len(kernel)
+    pad_width = [(0, 0), (0, 0)] + [
+        (pads[i], pads[nd + i]) for i in range(nd)]
+    xp = np.pad(x, pad_width, constant_values=fill)
+    view = np.lib.stride_tricks.sliding_window_view(
+        xp, kernel, axis=tuple(range(2, 2 + nd)))
+    # subsample by stride on the out_spatial axes
+    idx = (slice(None), slice(None)) + tuple(
+        slice(None, None, s) for s in strides)
+    return view[idx]
+
+
+def _op_conv(x, w, attrs):
+    strides = attrs.get("strides", [1] * (x.ndim - 2))
+    dil = attrs.get("dilations", [1] * (x.ndim - 2))
+    group = attrs.get("group", 1)
+    nd = x.ndim - 2
+    pads = attrs.get("pads", [0] * (2 * nd))
+    # dilate the kernel explicitly
+    if any(d != 1 for d in dil):
+        kshape = list(w.shape[:2]) + [
+            (k - 1) * d + 1 for k, d in zip(w.shape[2:], dil)]
+        wd = np.zeros(kshape, w.dtype)
+        wd[(slice(None), slice(None)) + tuple(
+            slice(None, None, d) for d in dil)] = w
+        w = wd
+    co, ci_g = w.shape[0], w.shape[1]
+    out_parts = []
+    for g in range(group):
+        xg = x[:, g * ci_g * 1:, ...] if False else \
+            x[:, g * (x.shape[1] // group):(g + 1) * (x.shape[1] // group)]
+        wg = w[g * (co // group):(g + 1) * (co // group)]
+        patches = _pool_patches(xg, w.shape[2:], strides, pads, 0.0)
+        # patches: (N, Cg, *out, *k) ; wg: (Og, Cg, *k)
+        n = patches.shape[0]
+        out_sp = patches.shape[2:2 + nd]
+        pm = patches.reshape(n, xg.shape[1], int(np.prod(out_sp)),
+                             int(np.prod(w.shape[2:])))
+        pm = pm.transpose(0, 2, 1, 3).reshape(
+            n * int(np.prod(out_sp)), -1)
+        wm = wg.reshape(wg.shape[0], -1)
+        og = (pm @ wm.T).reshape(n, *out_sp, wg.shape[0])
+        og = np.moveaxis(og, -1, 1)
+        out_parts.append(og)
+    return np.concatenate(out_parts, axis=1) if group > 1 else out_parts[0]
+
+
+def _op_maxpool(x, attrs):
+    p = _pool_patches(x, attrs["kernel_shape"],
+                      attrs.get("strides", [1] * (x.ndim - 2)),
+                      attrs.get("pads", [0] * (2 * (x.ndim - 2))),
+                      -np.inf)
+    nd = len(attrs["kernel_shape"])
+    return p.max(axis=tuple(range(p.ndim - nd, p.ndim))).astype(x.dtype)
+
+
+def _op_avgpool(x, attrs):
+    if not attrs.get("count_include_pad", 0):
+        raise NotImplementedError("count_include_pad=0")
+    p = _pool_patches(x, attrs["kernel_shape"],
+                      attrs.get("strides", [1] * (x.ndim - 2)),
+                      attrs.get("pads", [0] * (2 * (x.ndim - 2))), 0.0)
+    nd = len(attrs["kernel_shape"])
+    return p.mean(axis=tuple(range(p.ndim - nd, p.ndim))).astype(x.dtype)
+
+
+def _np_broadcast_matmul(a, b):
+    return np.matmul(a, b)
+
+
+def _run_node(n, vals: Dict[str, np.ndarray]):
+    op = n["op_type"]
+    A = n["attrs"]
+    x = [vals[i] for i in n["inputs"]]
+    if op == "Identity":
+        r = x[0]
+    elif op == "Add":
+        r = x[0] + x[1]
+    elif op == "Sub":
+        r = x[0] - x[1]
+    elif op == "Mul":
+        r = x[0] * x[1]
+    elif op == "Div":
+        r = x[0] / x[1] if np.issubdtype(x[0].dtype, np.floating) \
+            else x[0] // x[1]
+    elif op == "Max":
+        r = np.maximum(x[0], x[1])
+    elif op == "Min":
+        r = np.minimum(x[0], x[1])
+    elif op == "Pow":
+        r = np.power(x[0], x[1]).astype(x[0].dtype)
+    elif op == "Mod":
+        r = np.fmod(x[0], x[1]) if A.get("fmod") else np.mod(x[0], x[1])
+    elif op == "Neg":
+        r = -x[0]
+    elif op == "Abs":
+        r = np.abs(x[0])
+    elif op == "Sign":
+        r = np.sign(x[0])
+    elif op == "Floor":
+        r = np.floor(x[0])
+    elif op == "Ceil":
+        r = np.ceil(x[0])
+    elif op == "Round":
+        r = np.round(x[0])
+    elif op == "Exp":
+        r = np.exp(x[0])
+    elif op == "Log":
+        r = np.log(x[0])
+    elif op == "Tanh":
+        r = np.tanh(x[0])
+    elif op == "Sigmoid":
+        r = 1.0 / (1.0 + np.exp(-x[0].astype(np.float64)))
+        r = r.astype(x[0].dtype)
+    elif op == "Sqrt":
+        r = np.sqrt(x[0])
+    elif op == "Reciprocal":
+        r = (1.0 / x[0]).astype(x[0].dtype)
+    elif op == "Erf":
+        r = _erf(x[0].astype(np.float64)).astype(x[0].dtype)
+    elif op in ("Sin", "Cos", "Tan", "Sinh", "Cosh"):
+        r = getattr(np, op.lower())(x[0])
+    elif op in ("Asin", "Acos", "Atan"):
+        r = getattr(np, "arc" + op.lower()[1:])(x[0])
+    elif op == "Equal":
+        r = x[0] == x[1]
+    elif op == "Less":
+        r = x[0] < x[1]
+    elif op == "LessOrEqual":
+        r = x[0] <= x[1]
+    elif op == "Greater":
+        r = x[0] > x[1]
+    elif op == "GreaterOrEqual":
+        r = x[0] >= x[1]
+    elif op == "Not":
+        r = ~x[0]
+    elif op == "And":
+        r = x[0] & x[1]
+    elif op == "Or":
+        r = x[0] | x[1]
+    elif op == "Xor":
+        r = x[0] ^ x[1]
+    elif op == "Where":
+        r = np.where(x[0], x[1], x[2])
+    elif op == "Cast":
+        r = x[0].astype(proto.ONNX_TO_NP[A["to"]])
+    elif op == "Clip":
+        r = np.clip(x[0], x[1] if len(x) > 1 else None,
+                    x[2] if len(x) > 2 else None)
+    elif op == "Reshape":
+        r = x[0].reshape([int(d) for d in x[1]])
+    elif op == "Transpose":
+        r = np.transpose(x[0], A["perm"])
+    elif op == "Squeeze":
+        r = np.squeeze(x[0], axis=tuple(int(a) for a in x[1]))
+    elif op == "Expand":
+        r = x[0] * np.ones([int(d) for d in x[1]], x[0].dtype) \
+            if x[0].dtype != np.bool_ else \
+            np.broadcast_to(x[0], [int(d) for d in x[1]]).copy()
+    elif op == "Concat":
+        r = np.concatenate(x, axis=A["axis"])
+    elif op == "Pad":
+        pads = [int(p) for p in x[1]]
+        nd = len(pads) // 2
+        pw = [(pads[i], pads[nd + i]) for i in range(nd)]
+        cv = x[2].item() if len(x) > 2 else 0
+        r = np.pad(x[0], pw, constant_values=cv)
+    elif op == "Slice":
+        starts = [int(v) for v in x[1]]
+        ends = [int(v) for v in x[2]]
+        axes = [int(v) for v in x[3]] if len(x) > 3 else \
+            list(range(len(starts)))
+        steps = [int(v) for v in x[4]] if len(x) > 4 else [1] * len(starts)
+        sl = [slice(None)] * x[0].ndim
+        for a, s, e, st in zip(axes, starts, ends, steps):
+            sl[a] = slice(s, e, st)
+        r = x[0][tuple(sl)]
+    elif op == "MatMul":
+        r = _np_broadcast_matmul(x[0], x[1])
+    elif op == "Gemm":
+        a = x[0].T if A.get("transA") else x[0]
+        b = x[1].T if A.get("transB") else x[1]
+        r = A.get("alpha", 1.0) * (a @ b)
+        if len(x) > 2:
+            r = r + A.get("beta", 1.0) * x[2]
+    elif op == "Conv":
+        r = _op_conv(x[0], x[1], A).astype(x[0].dtype)
+    elif op == "MaxPool":
+        r = _op_maxpool(x[0], A)
+    elif op == "AveragePool":
+        r = _op_avgpool(x[0], A)
+    elif op == "ReduceSum":
+        axes = tuple(int(a) for a in x[1]) if len(x) > 1 else None
+        r = x[0].sum(axis=axes, keepdims=bool(A.get("keepdims", 1)))
+    elif op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+        fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+              "ReduceProd": np.prod, "ReduceMean": np.mean}[op]
+        axes = tuple(A["axes"]) if "axes" in A else None
+        r = fn(x[0], axis=axes, keepdims=bool(A.get("keepdims", 1)))
+    elif op in ("ArgMax", "ArgMin"):
+        fn = np.argmax if op == "ArgMax" else np.argmin
+        r = fn(x[0], axis=A.get("axis", 0))
+        if A.get("keepdims", 1):
+            r = np.expand_dims(r, A.get("axis", 0))
+        r = r.astype(np.int64)
+    elif op == "Gather":
+        r = np.take(x[0], x[1].astype(np.int64), axis=A.get("axis", 0))
+    elif op == "CumSum":
+        ax = int(x[1])
+        r = np.flip(np.cumsum(np.flip(x[0], ax), axis=ax), ax) \
+            if A.get("reverse") else np.cumsum(x[0], axis=ax)
+        r = r.astype(x[0].dtype)
+    elif op == "Softmax":
+        e = np.exp(x[0] - x[0].max(axis=A.get("axis", -1), keepdims=True))
+        r = e / e.sum(axis=A.get("axis", -1), keepdims=True)
+    else:
+        raise NotImplementedError(f"reference runtime: op {op}")
+    outs = n["outputs"]
+    vals[outs[0]] = np.asarray(r)
+
+
+def load_model(path: str) -> dict:
+    with open(path, "rb") as f:
+        return proto.decode_model(f.read())
+
+
+def run_model(model_or_path, inputs) -> list:
+    """Execute the graph; ``inputs``: list of arrays (graph-input order)
+    or dict name->array.  Returns output arrays in graph order."""
+    m = load_model(model_or_path) if isinstance(model_or_path, str) \
+        else model_or_path
+    g = m["graph"]
+    vals: Dict[str, np.ndarray] = dict(g["initializers"])
+    if isinstance(inputs, dict):
+        vals.update({k: np.asarray(v) for k, v in inputs.items()})
+    else:
+        for vi, arr in zip(g["inputs"], inputs):
+            vals[vi["name"]] = np.asarray(arr)
+    for n in g["nodes"]:
+        _run_node(n, vals)
+    return [vals[o["name"]] for o in g["outputs"]]
+
+
+def check_model(model_or_path) -> dict:
+    """Structural validation: opset present, graph connectivity (every
+    node input is a graph input, an initializer, or an earlier node's
+    output), single-assignment, outputs produced.  Raises ValueError on
+    violation; returns summary stats."""
+    m = load_model(model_or_path) if isinstance(model_or_path, str) \
+        else model_or_path
+    if not m["opset_import"]:
+        raise ValueError("no opset_import")
+    g = m["graph"]
+    known = set(g["initializers"]) | {i["name"] for i in g["inputs"]}
+    for n in g["nodes"]:
+        if not n["op_type"]:
+            raise ValueError(f"node {n['name']}: empty op_type")
+        for i in n["inputs"]:
+            if i and i not in known:
+                raise ValueError(
+                    f"node {n['name']} ({n['op_type']}): input {i!r} "
+                    "is not produced before use")
+        for o in n["outputs"]:
+            if o in known:
+                raise ValueError(f"{o!r} assigned twice")
+            known.add(o)
+    for o in g["outputs"]:
+        if o["name"] not in known:
+            raise ValueError(f"graph output {o['name']!r} never produced")
+    return {"nodes": len(g["nodes"]), "initializers":
+            len(g["initializers"]), "inputs": len(g["inputs"]),
+            "outputs": len(g["outputs"]),
+            "opset": m["opset_import"].get("", None)}

@@ -1,0 +1,98 @@
+"""API surface freeze + compat-alias introspection.
+
+Reference roles: paddle/fluid/API.spec diffed in CI (tools/
+print_signatures.py, tools/check_api_compatible.py) — public signature
+drift must be deliberate, not accidental.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_api_spec_frozen():
+    import print_signatures
+    with open(os.path.join(REPO, "API.spec")) as f:
+        frozen = f.read()
+    current = print_signatures.render()
+    if frozen != current:
+        import difflib
+        diff = "\n".join(list(difflib.unified_diff(
+            frozen.splitlines(), current.splitlines(),
+            fromfile="API.spec", tofile="current", lineterm=""))[:60])
+        pytest.fail(
+            "public API surface drifted from API.spec — if intentional, "
+            "regenerate with `python tools/print_signatures.py --update`"
+            f"\n{diff}")
+
+
+def test_spec_has_substantial_coverage():
+    with open(os.path.join(REPO, "API.spec")) as f:
+        n = len(f.read().splitlines())
+    assert n > 2000, f"API.spec suspiciously small ({n} entries)"
+
+
+def test_check_cli_green():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "print_signatures.py"),
+         "--check"], capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+# -- fluid-era alias surface -------------------------------------------------
+
+FLUID_ALIASES = [
+    "LoDTensor", "VarBase", "LoDTensorArray", "commit", "full_version",
+    "elementwise_add", "elementwise_sub", "elementwise_div",
+    "elementwise_floordiv", "elementwise_mod", "elementwise_pow",
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
+    "crop_tensor", "fill_constant", "broadcast_shape", "rank", "shape",
+    "has_nan", "has_inf",
+]
+
+
+def test_fluid_aliases_present_and_callable():
+    for name in FLUID_ALIASES:
+        assert hasattr(paddle, name), f"fluid alias paddle.{name} missing"
+
+
+def test_fluid_alias_behavior():
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    np.testing.assert_allclose(paddle.elementwise_add(a, b).numpy(), [4, 6])
+    np.testing.assert_allclose(float(paddle.reduce_sum(a)), 3.0)
+    fc = paddle.fill_constant([2, 2], "float32", 7.0)
+    np.testing.assert_allclose(fc.numpy(), np.full((2, 2), 7.0))
+    assert int(paddle.rank(fc)) == 2
+    np.testing.assert_array_equal(paddle.shape(fc).numpy(), [2, 2])
+    assert not bool(paddle.has_nan(a))
+    assert paddle.broadcast_shape([2, 1], [1, 3]) == [2, 3]
+    assert isinstance(a, paddle.LoDTensor)       # LoDTensor is Tensor
+
+
+# -- Place introspection -----------------------------------------------------
+
+def test_place_introspection():
+    # CUDAPlace aliases TPUPlace for porting; introspection must keep
+    # working the way 2.0-era scripts use it
+    p = paddle.CUDAPlace(0)
+    assert isinstance(p, paddle.TPUPlace)
+    assert "0" in repr(p)
+    cpu = paddle.CPUPlace()
+    assert not isinstance(cpu, paddle.TPUPlace)
+    t = paddle.to_tensor(np.zeros((1,), np.float32))
+    assert t.place is not None
+    dev = paddle.get_device()
+    assert dev.split(":")[0] in ("cpu", "tpu", "gpu")
+
+
+def test_is_compiled_introspection():
+    assert isinstance(paddle.is_compiled_with_cuda(), bool)
