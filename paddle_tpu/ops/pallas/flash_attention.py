@@ -142,6 +142,16 @@ def _pick_block(pref: int, seq: int) -> int:
     return max(b, _MIN_BLOCK)
 
 
+def _blocks_for(sq, sk, d, dtype, causal, biased):
+    """(block_q, block_k) — the measured autotune cache first (keyed on
+    shape/dtype/mask class), else the BLOCK_Q/K heuristic; either way
+    halved until it divides the sequence."""
+    from paddle_tpu.ops.pallas import autotune
+    hit = autotune.lookup(sq, sk, d, str(dtype), causal, biased)
+    bq, bk = hit if hit else (BLOCK_Q, BLOCK_K)
+    return _pick_block(bq, sq), _pick_block(bk, sk)
+
+
 def _bias_g_map(bb, hb, h):
     """bh (= b*h + head) → block index into the folded (Bb*Hb, ...) bias."""
     if bb == 1 and hb == 1:
@@ -275,11 +285,11 @@ def _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal):
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = _pick_block(BLOCK_Q, sq)
-    block_k = _pick_block(BLOCK_K, sk)
-    n_kb = sk // block_k
     has_bias = bias is not None
     has_segs = qseg is not None
+    block_q, block_k = _blocks_for(sq, sk, d, q.dtype, causal,
+                                   has_bias or has_segs)
+    n_kb = sk // block_k
     if has_bias:
         bb, hb, sqb, _ = bias.shape
         g_map = _bias_g_map(bb, hb, h)
@@ -499,13 +509,14 @@ def _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, do, scale, causal,
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = _pick_block(BLOCK_Q, sq)
-    block_k = _pick_block(BLOCK_K, sk)
+    has_bias = bias is not None
+    has_segs = qseg is not None
+    block_q, block_k = _blocks_for(sq, sk, d, q.dtype, causal,
+                                   has_bias or has_segs)
     n_qb = sq // block_q
     n_kb = sk // block_k
     off = sk - sq
-    has_bias = bias is not None
-    has_segs = qseg is not None
+
     if has_bias:
         bb, hb, sqb, _ = bias.shape
         g_map = _bias_g_map(bb, hb, h)

@@ -1,0 +1,61 @@
+"""Flash block autotune cache: lookup/record/force, kernel integration."""
+import json
+
+import pytest
+
+from paddle_tpu.ops.pallas import autotune
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setattr(autotune, "_PATH", str(tmp_path / "blocks.json"))
+    monkeypatch.setattr(autotune, "_cache", None)
+    yield
+    autotune._cache = None
+
+
+def test_lookup_miss_then_record():
+    assert autotune.lookup(8192, 8192, 128, "bfloat16", True, False) is None
+    autotune.record(8192, 8192, 128, "bfloat16", True, False, (256, 512))
+    assert autotune.lookup(8192, 8192, 128, "bfloat16", True, False) == \
+        (256, 512)
+    # persisted
+    with open(autotune._PATH) as f:
+        data = json.load(f)
+    assert data["8192x8192:d128:bfloat16:causal:nobias"] == [256, 512]
+
+
+def test_reload_from_disk():
+    autotune.record(1024, 1024, 64, "float32", False, True, (512, 256))
+    autotune._cache = None                       # force reload
+    assert autotune.lookup(1024, 1024, 64, "float32", False, True) == \
+        (512, 256)
+
+
+def test_force_blocks_overrides():
+    autotune.record(2048, 2048, 128, "bfloat16", True, False, (512, 512))
+    with autotune.force_blocks(256, 256):
+        assert autotune.lookup(2048, 2048, 128, "bfloat16", True,
+                               False) == (256, 256)
+    assert autotune.lookup(2048, 2048, 128, "bfloat16", True, False) == \
+        (512, 512)
+
+
+def test_blocks_for_uses_cache_and_divisibility():
+    autotune.record(4096, 4096, 128, "bfloat16", True, False, (1024, 512))
+    assert fa._blocks_for(4096, 4096, 128, "bfloat16", True, False) == \
+        (1024, 512)
+    # miss -> heuristic, halved to divide the sequence
+    bq, bk = fa._blocks_for(384, 384, 64, "float32", False, False)
+    assert 384 % bq == 0 and 384 % bk == 0
+    # cached preference halved when it does not divide this sequence
+    autotune.record(768, 768, 64, "float32", False, False, (512, 512))
+    bq, bk = fa._blocks_for(768, 768, 64, "float32", False, False)
+    assert 768 % bq == 0 and 768 % bk == 0
+
+
+def test_distinct_mask_class_keys():
+    autotune.record(2048, 2048, 64, "bfloat16", False, True, (256, 512))
+    assert autotune.lookup(2048, 2048, 64, "bfloat16", False,
+                           False) is None

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Measure flash-attention block sizes on the attached TPU and persist
+the winners into paddle_tpu/ops/pallas/flash_blocks.json.
+
+    python tools/flash_autotune.py                  # bench/model configs
+    python tools/flash_autotune.py --sq 4096 --sk 4096 --d 128 --causal
+
+The shipped json is the measured cache the kernels consult at trace
+time; re-run this on new hardware generations.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (sq, sk, d, dtype, causal, biased) — the bench + model-zoo kernel shapes
+DEFAULT_CONFIGS = [
+    (1024, 1024, 64, "bfloat16", True, False),    # GPT-2 345M
+    (2048, 2048, 128, "bfloat16", True, False),   # longseq ref leg
+    (8192, 8192, 128, "bfloat16", True, False),   # longseq 8k leg
+    (2048, 2048, 64, "bfloat16", False, True),    # masked BERT-class
+    (8192, 8192, 128, "bfloat16", True, True),    # packed longseq
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sq", type=int)
+    ap.add_argument("--sk", type=int)
+    ap.add_argument("--d", type=int, default=128)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--causal", action="store_true")
+    ap.add_argument("--biased", action="store_true")
+    ap.add_argument("--iters", type=int, default=3)
+    a = ap.parse_args(argv)
+
+    from paddle_tpu.ops.pallas import autotune
+    from paddle_tpu.ops.pallas.flash_attention import _backend_is_tpu
+    if not _backend_is_tpu():
+        print("no TPU attached — autotune must run on real hardware",
+              file=sys.stderr)
+        return 1
+
+    configs = [(a.sq, a.sk, a.d, a.dtype, a.causal, a.biased)] \
+        if a.sq else DEFAULT_CONFIGS
+    for sq, sk, d, dt, causal, biased in configs:
+        print(f"config sq={sq} sk={sk} d={d} {dt} "
+              f"causal={causal} biased={biased}")
+        out = autotune.measure(sq, sk, d, dt, causal, biased,
+                               iters=a.iters, verbose=True)
+        if out is None:
+            print("  no viable candidate")
+        else:
+            best, _ = out
+            print(f"  -> {best}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
