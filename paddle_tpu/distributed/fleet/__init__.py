@@ -271,5 +271,7 @@ def save_persistables(executor=None, dirname=None, main_program=None,
                       **kwargs):
     raise NotImplementedError("use paddle_tpu.save(model.state_dict(), ...)")
 
+from paddle_tpu.distributed.fleet import metrics  # noqa: F401,E402
 from paddle_tpu.distributed.fleet import utils  # noqa: F401,E402
 from paddle_tpu.distributed.fleet.utils import recompute  # noqa: F401,E402
+from paddle_tpu.distributed.fleet.utils import fs  # noqa: F401,E402

@@ -80,7 +80,10 @@ class AdaptiveAvgPool1D(_AdaptivePoolNd):
 
 class AdaptiveAvgPool2D(_AdaptivePoolNd):
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(
+            x, self.output_size,
+            **{k: v for k, v in self.kwargs.items()
+               if k in ("data_format",)})
 
 
 class AdaptiveAvgPool3D(_AdaptivePoolNd):

@@ -69,3 +69,38 @@ class Executor:
 from paddle_tpu.static import nn  # noqa: E402,F401
 from paddle_tpu.static.nn import (  # noqa: E402,F401
     case, cond, switch_case, while_loop)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Deploy-artifact export under the 2.0 static API name (reference:
+    python/paddle/static/io.py save_inference_model -> Program pruning +
+    serialization).  TPU-native: the model is a Layer whose jit capture
+    IS the pruned program — ``fetch_vars`` must be the Layer (or carry
+    ``.model``); ``feed_vars`` supply the InputSpecs.  Produces the same
+    artifact as ``paddle_tpu.jit.save`` (StableHLO + params), loadable by
+    ``paddle_tpu.jit.load`` / ``inference.create_predictor``."""
+    from paddle_tpu import jit
+    from paddle_tpu.nn.layer.layers import Layer
+    layer = fetch_vars if isinstance(fetch_vars, Layer) else \
+        getattr(fetch_vars, "model", None)
+    if layer is None:
+        raise TypeError(
+            "save_inference_model(fetch_vars=...) must be the Layer to "
+            "export (there is no Program to prune in paddle_tpu; the "
+            "Layer's traced forward plays that role)")
+    specs = list(feed_vars) if feed_vars is not None else None
+    jit.save(layer, path_prefix, input_spec=specs)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Counterpart of save_inference_model: returns the TranslatedLayer
+    (callable like the reference's (program, feeds, fetches) triple —
+    call it with input Tensors to get the fetch outputs)."""
+    from paddle_tpu import jit
+    return jit.load(path_prefix)
+
+
+__all__ += ["save_inference_model", "load_inference_model", "Program",
+            "Executor"]

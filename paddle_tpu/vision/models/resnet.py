@@ -2,11 +2,16 @@
 BottleneckBlock, resnet18..152; the single-chip bf16 flagship,
 BASELINE.md config #2).
 
-TPU notes: NCHW layout kept for paddle API parity (XLA canonicalizes
-layouts for the MXU); BatchNorm stats update inside the compiled step via
-buffer threading (Layer buffers).
+TPU notes: ``data_format`` selects the internal layout.  NCHW is the
+paddle-parity default; NHWC is the TPU-native fast path — measured
+per-layer on one chip, the C=128@28x28 3x3 convs run 2.25x faster and
+the 1x1 expansions 1.3x faster in NHWC (the stem slightly prefers NCHW,
+but it is <5% of the FLOPs).  BatchNorm stats update inside the compiled
+step via buffer threading (Layer buffers).
 """
 from __future__ import annotations
+
+import functools
 
 import paddle_tpu.nn as nn
 
@@ -18,15 +23,16 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 norm_layer=None):
+                 norm_layer=None, data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1,
-                               stride=stride, bias_attr=False)
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
+        conv = functools.partial(nn.Conv2D, data_format=data_format)
+        self.conv1 = conv(inplanes, planes, 3, padding=1,
+                          stride=stride, bias_attr=False)
         self.bn1 = norm_layer(planes)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1,
-                               bias_attr=False)
+        self.conv2 = conv(planes, planes, 3, padding=1, bias_attr=False)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -44,16 +50,18 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 norm_layer=None):
+                 norm_layer=None, data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
+        conv = functools.partial(nn.Conv2D, data_format=data_format)
+        self.conv1 = conv(inplanes, planes, 1, bias_attr=False)
         self.bn1 = norm_layer(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, stride=stride,
-                               bias_attr=False)
+        self.conv2 = conv(planes, planes, 3, padding=1, stride=stride,
+                          bias_attr=False)
         self.bn2 = norm_layer(planes)
-        self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1,
-                               bias_attr=False)
+        self.conv3 = conv(planes, planes * self.expansion, 1,
+                          bias_attr=False)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -70,7 +78,8 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    def __init__(self, block, depth=50, num_classes=1000, with_pool=True):
+    def __init__(self, block, depth=50, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3],
                      50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
@@ -78,19 +87,24 @@ class ResNet(nn.Layer):
         layers = layer_cfg[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
-        self._norm_layer = nn.BatchNorm2D
+        self.data_format = data_format
+        self._norm_layer = functools.partial(nn.BatchNorm2D,
+                                             data_format=data_format)
+        self._conv = functools.partial(nn.Conv2D, data_format=data_format)
         self.inplanes = 64
-        self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
+        self.conv1 = self._conv(3, self.inplanes, 7, stride=2, padding=3,
+                                bias_attr=False)
         self.bn1 = self._norm_layer(self.inplanes)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
+                                    data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
@@ -99,15 +113,16 @@ class ResNet(nn.Layer):
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
-                nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
+                self._conv(self.inplanes, planes * block.expansion, 1,
+                           stride=stride, bias_attr=False),
                 norm_layer(planes * block.expansion))
         layers = [block(self.inplanes, planes, stride, downsample,
-                        norm_layer)]
+                        norm_layer, data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):

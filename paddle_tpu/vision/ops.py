@@ -41,25 +41,7 @@ def nms(boxes, scores=None, iou_threshold=0.3, top_k: int = -1):
         s = np.arange(len(b))[::-1].astype(np.float32)
     else:
         s = np.asarray(_unwrap(scores))
-    order = np.argsort(-s)
-    keep = []
-    while order.size:
-        i = order[0]
-        keep.append(i)
-        if top_k > 0 and len(keep) >= top_k:
-            break
-        rest = order[1:]
-        if rest.size == 0:
-            break
-        lt = np.maximum(b[i, :2], b[rest, :2])
-        rb = np.minimum(b[i, 2:], b[rest, 2:])
-        wh = np.clip(rb - lt, 0, None)
-        inter = wh[:, 0] * wh[:, 1]
-        a_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
-        a_r = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
-        iou = inter / np.maximum(a_i + a_r - inter, 1e-10)
-        order = rest[iou <= iou_threshold]
-    return Tensor(np.asarray(keep, np.int64))
+    return Tensor(_nms_keep(b, s, iou_threshold, top_k=top_k))
 
 
 def _roi_image_index(boxes_num, n_rois):
@@ -272,3 +254,411 @@ def box_coder(prior_box, prior_box_var, target_box,
         return jnp.stack([dcx - dw / 2, dcy - dh / 2,
                           dcx + dw / 2, dcy + dh / 2], 1)
     return apply1(f, prior_box, prior_box_var, target_box, name="box_coder")
+
+
+# ---------------------------------------------------------------------------
+# round-3 detection tail (reference: operators/detection/*, ~50 ops; this
+# brings the jax-expressible + host-side algorithmic core to ~20)
+# ---------------------------------------------------------------------------
+
+
+def iou_similarity(x, y, box_normalized=True):
+    """(N,4)x(M,4) -> (N,M) IoU (reference:
+    operators/detection/iou_similarity_op).  Unnormalized boxes count
+    the closing pixel (+1 on extents), matching the reference."""
+    off = 0.0 if box_normalized else 1.0
+
+    def f(b1, b2):
+        a1 = (b1[:, 2] - b1[:, 0] + off) * (b1[:, 3] - b1[:, 1] + off)
+        a2 = (b2[:, 2] - b2[:, 0] + off) * (b2[:, 3] - b2[:, 1] + off)
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt + off, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(a1[:, None] + a2[None] - inter, 1e-10)
+    return apply1(f, x, y, name="iou_similarity")
+
+
+def box_clip(input, im_shape):
+    """Clip (..,4) xyxy boxes into [0, w-1] x [0, h-1] (reference:
+    operators/detection/box_clip_op; im_shape = (h, w) per image or a
+    single pair for the whole batch)."""
+    def f(b, s):
+        h, w = s[..., 0], s[..., 1]
+        x1 = jnp.clip(b[..., 0], 0, w - 1)
+        y1 = jnp.clip(b[..., 1], 0, h - 1)
+        x2 = jnp.clip(b[..., 2], 0, w - 1)
+        y2 = jnp.clip(b[..., 3], 0, h - 1)
+        return jnp.stack([x1, y1, x2, y2], -1)
+    return apply1(f, input, im_shape, nondiff=(1,), name="box_clip")
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances=None,
+                     stride=(16.0, 16.0), offset=0.5):
+    """Per-position anchors over an (N,C,H,W) feature map (reference:
+    operators/detection/anchor_generator_op).  Returns
+    (anchors (H,W,A,4), variances (H,W,A,4))."""
+    arr = _unwrap(input)
+    H, W = int(arr.shape[-2]), int(arr.shape[-1])
+    sw, sh = float(stride[0]), float(stride[1])
+    variances = list(variances or [0.1, 0.1, 0.2, 0.2])
+    ws, hs = [], []
+    for r in aspect_ratios:
+        for s in anchor_sizes:
+            ws.append(s / np.sqrt(r))
+            hs.append(s * np.sqrt(r))
+    ws = np.asarray(ws, np.float32)
+    hs = np.asarray(hs, np.float32)
+    cx = (np.arange(W, dtype=np.float32) + offset) * sw
+    cy = (np.arange(H, dtype=np.float32) + offset) * sh
+    cxg, cyg = np.meshgrid(cx, cy)                       # (H, W)
+    boxes = np.stack([
+        cxg[..., None] - 0.5 * ws, cyg[..., None] - 0.5 * hs,
+        cxg[..., None] + 0.5 * ws, cyg[..., None] + 0.5 * hs], -1)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          boxes.shape).copy()
+    return Tensor(boxes.astype(np.float32)), Tensor(var)
+
+
+def density_prior_box(input, image=None, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variances=None, clip=False,
+                      steps=(0.0, 0.0), offset=0.5):
+    """SSD density prior boxes (reference:
+    operators/detection/density_prior_box_op): each (fixed_size,
+    density) pair lays density^2 shifted boxes per cell for every
+    fixed_ratio."""
+    arr = _unwrap(input)
+    H, W = int(arr.shape[-2]), int(arr.shape[-1])
+    if image is not None:
+        img = _unwrap(image)
+        IH, IW = int(img.shape[-2]), int(img.shape[-1])
+    else:
+        IH = IW = None
+    step_w = float(steps[0]) or (IW / W if IW else 1.0)
+    step_h = float(steps[1]) or (IH / H if IH else 1.0)
+    variances = list(variances or [0.1, 0.1, 0.2, 0.2])
+    # per-cell offsets (dcx, dcy, bw, bh) for every (size, density,
+    # ratio, shift) combo, then broadcast against the cell-center grid —
+    # same meshgrid formulation as anchor_generator (a python loop here
+    # is millions of iterations on an SSD-sized map)
+    dcx, dcy, bws, bhs = [], [], [], []
+    for size, dens in zip(fixed_sizes, densities):
+        shift = size / dens
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            d = np.arange(dens, dtype=np.float32)
+            sx = (-size / 2 + shift / 2 + d * shift)
+            gx, gy = np.meshgrid(sx, sx)               # (dens, dens)
+            dcx.extend(gx.ravel())
+            dcy.extend(gy.ravel())
+            bws.extend([bw] * dens * dens)
+            bhs.extend([bh] * dens * dens)
+    dcx = np.asarray(dcx, np.float32)
+    dcy = np.asarray(dcy, np.float32)
+    bws = np.asarray(bws, np.float32)
+    bhs = np.asarray(bhs, np.float32)
+    ccx = ((np.arange(W, dtype=np.float32) + offset) * step_w)[None, :]
+    ccy = ((np.arange(H, dtype=np.float32) + offset) * step_h)[:, None]
+    A = len(dcx)
+    scx = np.broadcast_to(ccx[..., None] + dcx, (H, W, A))
+    scy = np.broadcast_to(ccy[..., None] + dcy, (H, W, A))
+    boxes = np.stack([scx - bws / 2, scy - bhs / 2,
+                      scx + bws / 2, scy + bhs / 2], -1).astype(np.float32)
+    if IW:
+        boxes[..., 0::2] /= IW
+        boxes[..., 1::2] /= IH
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          boxes.shape).copy()
+    return Tensor(boxes), Tensor(var)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5):
+    """Greedy bipartite matching over a (N,M) distance/similarity matrix
+    (reference: operators/detection/bipartite_match_op).  Returns
+    (match_indices (M,) int64 with -1 for unmatched columns,
+    match_dist (M,))."""
+    d = np.array(np.asarray(_unwrap(dist_matrix)), np.float32, copy=True)
+    n, m = d.shape
+    indices = np.full((m,), -1, np.int64)
+    dist = np.zeros((m,), np.float32)
+    work = d.copy()
+    for _ in range(min(n, m)):
+        i, j = np.unravel_index(np.argmax(work), work.shape)
+        if work[i, j] <= 0:
+            break
+        indices[j] = i
+        dist[j] = work[i, j]
+        work[i, :] = -1.0
+        work[:, j] = -1.0
+    if match_type == "per_prediction":
+        # unmatched columns fall back to their row argmax if above the
+        # threshold (SSD matching stage 2)
+        for j in range(m):
+            if indices[j] == -1:
+                i = int(np.argmax(d[:, j]))
+                if d[i, j] >= dist_threshold:
+                    indices[j] = i
+                    dist[j] = d[i, j]
+    return Tensor(indices), Tensor(dist)
+
+
+def _nms_keep(boxes, scores, thresh, top_k=-1):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if top_k > 0 and len(keep) >= top_k:
+            break
+        rest = order[1:]
+        if not rest.size:
+            break
+        lt = np.maximum(boxes[i, :2], boxes[rest, :2])
+        rb = np.minimum(boxes[i, 2:], boxes[rest, 2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        ai = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        ar = (boxes[rest, 2] - boxes[rest, 0]) * \
+            (boxes[rest, 3] - boxes[rest, 1])
+        iou = inter / np.maximum(ai + ar - inter, 1e-10)
+        order = rest[iou <= thresh]
+    return np.asarray(keep, np.int64)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   background_label=-1, return_index=False):
+    """Per-class NMS + cross-class top-k (reference:
+    operators/detection/multiclass_nms_op).  ``bboxes`` (N, M, 4),
+    ``scores`` (N, C, M).  Returns (out (K, 6) [label, score, x1..y2],
+    rois_num (N,)) and optionally flat indices."""
+    b = np.asarray(_unwrap(bboxes))
+    s = np.asarray(_unwrap(scores))
+    N, C, M = s.shape
+    outs, nums, idxs = [], [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            mask = s[n, c] > score_threshold
+            if not mask.any():
+                continue
+            cand = np.nonzero(mask)[0]
+            cs = s[n, c, cand]
+            if nms_top_k > 0 and len(cand) > nms_top_k:
+                top = np.argsort(-cs)[:nms_top_k]
+                cand, cs = cand[top], cs[top]
+            keep = _nms_keep(b[n, cand], cs, nms_threshold)
+            for k in keep:
+                dets.append((c, cs[k], *b[n, cand[k]], n * M + cand[k]))
+        dets.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        nums.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            idxs.append(d[6])
+    out = np.asarray(outs, np.float32).reshape(-1, 6)
+    res = (Tensor(out), Tensor(np.asarray(nums, np.int32)))
+    if return_index:
+        res = res + (Tensor(np.asarray(idxs, np.int64)),)
+    return res
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=-1):
+    """Matrix (decay) NMS from SOLOv2 (reference:
+    operators/detection/matrix_nms_op): scores decay by the min over
+    higher-ranked same-class overlaps — no serial suppression loop, so
+    unlike greedy NMS the whole thing is one dense computation.
+    Returns (out (K,6), rois_num (N,), index (K,))."""
+    b = np.asarray(_unwrap(bboxes))
+    s = np.asarray(_unwrap(scores))
+    N, C, M = s.shape
+    outs, nums, idxs = [], [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            mask = s[n, c] > score_threshold
+            if not mask.any():
+                continue
+            cand = np.nonzero(mask)[0]
+            cs = s[n, c, cand]
+            order = np.argsort(-cs)
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            cand, cs = cand[order], cs[order]
+            bb = b[n, cand]
+            lt = np.maximum(bb[:, None, :2], bb[None, :, :2])
+            rb = np.minimum(bb[:, None, 2:], bb[None, :, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[..., 0] * wh[..., 1]
+            area = (bb[:, 2] - bb[:, 0]) * (bb[:, 3] - bb[:, 1])
+            iou = inter / np.maximum(area[:, None] + area[None] - inter,
+                                     1e-10)
+            iou = np.triu(iou, k=1)            # i<j: higher-ranked i
+            max_iou = iou.max(axis=0)          # per box: its own worst
+            # decay_ij = f(iou_ij) / f(compensate_i): the SUPPRESSOR i's
+            # own max overlap compensates (SOLOv2 eq. 5) — indexing by
+            # the suppressed column would cancel to exactly 1
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - max_iou[:, None] ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - max_iou[:, None],
+                                               1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0,
+                             decay, np.inf)
+            decay = decay.min(axis=0)
+            decay[0] = 1.0
+            ds = cs * np.minimum(decay, 1.0)
+            for k in range(len(cand)):
+                if ds[k] > post_threshold:
+                    dets.append((c, ds[k], *bb[k], n * M + cand[k]))
+        dets.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        nums.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            idxs.append(d[6])
+    return (Tensor(np.asarray(outs, np.float32).reshape(-1, 6)),
+            Tensor(np.asarray(nums, np.int32)),
+            Tensor(np.asarray(idxs, np.int64)))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None):
+    """Route RoIs to FPN levels by scale (reference:
+    operators/detection/distribute_fpn_proposals_op):
+    level = floor(refer_level + log2(sqrt(area)/refer_scale)).  Returns
+    (multi_rois per level, restore_index, rois_num per level)."""
+    r = np.asarray(_unwrap(fpn_rois))
+    area = np.clip((r[:, 2] - r[:, 0]) * (r[:, 3] - r[:, 1]), 1e-12, None)
+    lvl = np.floor(refer_level + np.log2(np.sqrt(area) / refer_scale))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, nums, order = [], [], []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        multi.append(Tensor(r[sel]))
+        nums.append(len(sel))
+        order.extend(sel.tolist())
+    restore = np.empty(len(r), np.int64)
+    restore[np.asarray(order, np.int64)] = np.arange(len(r))
+    return multi, Tensor(restore), Tensor(np.asarray(nums, np.int32))
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n):
+    """Merge per-level RoIs and keep the global top-n by score
+    (reference: operators/detection/collect_fpn_proposals_op)."""
+    rois = np.concatenate([np.asarray(_unwrap(r)) for r in multi_rois], 0)
+    scores = np.concatenate(
+        [np.asarray(_unwrap(s)).reshape(-1) for s in multi_scores], 0)
+    top = np.argsort(-scores)[:post_nms_top_n]
+    return Tensor(rois[top])
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False):
+    """RPN proposal generation (reference:
+    operators/detection/generate_proposals_v2_op): per image — top
+    pre-NMS scores, delta-decode vs anchors, clip to image, drop tiny
+    boxes, greedy NMS, keep post-NMS top-n.  ``scores`` (N,A,H,W),
+    ``bbox_deltas`` (N,4A,H,W), ``anchors``/``variances`` (H,W,A,4)."""
+    sc = np.asarray(_unwrap(scores))
+    bd = np.asarray(_unwrap(bbox_deltas))
+    ims = np.asarray(_unwrap(im_shape))
+    an = np.asarray(_unwrap(anchors)).reshape(-1, 4)
+    va = np.asarray(_unwrap(variances)).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    all_rois, all_scores, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # (H*W*A)
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        # decode (decode_center_size with variances)
+        pw = a[:, 2] - a[:, 0]
+        ph = a[:, 3] - a[:, 1]
+        pcx = a[:, 0] + pw / 2
+        pcy = a[:, 1] + ph / 2
+        dv = d * v
+        cx = dv[:, 0] * pw + pcx
+        cy = dv[:, 1] * ph + pcy
+        bw = np.exp(np.clip(dv[:, 2], None, 10)) * pw
+        bh = np.exp(np.clip(dv[:, 3], None, 10)) * ph
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2, cy + bh / 2], 1)
+        h_im, w_im = float(ims[n, 0]), float(ims[n, 1])
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w_im - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h_im - 1)
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
+              (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[ok], s[ok]
+        keep = _nms_keep(boxes, s, nms_thresh, top_k=post_nms_top_n)
+        all_rois.append(boxes[keep])
+        all_scores.append(s[keep])
+        nums.append(len(keep))
+    rois = Tensor(np.concatenate(all_rois, 0).astype(np.float32))
+    rscores = Tensor(np.concatenate(all_scores, 0).astype(np.float32))
+    if return_rois_num:
+        return rois, rscores, Tensor(np.asarray(nums, np.int32))
+    return rois, rscores
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    """Focal loss on sigmoid logits (reference:
+    operators/detection/sigmoid_focal_loss_op; 2.x surface
+    F.sigmoid_focal_loss).  ``label``: same-shape float one-hot.
+    Differentiable (rides the tape/jit like any functional)."""
+    def f(x, t, *norm):
+        p = jax.nn.sigmoid(x)
+        ce = -(t * jax.nn.log_sigmoid(x) +
+               (1 - t) * jax.nn.log_sigmoid(-x))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if norm:
+            loss = loss / norm[0]
+        if reduction == "sum":
+            return loss.sum()
+        if reduction == "mean":
+            return loss.mean()
+        return loss
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(normalizer)
+    return apply1(f, *args, nondiff=(1, 2), name="sigmoid_focal_loss")
+
+
+def polygon_box_transform(input):
+    """EAST quad-geometry transform (reference:
+    operators/detection/polygon_box_transform_op): channel 2k holds x
+    offsets, 2k+1 y offsets; output = 4*grid_coord - input."""
+    def f(a):
+        N, C, H, W = a.shape
+        xs = jnp.arange(W, dtype=a.dtype)[None, None, None, :]
+        ys = jnp.arange(H, dtype=a.dtype)[None, None, :, None]
+        even = jnp.arange(C) % 2 == 0
+        grid = jnp.where(even[None, :, None, None], 4 * xs + 0 * ys,
+                         4 * ys + 0 * xs)
+        return grid - a
+    return apply1(f, input, name="polygon_box_transform")
+
+
+__all__ += ["iou_similarity", "box_clip", "anchor_generator",
+            "density_prior_box", "bipartite_match", "multiclass_nms",
+            "matrix_nms", "distribute_fpn_proposals",
+            "collect_fpn_proposals", "generate_proposals",
+            "sigmoid_focal_loss", "polygon_box_transform"]

@@ -1,0 +1,178 @@
+"""Round-3 detection op tail (reference: operators/detection/*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def T(a, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(a, dtype))
+
+
+def test_iou_similarity():
+    x = T([[0, 0, 10, 10], [5, 5, 15, 15]])
+    y = T([[0, 0, 10, 10]])
+    m = V.iou_similarity(x, y).numpy()
+    np.testing.assert_allclose(m[0, 0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(m[1, 0], 25.0 / 175.0, atol=1e-6)
+
+
+def test_box_clip():
+    b = T([[-5, -5, 30, 40], [2, 3, 8, 9]])
+    out = V.box_clip(b, T([20, 25])).numpy()
+    np.testing.assert_allclose(out[0], [0, 0, 24, 19])
+    np.testing.assert_allclose(out[1], [2, 3, 8, 9])
+
+
+def test_anchor_generator():
+    fm = T(np.zeros((1, 8, 4, 6)))
+    anchors, variances = V.anchor_generator(
+        fm, anchor_sizes=[32, 64], aspect_ratios=[1.0, 2.0],
+        stride=(16, 16))
+    assert anchors.shape == [4, 6, 4, 4]
+    assert variances.shape == [4, 6, 4, 4]
+    a = anchors.numpy()
+    # first cell, first (ratio=1, size=32) anchor centered at (8, 8)
+    np.testing.assert_allclose(a[0, 0, 0], [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+    # ratio 2 preserves area: w*h == size^2
+    w = a[0, 0, 2, 2] - a[0, 0, 2, 0]
+    h = a[0, 0, 2, 3] - a[0, 0, 2, 1]
+    np.testing.assert_allclose(w * h, 32 * 32, rtol=1e-5)
+
+
+def test_density_prior_box():
+    fm = T(np.zeros((1, 8, 2, 2)))
+    img = T(np.zeros((1, 3, 32, 32)))
+    boxes, var = V.density_prior_box(
+        fm, img, densities=[2], fixed_sizes=[16.0], fixed_ratios=[1.0],
+        clip=True)
+    assert boxes.shape == [2, 2, 4, 4]          # density^2 = 4 per cell
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_bipartite_match():
+    d = T([[0.9, 0.1, 0.3],
+           [0.2, 0.8, 0.4]])
+    idx, dist = V.bipartite_match(d)
+    np.testing.assert_array_equal(idx.numpy(), [0, 1, -1])
+    np.testing.assert_allclose(dist.numpy(), [0.9, 0.8, 0.0])
+    idx2, dist2 = V.bipartite_match(d, match_type="per_prediction",
+                                    dist_threshold=0.25)
+    np.testing.assert_array_equal(idx2.numpy(), [0, 1, 1])
+
+
+def test_multiclass_nms():
+    M = 4
+    bboxes = np.zeros((1, M, 4), np.float32)
+    bboxes[0, 0] = [0, 0, 10, 10]
+    bboxes[0, 1] = [1, 1, 11, 11]        # overlaps box 0
+    bboxes[0, 2] = [50, 50, 60, 60]
+    bboxes[0, 3] = [100, 100, 110, 110]
+    scores = np.zeros((1, 2, M), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.01]
+    out, nums = V.multiclass_nms(T(bboxes), T(scores),
+                                 score_threshold=0.05, nms_threshold=0.5,
+                                 background_label=0)
+    o = out.numpy()
+    assert nums.numpy()[0] == 2              # box1 suppressed, box3 below thr
+    assert set(o[:, 0]) == {1.0}             # class labels
+    np.testing.assert_allclose(sorted(o[:, 1], reverse=True), [0.9, 0.7])
+
+
+def test_matrix_nms_decays_overlaps():
+    bboxes = np.zeros((1, 3, 4), np.float32)
+    bboxes[0, 0] = [0, 0, 10, 10]
+    bboxes[0, 1] = [0, 0, 10, 10]        # exact duplicate
+    bboxes[0, 2] = [50, 50, 60, 60]
+    scores = np.zeros((1, 1, 3), np.float32)
+    scores[0, 0] = [0.9, 0.8, 0.7]
+    out, nums, idx = V.matrix_nms(T(bboxes), T(scores),
+                                  score_threshold=0.05,
+                                  post_threshold=0.1)
+    o = out.numpy()
+    # duplicate fully decays (iou=1 -> decay 0); distant box untouched
+    kept = dict(zip(idx.numpy().tolist(), o[:, 1].tolist()))
+    assert kept[0] == pytest.approx(0.9)
+    assert kept[2] == pytest.approx(0.7)
+    assert 1 not in kept
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.array([[0, 0, 16, 16],         # small -> low level
+                     [0, 0, 448, 448]], np.float32)   # big -> high level
+    multi, restore, nums = V.distribute_fpn_proposals(
+        T(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    assert len(multi) == 4
+    assert nums.numpy().tolist() == [1, 0, 0, 1]
+    np.testing.assert_array_equal(restore.numpy(), [0, 1])
+    merged = V.collect_fpn_proposals(
+        [multi[0], multi[3]], [T([0.3]), T([0.9])], post_nms_top_n=1)
+    np.testing.assert_allclose(merged.numpy()[0], rois[1])
+
+
+def test_generate_proposals():
+    rng = np.random.default_rng(0)
+    A, H, W = 3, 4, 4
+    scores = rng.random((1, A, H, W)).astype(np.float32)
+    deltas = (rng.standard_normal((1, 4 * A, H, W)) * 0.1).astype(
+        np.float32)
+    fm = T(np.zeros((1, 8, H, W)))
+    anchors, variances = V.anchor_generator(
+        fm, anchor_sizes=[16, 32], aspect_ratios=[1.0],
+        stride=(8, 8))
+    # anchor_generator gives A=2; regenerate with 3 sizes to match A=3
+    anchors, variances = V.anchor_generator(
+        fm, anchor_sizes=[8, 16, 32], aspect_ratios=[1.0], stride=(8, 8))
+    rois, rscores, nums = V.generate_proposals(
+        T(scores), T(deltas), T([[32, 32]]), anchors, variances,
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.5,
+        min_size=1.0, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and 0 < r.shape[0] <= 5
+    assert nums.numpy()[0] == r.shape[0]
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 31).all()
+    s = rscores.numpy()
+    assert (np.diff(s) <= 1e-6).all()          # sorted by score
+
+
+def test_sigmoid_focal_loss_grad():
+    logit = paddle.to_tensor(
+        np.array([[2.0, -1.0], [0.5, 0.1]], np.float32),
+        stop_gradient=False)
+    label = T([[1, 0], [0, 1]])
+    loss = V.sigmoid_focal_loss(logit, label, reduction="mean")
+    loss.backward()
+    assert logit.grad is not None
+    # well-classified positive (logit 2, label 1) has tiny grad vs
+    # poorly-classified positive (logit 0.1, label 1)
+    g = np.abs(logit.grad.numpy())
+    assert g[0, 0] < g[1, 1]
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    out = V.polygon_box_transform(T(x)).numpy()
+    # even channel: 4*x_coord; odd channel: 4*y_coord
+    np.testing.assert_allclose(out[0, 0, 0], [0, 4, 8])
+    np.testing.assert_allclose(out[0, 1, :, 0], [0, 4])
+
+
+def test_matrix_nms_partial_overlap_decays():
+    # reviewer scenario: pairwise IoU ~0.67 must decay ranked-below
+    # scores, not pass them through at 1.0
+    bboxes = np.zeros((1, 3, 4), np.float32)
+    bboxes[0, 0] = [0, 0, 10, 10]
+    bboxes[0, 1] = [0, 2, 10, 12]       # iou 8/12 with box0
+    bboxes[0, 2] = [0, 4, 10, 14]       # iou 8/12 with box1, 6/14 w box0
+    scores = np.zeros((1, 1, 3), np.float32)
+    scores[0, 0] = [0.9, 0.8, 0.7]
+    out, nums, idx = V.matrix_nms(T(bboxes), T(scores),
+                                  score_threshold=0.05,
+                                  post_threshold=0.0, keep_top_k=-1)
+    kept = dict(zip(idx.numpy().tolist(), out.numpy()[:, 1].tolist()))
+    assert kept[0] == pytest.approx(0.9)
+    assert kept[1] < 0.8 * 0.5          # strongly decayed by box0
+    assert kept[2] < 0.7                # decayed too

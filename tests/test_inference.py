@@ -65,3 +65,26 @@ class TestPredictor:
         t = PredictorTensor("x")
         with pytest.raises(RuntimeError, match="no value"):
             t.copy_to_cpu()
+
+
+def test_static_save_load_inference_model(tmp_path):
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import static
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    want = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [static.InputSpec([None, 4])], net)
+    loaded = static.load_inference_model(prefix)
+    np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_save_inference_model_rejects_non_layer(tmp_path):
+    import pytest
+    from paddle_tpu import static
+    with pytest.raises(TypeError, match="Layer"):
+        static.save_inference_model(str(tmp_path / "x"), None, object())

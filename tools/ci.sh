@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# CI gate (reference roles: paddle/scripts/paddle_build.sh test stages,
+# tools/test_op_benchmark.sh, tools/check_api_compatible.py).
+#
+#   tools/ci.sh            # full gate: tests + API freeze + op-bench check
+#   tools/ci.sh quick      # tests only
+#
+# The op-benchmark regression stage only runs when a baseline exists
+# (tools/op_bench_baseline.json — record one on your hardware with
+# `python tools/op_bench.py --save tools/op_bench_baseline.json`).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== pytest =="
+python -m pytest tests/ -q
+
+if [ "${1:-}" = "quick" ]; then exit 0; fi
+
+echo "== API signature freeze =="
+JAX_PLATFORMS=cpu python tools/print_signatures.py --check
+
+if [ -f tools/op_bench_baseline.json ]; then
+  echo "== op benchmark regression gate =="
+  python tools/op_bench.py --compare tools/op_bench_baseline.json \
+      --threshold 0.15
+else
+  echo "== op benchmark gate skipped (no tools/op_bench_baseline.json) =="
+fi
+echo "CI gate passed."

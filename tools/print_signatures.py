@@ -58,6 +58,9 @@ MODULES = [
     "paddle_tpu.onnx",
     "paddle_tpu.regularizer",
     "paddle_tpu.framework.flags",
+    "paddle_tpu.framework.crypto",
+    "paddle_tpu.distributed.fleet.metrics",
+    "paddle_tpu.distributed.fleet.utils.fs",
     "paddle_tpu.utils.cpp_extension",
 ]
 
