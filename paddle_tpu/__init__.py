@@ -63,8 +63,14 @@ from paddle_tpu import jit  # noqa: E402,F401
 from paddle_tpu import static  # noqa: E402,F401
 from paddle_tpu import parallel  # noqa: E402,F401
 from paddle_tpu import distributed  # noqa: E402,F401
+from paddle_tpu import device  # noqa: E402,F401
 from paddle_tpu import distribution  # noqa: E402,F401
+from paddle_tpu import incubate  # noqa: E402,F401
 from paddle_tpu import profiler  # noqa: E402,F401
+from paddle_tpu import reader  # noqa: E402,F401
+from paddle_tpu import sysconfig  # noqa: E402,F401
+from paddle_tpu import version  # noqa: E402,F401
+from paddle_tpu.reader import batch  # noqa: E402,F401
 from paddle_tpu import quantization  # noqa: E402,F401
 from paddle_tpu import vision  # noqa: E402,F401
 from paddle_tpu import text  # noqa: E402,F401

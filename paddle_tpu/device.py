@@ -1,0 +1,37 @@
+"""paddle.device namespace (parity: python/paddle/device.py — 2.x home
+of set_device/get_device and the is_compiled_with_* probes)."""
+from __future__ import annotations
+
+from paddle_tpu.core import (device_count, get_device,  # noqa: F401
+                             set_device)
+
+__all__ = ["set_device", "get_device", "device_count",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_npu", "is_compiled_with_tpu",
+           "get_cudnn_version", "XPUPlace"]
+
+
+def is_compiled_with_cuda() -> bool:
+    return False                      # TPU build
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import paddle_tpu
+    return paddle_tpu.is_compiled_with_tpu()
+
+
+def get_cudnn_version():
+    return None                       # no cuDNN in the TPU build
+
+
+def XPUPlace(dev_id: int = 0):
+    from paddle_tpu.core import XPUPlace as _P
+    return _P(dev_id)

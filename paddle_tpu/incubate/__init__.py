@@ -1,0 +1,7 @@
+"""paddle.incubate — experimental surface (reference:
+python/paddle/incubate/__init__.py, v2.1: LookAhead + ModelAverage
+optimizers under incubate.optimizer)."""
+from paddle_tpu.incubate import optimizer  # noqa: F401
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["optimizer", "LookAhead", "ModelAverage"]

@@ -1,0 +1,162 @@
+"""Incubate optimizers (reference:
+python/paddle/incubate/optimizer/{lookahead.py, modelaverage.py}).
+
+Both wrap a working ("fast") optimizer with slow-moving parameter state:
+LookAhead interpolates slow weights toward fast every k steps;
+ModelAverage maintains a running average applied for evaluation.  The
+state lives host-side as jax arrays per parameter — step() composes with
+the eager tape; under TrainStep capture, wrap the *inner* optimizer in
+the step and call ``lookahead.sync()`` / ``average.accumulate()`` on the
+step boundary (they are O(params) elementwise jobs XLA runs as one fused
+update).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import Tensor, no_grad
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead (reference lookahead.py:28; 'Lookahead Optimizer:
+    k steps forward, 1 step back').  ``step()`` runs the inner optimizer;
+    every ``k`` steps slow <- slow + alpha*(fast - slow), fast <- slow."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name: Optional[str] = None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if k < 1:
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow: Dict[int, jnp.ndarray] = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    @no_grad()
+    def step(self):
+        params = self.inner_optimizer._parameter_list or []
+        for p in params:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._data
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            self.sync()
+
+    @no_grad()
+    def sync(self):
+        """slow <- slow + alpha*(fast - slow); fast <- slow."""
+        for p in self.inner_optimizer._parameter_list or []:
+            slow = self._slow.get(id(p), p._data)
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            p._data = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        inner = getattr(self.inner_optimizer, "state_dict", dict)()
+        return {"inner": inner, "step": self._step_count,
+                "slow": {str(i): v for i, (k, v) in
+                         enumerate(self._slow.items())}}
+
+
+class ModelAverage:
+    """Running parameter average for evaluation (reference
+    modelaverage.py:30: sum_1/sum_2/sum_3 windowed accumulation;
+    ``apply()`` swaps averaged weights in, ``restore()`` swaps back).
+
+    TPU-native simplification of the three-bucket scheme: one running sum
+    + count with the same window semantics (the buckets exist to bound
+    host memory for sparse rows; dense jax arrays don't need the split —
+    the window caps how much history the average carries).
+    """
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters=None, min_average_window: int = 10000,
+                 max_average_window: int = 10000000, name=None):
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._parameter_list = list(parameters) if parameters is not None \
+            else []
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._count: Dict[int, int] = {}
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._applied = False
+
+    @no_grad()
+    def step(self):
+        """Accumulate the current parameter values into the average."""
+        for p in self._parameter_list:
+            k = id(p)
+            if k not in self._sum:
+                self._sum[k] = jnp.zeros_like(p._data)
+                self._count[k] = 0
+            window = max(self.min_average_window,
+                         min(self.max_average_window,
+                             int(self._count[k] * self.average_window)
+                             or self.min_average_window))
+            if self._count[k] >= window:
+                # window cap: geometric forgetting keeps the sum bounded
+                self._sum[k] = self._sum[k] * (1.0 - 1.0 / window)
+                self._count[k] = window - 1
+            self._sum[k] = self._sum[k] + p._data
+            self._count[k] += 1
+
+    accumulate = step
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Context manager (and plain call) installing averaged params."""
+        for p in self._parameter_list:
+            k = id(p)
+            if k in self._sum and self._count[k] > 0:
+                self._backup[k] = p._data
+                p._data = (self._sum[k] / self._count[k]).astype(
+                    p._data.dtype)
+        self._applied = True
+        self._need_restore = need_restore
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+        return False
+
+    @no_grad()
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            k = id(p)
+            if k in self._backup:
+                p._data = self._backup.pop(k)
+        self._applied = False
+
+    def minimize(self, loss, **kw):
+        raise RuntimeError(
+            "ModelAverage only averages; pair it with a real optimizer "
+            "(reference modelaverage.py has the same contract)")

@@ -1,0 +1,18 @@
+"""paddle.sysconfig (parity: python/paddle/sysconfig.py — include/lib
+dirs for building against the framework; here they point at the package
+itself and the native-op sources)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    return os.path.join(_ROOT, "ops", "native")
+
+
+def get_lib() -> str:
+    return os.path.join(_ROOT, "ops", "native")

@@ -1,2 +1,8 @@
-"""paddle.utils parity tier: custom-op runtime (cpp_extension)."""
+"""paddle.utils parity tier: custom-op runtime (cpp_extension),
+@deprecated, install run_check, weights-cache download."""
 from paddle_tpu.utils import cpp_extension  # noqa: F401
+from paddle_tpu.utils import download  # noqa: F401
+from paddle_tpu.utils.deprecated import deprecated  # noqa: F401
+from paddle_tpu.utils.install_check import run_check  # noqa: F401
+
+__all__ = ["cpp_extension", "download", "deprecated", "run_check"]

@@ -1,0 +1,28 @@
+"""@deprecated decorator (parity: python/paddle/utils/deprecated.py —
+appends a deprecation note to the docstring and warns once per site)."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    def decorator(func):
+        msg = f"API \"{func.__module__}.{func.__name__}\" is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use \"{update_to}\" instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        doc = "\n\nWarning:\n    " + msg + "\n"
+        func.__doc__ = (func.__doc__ or "") + doc
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return wrapper
+    return decorator

@@ -1,0 +1,35 @@
+"""paddle.utils.download (parity: python/paddle/utils/download.py —
+get_weights_path_from_url with a local cache).  This environment has
+zero egress, so the cache is the only source: a URL whose file is
+already cached resolves; anything else raises with a clear message
+instead of hanging on a socket.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hapi/weights")
+
+
+def _map_path(url: str) -> str:
+    fname = os.path.basename(url.split("?")[0]) or \
+        hashlib.md5(url.encode()).hexdigest()
+    return os.path.join(WEIGHTS_HOME, fname)
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    path = _map_path(url)
+    if os.path.exists(path):
+        if md5sum:
+            with open(path, "rb") as f:
+                if hashlib.md5(f.read()).hexdigest() != md5sum:
+                    raise IOError(
+                        f"cached file {path} fails its md5 check")
+        return path
+    raise RuntimeError(
+        f"{url} is not in the local weights cache ({path}) and this "
+        "environment has no network egress — place the file there "
+        "manually, or construct the model with pretrained=False")

@@ -1,0 +1,33 @@
+"""paddle.utils.run_check (parity: python/paddle/utils/install_check.py
+— trains a tiny linear model to verify the install, then reports which
+device tier is active)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    """Train a 2-step linear regression on the default device; prints the
+    same style of success message the reference does."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    print("Running verify PaddlePaddle(TPU) program ... ")
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    x = paddle.to_tensor(np.random.rand(8, 4).astype("float32"))
+    y = paddle.to_tensor(np.random.rand(8, 2).astype("float32"))
+    for _ in range(2):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.isfinite(float(loss)), "install check produced non-finite loss"
+    dev = paddle.get_device()
+    print(f"PaddlePaddle(TPU) works well on 1 {dev.split(':')[0]}.")
+    print("PaddlePaddle(TPU) is installed successfully! Let's start deep "
+          "learning with PaddlePaddle(TPU) now.")
+    return True

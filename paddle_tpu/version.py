@@ -1,0 +1,22 @@
+"""paddle.version (parity: the generated python/paddle/version.py —
+major/minor/patch/rc fields + show())."""
+from __future__ import annotations
+
+full_version = "0.2.0"
+major, minor, patch = full_version.split(".")
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "show",
+           "commit", "istaged", "with_mkl"]
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {commit}")

@@ -1,0 +1,80 @@
+"""incubate optimizers (reference: python/paddle/incubate/optimizer/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import LookAhead, ModelAverage
+
+
+def _train_data():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = (x @ np.ones((4, 1), np.float32))
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+def test_lookahead_converges_and_syncs():
+    net = nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=5)
+    x, y = _train_data()
+    losses = []
+    for _ in range(40):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_lookahead_slow_weights_interpolate():
+    net = nn.Linear(2, 1)
+    w0 = net.weight.numpy().copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                 parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=1)   # sync every step
+    x = paddle.to_tensor(np.ones((4, 2), np.float32))
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    w_grad = net.weight.grad.numpy().copy()
+    opt.step()
+    # fast = w0 - 0.5*g; slow = w0 + 0.5*(fast - w0) = w0 - 0.25*g
+    np.testing.assert_allclose(net.weight.numpy(), w0 - 0.25 * w_grad,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lookahead_validates_args():
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=nn.Linear(2, 1).parameters())
+    with pytest.raises(ValueError):
+        LookAhead(None)
+    with pytest.raises(ValueError):
+        LookAhead(inner, alpha=2.0)
+    with pytest.raises(ValueError):
+        LookAhead(inner, k=0)
+
+
+def test_model_average_apply_restore():
+    net = nn.Linear(2, 1)
+    avg = ModelAverage(0.15, parameters=net.parameters(),
+                       min_average_window=2)
+    vals = []
+    for v in (1.0, 2.0, 3.0):
+        net.weight._data = np.full((2, 1), v, np.float32)
+        avg.step()
+        vals.append(v)
+    raw = net.weight.numpy().copy()
+    with avg.apply():
+        applied = net.weight.numpy().copy()
+    # inside: some windowed average of history; outside: restored
+    assert applied.mean() != pytest.approx(raw.mean())
+    np.testing.assert_allclose(net.weight.numpy(), raw)
+
+
+def test_model_average_needs_real_optimizer():
+    avg = ModelAverage(0.15, parameters=nn.Linear(2, 1).parameters())
+    with pytest.raises(RuntimeError, match="real optimizer"):
+        avg.minimize(None)

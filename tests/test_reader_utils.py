@@ -1,0 +1,99 @@
+"""paddle.reader combinators + utils tier (parity:
+python/paddle/reader/decorator.py, python/paddle/batch.py,
+python/paddle/utils/{deprecated,install_check}.py)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader as R
+
+
+def _r(n=10):
+    def impl():
+        yield from range(n)
+    return impl
+
+
+def test_batch():
+    out = list(paddle.batch(_r(7), 3)())
+    assert out == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(_r(7), 3, drop_last=True)()) == \
+        [[0, 1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError):
+        paddle.batch(_r(), 0)
+
+
+def test_cache_and_firstn():
+    calls = []
+
+    def impl():
+        calls.append(1)
+        yield from range(5)
+    c = R.cache(impl)
+    assert list(c()) == list(range(5))
+    assert list(c()) == list(range(5))
+    assert len(calls) == 1
+    assert list(R.firstn(_r(10), 3)()) == [0, 1, 2]
+
+
+def test_map_chain_compose():
+    assert list(R.map_readers(lambda a, b: a + b, _r(3), _r(3))()) == \
+        [0, 2, 4]
+    assert list(R.chain(_r(2), _r(2))()) == [0, 1, 0, 1]
+    assert list(R.compose(_r(2), _r(2))()) == [(0, 0), (1, 1)]
+    with pytest.raises(R.ComposeNotAligned):
+        list(R.compose(_r(2), _r(3))())
+
+
+def test_shuffle_buffered_xmap():
+    out = sorted(R.shuffle(_r(20), 5)())
+    assert out == list(range(20))
+    assert sorted(R.buffered(_r(10), 2)()) == list(range(10))
+    sq = R.xmap_readers(lambda x: x * x, _r(10), 3, 4, order=True)
+    assert list(sq()) == [i * i for i in range(10)]
+    sq2 = R.xmap_readers(lambda x: x * x, _r(10), 3, 4, order=False)
+    assert sorted(sq2()) == sorted(i * i for i in range(10))
+
+
+def test_multiprocess_reader_merges():
+    out = sorted(R.multiprocess_reader([_r(5), _r(5)])())
+    assert out == sorted(list(range(5)) * 2)
+
+
+def test_deprecated_decorator():
+    @paddle.utils.deprecated(since="2.0", update_to="paddle.new_api")
+    def old_api():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_api() == 42
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
+    assert "deprecated" in old_api.__doc__
+
+
+def test_run_check(capsys):
+    assert paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_download_gated(tmp_path, monkeypatch):
+    from paddle_tpu.utils import download
+    monkeypatch.setattr(download, "WEIGHTS_HOME", str(tmp_path))
+    with pytest.raises(RuntimeError, match="egress"):
+        download.get_weights_path_from_url("http://x/y.pdparams")
+    p = tmp_path / "y.pdparams"
+    p.write_bytes(b"w")
+    assert download.get_weights_path_from_url("http://x/y.pdparams") == \
+        str(p)
+
+
+def test_device_version_sysconfig():
+    import os
+    assert paddle.device.get_device().split(":")[0] in ("cpu", "tpu", "gpu")
+    assert not paddle.device.is_compiled_with_cuda()
+    assert paddle.version.full_version == paddle.__version__
+    assert os.path.isdir(paddle.sysconfig.get_include())

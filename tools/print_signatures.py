@@ -62,6 +62,13 @@ MODULES = [
     "paddle_tpu.distributed.fleet.metrics",
     "paddle_tpu.distributed.fleet.utils.fs",
     "paddle_tpu.utils.cpp_extension",
+    "paddle_tpu.reader",
+    "paddle_tpu.device",
+    "paddle_tpu.version",
+    "paddle_tpu.sysconfig",
+    "paddle_tpu.incubate",
+    "paddle_tpu.incubate.optimizer",
+    "paddle_tpu.utils",
 ]
 
 
