@@ -662,3 +662,281 @@ __all__ += ["iou_similarity", "box_clip", "anchor_generator",
             "matrix_nms", "distribute_fpn_proposals",
             "collect_fpn_proposals", "generate_proposals",
             "sigmoid_focal_loss", "polygon_box_transform"]
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference:
+    python/paddle/vision/ops.py:394 deform_conv2d over
+    operators/deformable_conv_op.*).  Offsets bend every kernel tap's
+    sampling point (bilinear), ``mask`` (v2) modulates each tap.
+
+    TPU mapping: the CUDA kernel's per-tap sampling becomes a batched
+    gather of the 4 bilinear corners + an im2col matmul that lands on
+    the MXU — no scalar loops, fully differentiable through offsets,
+    mask and weights.  Offset channel layout: (dy, dx) interleaved per
+    tap, ``2 * deformable_groups * kh * kw`` channels.
+    """
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw_ = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    dg = deformable_groups
+
+    def _dc(xa, off, w, *rest):
+        it = iter(rest)
+        m = next(it) if mask is not None else None
+        b = next(it, None)
+        N, Cin, H, W = xa.shape
+        Cout, Cin_g, kh, kw = w.shape
+        K = kh * kw
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+
+        # base sampling grid per tap: (K, Ho, Wo)
+        oy = (jnp.arange(Ho) * sh - ph)[None, :, None]
+        ox = (jnp.arange(Wo) * sw - pw_)[None, None, :]
+        ky = (jnp.arange(kh) * dh).repeat(kw)[:, None, None]
+        kx = jnp.tile(jnp.arange(kw) * dw, kh)[:, None, None]
+        base_y = (oy + ky).astype(xa.dtype)
+        base_x = (ox + kx).astype(xa.dtype)
+
+        off = off.reshape(N, dg, K, 2, Ho, Wo)
+        py = base_y[None, None] + off[:, :, :, 0]      # (N, dg, K, Ho, Wo)
+        px = base_x[None, None] + off[:, :, :, 1]
+
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+
+        xg = xa.reshape(N, dg, Cin // dg, H * W)
+
+        def corner(yc, xc):
+            inb = ((yc >= 0) & (yc <= H - 1) &
+                   (xc >= 0) & (xc <= W - 1))
+            idx = (jnp.clip(yc, 0, H - 1).astype(jnp.int32) * W +
+                   jnp.clip(xc, 0, W - 1).astype(jnp.int32))
+            idx = idx.reshape(N, dg, 1, K * Ho * Wo)
+            v = jnp.take_along_axis(
+                xg, jnp.broadcast_to(idx, (N, dg, Cin // dg,
+                                           K * Ho * Wo)), axis=-1)
+            v = v.reshape(N, dg, Cin // dg, K, Ho, Wo)
+            return v * inb[:, :, None].astype(xa.dtype)
+
+        val = (corner(y0, x0) * ((1 - wy) * (1 - wx))[:, :, None] +
+               corner(y0, x0 + 1) * ((1 - wy) * wx)[:, :, None] +
+               corner(y0 + 1, x0) * (wy * (1 - wx))[:, :, None] +
+               corner(y0 + 1, x0 + 1) * (wy * wx)[:, :, None])
+        if m is not None:
+            val = val * m.reshape(N, dg, 1, K, Ho, Wo)
+
+        # (N, Cin, K, Ho*Wo) -> grouped im2col matmul on the MXU
+        cols = val.reshape(N, Cin, K, Ho * Wo)
+        cols = cols.reshape(N, groups, (Cin // groups) * K, Ho * Wo)
+        wg = w.reshape(groups, Cout // groups, Cin_g * K)
+        out = jnp.einsum("gok,ngkp->ngop", wg, cols)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply1(_dc, *args, name="deform_conv2d")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference: python/paddle/vision/ops.py:28 over
+    operators/detection/yolov3_loss_op).  Per the reference semantics:
+    sigmoid-BCE for x/y/objectness/class, L1 for w/h, box losses scaled
+    by (2 - w*h), each gt matched to its best wh-IoU anchor, objectness
+    of non-matched predictions ignored where their decoded box overlaps
+    any gt above ``ignore_thresh``; optional mixup ``gt_score`` weights,
+    label smoothing to 1-1/C / 1/C.  Returns a (N,) per-sample loss.
+
+    gt_box: (N, B, 4) xywh in input-image pixels (input size =
+    downsample_ratio * H); rows with w<=0 or label<0 are padding.
+    """
+    am = list(anchor_mask)
+    S = len(am)
+    C = int(class_num)
+
+    def _bce(logit, t):
+        return jnp.maximum(logit, 0) - logit * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def _yl(xa, gb, gl, *maybe):
+        gs = maybe[0] if maybe else None
+        N, _, H, W = xa.shape
+        x5 = xa.reshape(N, S, 5 + C, H, W)
+        plx, ply = x5[:, :, 0], x5[:, :, 1]
+        plw, plh = x5[:, :, 2], x5[:, :, 3]
+        pobj = x5[:, :, 4]
+        pcls = x5[:, :, 5:]                      # (N, S, C, H, W)
+        input_size = float(downsample_ratio * H)
+        an = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+        man = an[jnp.asarray(am)]               # (S, 2) masked anchors
+
+        gwp, ghp = gb[..., 2], gb[..., 3]        # (N, B) pixels
+        glab = gl.astype(jnp.int32)
+        valid = (gwp > 0) & (glab >= 0)
+        score = gs if gs is not None else jnp.ones_like(gwp)
+
+        # best global anchor per gt by wh-IoU
+        inter = jnp.minimum(gwp[..., None], an[:, 0]) * \
+            jnp.minimum(ghp[..., None], an[:, 1])
+        union = gwp[..., None] * ghp[..., None] + \
+            an[:, 0] * an[:, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)
+        in_mask = best[..., None] == jnp.asarray(am)    # (N, B, S)
+        s_idx = jnp.argmax(in_mask, -1)
+        pos = valid & in_mask.any(-1)
+
+        gx = gb[..., 0] / input_size
+        gy = gb[..., 1] / input_size
+        gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+        tx = gx * W - gi
+        ty = gy * H - gj
+        aw = man[s_idx][..., 0]
+        ah = man[s_idx][..., 1]
+        tw = jnp.log(jnp.maximum(gwp, 1e-10) / jnp.maximum(aw, 1e-10))
+        th = jnp.log(jnp.maximum(ghp, 1e-10) / jnp.maximum(ah, 1e-10))
+        box_w = (2.0 - (gwp / input_size) * (ghp / input_size)) * score
+
+        n_ar = jnp.arange(N)[:, None]
+        # gather predictions at the matched (s, gj, gi) per gt: (N, B)
+        g = lambda t: t[n_ar, s_idx, gj, gi]          # noqa: E731
+        eps = 1e-7
+        if scale_x_y == 1.0:
+            lxy = _bce(g(plx), tx) + _bce(g(ply), ty)
+        else:
+            sgx = jnp.clip(jax.nn.sigmoid(g(plx)) * scale_x_y -
+                           0.5 * (scale_x_y - 1.0), eps, 1 - eps)
+            sgy = jnp.clip(jax.nn.sigmoid(g(ply)) * scale_x_y -
+                           0.5 * (scale_x_y - 1.0), eps, 1 - eps)
+            lxy = -(tx * jnp.log(sgx) + (1 - tx) * jnp.log(1 - sgx)) \
+                - (ty * jnp.log(sgy) + (1 - ty) * jnp.log(1 - sgy))
+        lwh = jnp.abs(g(plw) - tw) + jnp.abs(g(plh) - th)
+        if use_label_smooth:
+            t_pos, t_neg = 1.0 - 1.0 / C, 1.0 / C
+        else:
+            t_pos, t_neg = 1.0, 0.0
+        onehot = jax.nn.one_hot(glab, C, dtype=xa.dtype)
+        tcls = onehot * t_pos + (1 - onehot) * t_neg
+        pcls_g = jnp.moveaxis(pcls, 2, -1)[n_ar, s_idx, gj, gi]
+        lcls = _bce(pcls_g, tcls).sum(-1) * score
+        posf = pos.astype(xa.dtype)
+        loss_box = ((lxy + lwh) * box_w * posf).sum(-1)
+        loss_cls = (lcls * posf).sum(-1)
+
+        # objectness: positive map (scatter-max), ignore by decoded IoU
+        tobj = jnp.zeros((N, S, H, W), xa.dtype)
+        posmap = tobj.at[n_ar, s_idx, gj, gi].max(posf)
+        scoremap = tobj.at[n_ar, s_idx, gj, gi].max(score * posf)
+
+        cx = jnp.arange(W, dtype=xa.dtype)[None, None, None, :]
+        cy = jnp.arange(H, dtype=xa.dtype)[None, None, :, None]
+        bx = (jax.nn.sigmoid(plx) * scale_x_y -
+              0.5 * (scale_x_y - 1.0) + cx) / W
+        by = (jax.nn.sigmoid(ply) * scale_x_y -
+              0.5 * (scale_x_y - 1.0) + cy) / H
+        bw = man[:, 0][None, :, None, None] * jnp.exp(plw) / input_size
+        bh = man[:, 1][None, :, None, None] * jnp.exp(plh) / input_size
+
+        def one_iou(gxb, gyb, gwb, ghb):
+            # broadcast gt columns (N,B,1,1,1) over the (N,1,S,H,W) grid
+            bx_, by_ = bx[:, None], by[:, None]
+            bw_ = jnp.broadcast_to(bw, bx.shape)[:, None]
+            bh_ = jnp.broadcast_to(bh, by.shape)[:, None]
+            ix = jnp.maximum(
+                0.0, jnp.minimum(bx_ + bw_ / 2, gxb + gwb / 2) -
+                jnp.maximum(bx_ - bw_ / 2, gxb - gwb / 2))
+            iy = jnp.maximum(
+                0.0, jnp.minimum(by_ + bh_ / 2, gyb + ghb / 2) -
+                jnp.maximum(by_ - bh_ / 2, gyb - ghb / 2))
+            i = ix * iy
+            u = bw_ * bh_ + gwb * ghb - i
+            return i / jnp.maximum(u, 1e-10)
+
+        gxn = (gx * valid)[:, :, None, None, None]
+        gyn = (gy * valid)[:, :, None, None, None]
+        gwn = (gwp / input_size * valid)[:, :, None, None, None]
+        ghn = (ghp / input_size * valid)[:, :, None, None, None]
+        ious = one_iou(gxn, gyn, gwn, ghn)       # (N, B, S, H, W)
+        max_iou = ious.max(1)
+        noobj = ((max_iou < ignore_thresh).astype(xa.dtype) *
+                 (1.0 - posmap))
+        lobj = _bce(pobj, 1.0) * posmap * scoremap + \
+            _bce(pobj, 0.0) * noobj
+        return loss_box + loss_cls + lobj.sum((1, 2, 3))
+
+    args = [x, gt_box, gt_label]
+    nondiff = [2]
+    if gt_score is not None:
+        args.append(gt_score)
+        nondiff.append(3)
+    return apply1(_yl, *args, nondiff=tuple(nondiff), name="yolo_loss")
+
+
+__all__ += ["deform_conv2d", "yolo_loss"]
+
+
+class DeformConv2D:
+    """Layer form of deform_conv2d (reference:
+    python/paddle/vision/ops.py:598).  Defined lazily as a real Layer at
+    first import of paddle_tpu.nn to avoid a circular import."""
+
+    def __new__(cls, *args, **kwargs):
+        return _make_deform_layer()(*args, **kwargs)
+
+
+def _make_deform_layer():
+    global _DeformLayer
+    if _DeformLayer is None:
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn import initializer as I
+
+        class _DeformConv2D(nn.Layer):
+            def __init__(self, in_channels, out_channels, kernel_size,
+                         stride=1, padding=0, dilation=1,
+                         deformable_groups=1, groups=1, weight_attr=None,
+                         bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) if isinstance(
+                    kernel_size, int) else tuple(kernel_size)
+                self._stride = stride
+                self._padding = padding
+                self._dilation = dilation
+                self._deformable_groups = deformable_groups
+                self._groups = groups
+                self.weight = self.create_parameter(
+                    shape=[out_channels, in_channels // groups, *ks],
+                    attr=weight_attr,
+                    default_initializer=I.XavierUniform())
+                self.bias = None if bias_attr is False else \
+                    self.create_parameter(
+                        shape=[out_channels], attr=bias_attr, is_bias=True,
+                        default_initializer=I.Constant(0.0))
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(
+                    x, offset, self.weight, bias=self.bias,
+                    stride=self._stride, padding=self._padding,
+                    dilation=self._dilation,
+                    deformable_groups=self._deformable_groups,
+                    groups=self._groups, mask=mask)
+
+        _DeformLayer = _DeformConv2D
+    return _DeformLayer
+
+
+_DeformLayer = None
+
+__all__ += ["DeformConv2D"]

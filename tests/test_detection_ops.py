@@ -176,3 +176,131 @@ def test_matrix_nms_partial_overlap_decays():
     assert kept[0] == pytest.approx(0.9)
     assert kept[1] < 0.8 * 0.5          # strongly decayed by box0
     assert kept[2] < 0.7                # decayed too
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+    off = np.zeros((2, 2 * 1 * 9, 8, 8), np.float32)
+    got = V.deform_conv2d(T(x), T(off), T(w), padding=1).numpy()
+    want = F.conv2d(T(x), T(w), padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_mask_and_groups():
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((1, 4, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((4, 2, 3, 3)).astype(np.float32)  # groups=2
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    ones = np.ones((1, 9, 6, 6), np.float32)
+    got = V.deform_conv2d(T(x), T(off), T(w), padding=1, groups=2,
+                          mask=T(ones)).numpy()
+    want = F.conv2d(T(x), T(w), padding=1, groups=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # half mask halves the response
+    got2 = V.deform_conv2d(T(x), T(off), T(w), padding=1, groups=2,
+                           mask=T(ones * 0.5)).numpy()
+    np.testing.assert_allclose(got2, want * 0.5, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_offset_shifts_sampling():
+    # integer offset (dy=0, dx=1) on a 1x1 kernel == shifting the image
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    off[:, 1] = 1.0                  # dx=+1
+    got = V.deform_conv2d(T(x), T(off), T(w)).numpy()
+    want = np.zeros_like(x)
+    want[..., :, :-1] = x[..., :, 1:]   # shifted left; border samples 0
+    np.testing.assert_allclose(got, want)
+
+
+def test_deform_conv2d_grad_flows_to_offset():
+    rng = np.random.default_rng(5)
+    x = paddle.to_tensor(rng.standard_normal((1, 2, 5, 5)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((3, 2, 3, 3)).astype(np.float32),
+                         stop_gradient=False)
+    off = paddle.to_tensor(
+        (rng.standard_normal((1, 18, 5, 5)) * 0.1).astype(np.float32),
+        stop_gradient=False)
+    out = V.deform_conv2d(x, off, w, padding=1)
+    out.sum().backward()
+    assert off.grad is not None and np.abs(off.grad.numpy()).sum() > 0
+    assert w.grad is not None
+
+
+def _yolo_inputs(rng, N=2, B=3, H=4, C=6):
+    S = 3
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+    anchor_mask = [0, 1, 2]
+    x = rng.standard_normal((N, S * (5 + C), H, H)).astype(np.float32)
+    inp = 32 * H
+    gt = np.zeros((N, B, 4), np.float32)
+    gt[:, 0] = [inp * 0.4, inp * 0.4, 20, 25]       # one valid box
+    lab = np.zeros((N, B), np.int64)
+    lab[:, 1:] = -1                                  # padding rows
+    gt[:, 1:] = 0
+    return x, gt, lab, anchors, anchor_mask, C
+
+
+def test_yolo_loss_shape_and_padding_rows():
+    rng = np.random.default_rng(0)
+    x, gt, lab, anchors, mask, C = _yolo_inputs(rng)
+    loss = V.yolo_loss(T(x), T(gt), paddle.to_tensor(lab), anchors, mask,
+                       C, ignore_thresh=0.7, downsample_ratio=32)
+    l = loss.numpy()
+    assert l.shape == (2,) and np.isfinite(l).all() and (l > 0).all()
+
+
+def test_yolo_loss_perfect_prediction_is_smaller():
+    rng = np.random.default_rng(1)
+    x, gt, lab, anchors, mask, C = _yolo_inputs(rng)
+    rand = float(V.yolo_loss(T(x), T(gt), paddle.to_tensor(lab), anchors,
+                             mask, C, 0.7, 32,
+                             use_label_smooth=False).numpy().sum())
+    # construct near-perfect logits for the matched cell
+    H = 4
+    inp = 128.0
+    gx, gy, gw, gh = gt[0, 0]
+    # best anchor for (20, 25): argmax wh-iou -> anchor 1 (16, 30)
+    s = 1
+    gi, gj = int(gx / inp * H), int(gy / inp * H)
+    good = np.full_like(x, -8.0)     # sigmoid ~ 0: conf/class/xy lows
+    x5 = good.reshape(2, 3, 5 + C, H, H)
+    tx = gx / inp * H - gi
+    x5[:, s, 0, gj, gi] = np.log(tx / (1 - tx))
+    ty = gy / inp * H - gj
+    x5[:, s, 1, gj, gi] = np.log(ty / (1 - ty))
+    x5[:, s, 2, gj, gi] = np.log(gw / 16.0)
+    x5[:, s, 3, gj, gi] = np.log(gh / 30.0)
+    x5[:, s, 4, gj, gi] = 8.0        # confident objectness
+    x5[:, s, 5 + 0, gj, gi] = 8.0    # class 0
+    perfect = float(V.yolo_loss(T(x5.reshape(x.shape)), T(gt),
+                                paddle.to_tensor(lab), anchors, mask, C,
+                                0.7, 32,
+                                use_label_smooth=False).numpy().sum())
+    assert perfect < rand * 0.2
+
+
+def test_yolo_loss_differentiable():
+    rng = np.random.default_rng(2)
+    x, gt, lab, anchors, mask, C = _yolo_inputs(rng)
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    loss = V.yolo_loss(xt, T(gt), paddle.to_tensor(lab), anchors, mask,
+                       C, 0.7, 32)
+    loss.sum().backward()
+    g = xt.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_deform_conv2d_layer():
+    from paddle_tpu.vision.ops import DeformConv2D
+    layer = DeformConv2D(4, 6, 3, padding=1)
+    x = T(np.random.default_rng(0).standard_normal((2, 4, 8, 8)))
+    off = T(np.zeros((2, 18, 8, 8)))
+    out = layer(x, off)
+    assert out.shape == [2, 6, 8, 8]
+    assert len(list(layer.parameters())) == 2     # weight + bias
