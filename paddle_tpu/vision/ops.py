@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.core import Tensor, apply1
+from paddle_tpu.core import Tensor, apply, apply1
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "yolo_box",
            "prior_box", "box_coder"]
@@ -940,3 +940,132 @@ def _make_deform_layer():
 _DeformLayer = None
 
 __all__ += ["DeformConv2D"]
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0.0):
+    """Assign per-prior targets from matched gt rows (reference:
+    operators/detection/target_assign_op, the SSD target stage after
+    bipartite_match).  ``input`` (N, M, K) per-gt targets,
+    ``matched_indices`` (N, P) gt row per prior or -1.  Returns
+    (out (N, P, K), out_weight (N, P, 1)): unmatched priors get
+    ``mismatch_value`` and weight 0; ``negative_indices`` (list of
+    per-sample index arrays) force weight 1 (the sampled negatives of
+    the conf branch)."""
+    inp = np.asarray(_unwrap(input))
+    mi = np.asarray(_unwrap(matched_indices)).astype(np.int64)
+    N, P = mi.shape
+    K = inp.shape[-1]
+    out = np.full((N, P, K), float(mismatch_value), inp.dtype)
+    w = np.zeros((N, P, 1), np.float32)
+    for n in range(N):
+        pos = mi[n] >= 0
+        out[n, pos] = inp[n, mi[n, pos]]
+        w[n, pos] = 1.0
+        if negative_indices is not None:
+            neg = np.asarray(_unwrap(negative_indices[n])).astype(np.int64)
+            w[n, neg] = 1.0
+    return Tensor(out), Tensor(w)
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       sample_size=None, mining_type="max_negative"):
+    """Hard-negative mining (reference:
+    operators/detection/mine_hard_examples_op): per sample keep the
+    highest-loss unmatched priors, capped at ``neg_pos_ratio * #pos``
+    (or ``sample_size``).  Returns a list of per-sample negative index
+    arrays (feed to target_assign) and the updated match_indices where
+    non-selected negatives stay -1."""
+    if mining_type not in ("max_negative", "hard_example"):
+        raise ValueError(f"unknown mining_type {mining_type}")
+    loss = np.asarray(_unwrap(cls_loss))
+    mi = np.array(np.asarray(_unwrap(match_indices)), np.int64, copy=True)
+    neg_lists = []
+    for n in range(mi.shape[0]):
+        neg = np.nonzero(mi[n] < 0)[0]
+        if mining_type == "max_negative":
+            n_pos = int((mi[n] >= 0).sum())
+            cap = int(neg_pos_ratio * max(n_pos, 1))
+        else:
+            cap = int(sample_size or len(neg))
+        order = neg[np.argsort(-loss[n, neg])][:cap]
+        neg_lists.append(Tensor(np.sort(order)))
+    return neg_lists, Tensor(mi)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip_value=4.135):
+    """Decode per-class deltas and assign each roi its best-scoring
+    class box (reference:
+    operators/detection/box_decoder_and_assign_op).  ``target_box``
+    (N, 4*C) per-class deltas, ``box_score`` (N, C).  Returns
+    (decoded (N, 4*C), assigned (N, 4))."""
+    def f(pb, pbv, tb, sc):
+        N = pb.shape[0]
+        C = sc.shape[1]
+        pw = pb[:, 2] - pb[:, 0] + 1.0
+        ph = pb[:, 3] - pb[:, 1] + 1.0
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        d = tb.reshape(N, C, 4) * pbv[:, None, :]
+        dcx = d[..., 0] * pw[:, None] + pcx[:, None]
+        dcy = d[..., 1] * ph[:, None] + pcy[:, None]
+        dw = jnp.exp(jnp.minimum(d[..., 2], box_clip_value)) * pw[:, None]
+        dh = jnp.exp(jnp.minimum(d[..., 3], box_clip_value)) * ph[:, None]
+        boxes = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                           dcx + dw * 0.5 - 1.0, dcy + dh * 0.5 - 1.0],
+                          -1)                      # (N, C, 4)
+        best = jnp.argmax(sc, axis=1)
+        assigned = jnp.take_along_axis(
+            boxes, best[:, None, None].repeat(4, -1), 1)[:, 0]
+        return boxes.reshape(N, 4 * C), assigned
+    out, assigned = apply(f, prior_box, prior_box_var, target_box,
+                          box_score, name="box_decoder_and_assign")
+    return out, assigned
+
+
+def locality_aware_nms(bboxes, scores, score_threshold=0.05,
+                       nms_top_k=-1, keep_top_k=-1, nms_threshold=0.3,
+                       normalized=True):
+    """EAST-style locality-aware NMS (reference:
+    operators/detection/locality_aware_nms_op): consecutive overlapping
+    boxes are score-weighted-merged first, then standard greedy NMS.
+    ``bboxes`` (M, 4), ``scores`` (M,).  Returns (K, 5) [score, x1..y2]."""
+    b = np.array(np.asarray(_unwrap(bboxes)), np.float32, copy=True)
+    s = np.array(np.asarray(_unwrap(scores)), np.float32,
+                 copy=True).reshape(-1)
+    keep = s > score_threshold
+    b, s = b[keep], s[keep]
+
+    def iou(a, c):
+        lt = np.maximum(a[:2], c[:2])
+        rb = np.minimum(a[2:], c[2:])
+        wh = np.clip(rb - lt, 0, None)
+        i = wh[0] * wh[1]
+        aa = (a[2] - a[0]) * (a[3] - a[1])
+        ac = (c[2] - c[0]) * (c[3] - c[1])
+        return i / max(aa + ac - i, 1e-10)
+
+    merged_b, merged_s = [], []
+    for i in range(len(b)):
+        if merged_b and iou(merged_b[-1], b[i]) > nms_threshold:
+            w1, w2 = merged_s[-1], s[i]
+            merged_b[-1] = (merged_b[-1] * w1 + b[i] * w2) / (w1 + w2)
+            merged_s[-1] = w1 + w2
+        else:
+            merged_b.append(b[i].copy())
+            merged_s.append(float(s[i]))
+    if not merged_b:
+        return Tensor(np.zeros((0, 5), np.float32))
+    mb = np.stack(merged_b)
+    ms = np.asarray(merged_s, np.float32)
+    if nms_top_k > 0 and len(ms) > nms_top_k:
+        top = np.argsort(-ms)[:nms_top_k]
+        mb, ms = mb[top], ms[top]
+    kept = _nms_keep(mb, ms, nms_threshold, top_k=keep_top_k)
+    out = np.concatenate([ms[kept, None], mb[kept]], 1)
+    return Tensor(out)
+
+
+__all__ += ["target_assign", "mine_hard_examples",
+            "box_decoder_and_assign", "locality_aware_nms"]

@@ -304,3 +304,53 @@ def test_deform_conv2d_layer():
     out = layer(x, off)
     assert out.shape == [2, 6, 8, 8]
     assert len(list(layer.parameters())) == 2     # weight + bias
+
+
+def test_target_assign_and_mining():
+    # 2 gts, 4 priors; priors 0,2 matched to gts 1,0
+    tgt = np.arange(2 * 3, dtype=np.float32).reshape(1, 2, 3)
+    mi = np.array([[1, -1, 0, -1]], np.int64)
+    out, w = V.target_assign(T(tgt), paddle.to_tensor(mi),
+                             mismatch_value=-9.0)
+    np.testing.assert_allclose(out.numpy()[0, 0], tgt[0, 1])
+    np.testing.assert_allclose(out.numpy()[0, 2], tgt[0, 0])
+    np.testing.assert_allclose(out.numpy()[0, 1], [-9, -9, -9])
+    np.testing.assert_allclose(w.numpy()[0, :, 0], [1, 0, 1, 0])
+
+    # hard negative mining: ratio 0.5 with 2 pos -> 1 negative (hardest)
+    loss = np.array([[0.1, 0.9, 0.1, 0.3]], np.float32)
+    negs, mi2 = V.mine_hard_examples(T(loss), paddle.to_tensor(mi),
+                                     neg_pos_ratio=0.5)
+    np.testing.assert_array_equal(negs[0].numpy(), [1])
+    # weights now include the mined negative
+    _, w2 = V.target_assign(T(tgt), paddle.to_tensor(mi),
+                            negative_indices=negs)
+    np.testing.assert_allclose(w2.numpy()[0, :, 0], [1, 1, 1, 0])
+
+
+def test_box_decoder_and_assign():
+    pb = T([[0, 0, 10, 10]])
+    pbv = T([[1, 1, 1, 1]])
+    # class 0: zero deltas (identity); class 1: shifted
+    tb = T([[0, 0, 0, 0, 1.0, 0, 0, 0]])
+    sc = T([[0.2, 0.8]])
+    dec, assigned = V.box_decoder_and_assign(pb, pbv, tb, sc)
+    assert dec.shape == [1, 8]
+    # best class is 1 -> assigned box is the shifted one
+    d = dec.numpy().reshape(1, 2, 4)
+    np.testing.assert_allclose(assigned.numpy()[0], d[0, 1], rtol=1e-5)
+    # class-0 identity decode reproduces the prior
+    np.testing.assert_allclose(d[0, 0], [0, 0, 10, 10], atol=1e-5)
+
+
+def test_locality_aware_nms_merges_neighbors():
+    boxes = np.array([[0, 0, 10, 10],
+                      [0.5, 0.5, 10.5, 10.5],    # near-duplicate
+                      [50, 50, 60, 60]], np.float32)
+    scores = np.array([0.6, 0.4, 0.9], np.float32)
+    out = V.locality_aware_nms(T(boxes), T(scores),
+                               nms_threshold=0.5).numpy()
+    assert out.shape[0] == 2                     # merged + distant
+    merged = out[out[:, 0] > 0.9]                # merged score = 1.0
+    np.testing.assert_allclose(
+        merged[0, 1:], (boxes[0] * 0.6 + boxes[1] * 0.4), rtol=1e-5)
