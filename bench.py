@@ -222,7 +222,10 @@ def bench_widedeep_ps(on_accel):
     from paddle_tpu.models import WideDeepHost
 
     if on_accel:
-        B, V, E = 8192, 100_000_000, 64
+        # B swept 1k..32k (perf/ps_knee_analysis.md): knee at 16k —
+        # pulls stay <0.5% of the step throughout; beyond 16k the dense
+        # leg + host unique prep dominate and throughput falls
+        B, V, E = 16384, 100_000_000, 64
     else:
         B, V, E = 256, 50_000, 8
     fields, dense_dim = 26, 13
