@@ -96,8 +96,9 @@ def run_one(cfg, warmup=3, iters=10):
         out = fn(*args, **kwargs)
     _sync(out)
     dt = (time.perf_counter() - t0) / iters
+    import jax
     return {"name": cfg.get("name", cfg["op"]), "op": cfg["op"],
-            "ms": round(dt * 1e3, 4)}
+            "ms": round(dt * 1e3, 4), "device": jax.default_backend()}
 
 
 def eager_vs_jit_bench(iters=30, batch=64):
@@ -202,6 +203,12 @@ def main(argv=None):
         for r in results:
             b = base.get(r.get("name"))
             if b is None or "ms" not in r:
+                continue
+            if b.get("device") and r.get("device") and \
+                    b["device"] != r["device"]:
+                print(f"SKIP {r['name']}: baseline device "
+                      f"{b['device']!r} != current {r['device']!r}",
+                      file=sys.stderr)
                 continue
             slowdown = r["ms"] / b["ms"] - 1.0
             if slowdown > a.threshold:
