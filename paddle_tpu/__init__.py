@@ -79,6 +79,7 @@ from paddle_tpu import utils  # noqa: E402,F401
 from paddle_tpu import visualdl  # noqa: E402,F401
 from paddle_tpu import inference  # noqa: E402,F401
 from paddle_tpu import onnx  # noqa: E402,F401
+from paddle_tpu.framework import monitor  # noqa: E402,F401
 from paddle_tpu.distributed.parallel import DataParallel  # noqa: E402,F401
 from paddle_tpu.framework.io import save, load  # noqa: E402,F401
 from paddle_tpu.hapi.model import Model  # noqa: E402,F401

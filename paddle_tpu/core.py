@@ -614,10 +614,37 @@ _amp_hook = [None]
 # grad positions) — semantically equal closures share an entry.  Anything
 # non-hashable in cells/args (arrays, per-call RNG keys, mutable objects)
 # makes the call uncacheable and it falls back to the direct path.
+#
+# PURITY REQUIREMENT: a cached fn must be pure in its (args, kwargs, cells,
+# defaults) — the key does not see module-level globals, so an op that reads
+# mutable global state would have that state frozen into the compiled entry
+# at first call.  All in-tree ops satisfy this; custom ops dispatched through
+# ``apply`` that read mutable globals must pass the state as an argument or
+# disable the cache (FLAGS_eager_op_jit_cache=False).
 
 _OP_CACHE: dict = {}
 _OP_CACHE_MAX = 1024
 _UNCACHEABLE = object()
+
+# telemetry: monitor counters (STAT_ADD role) — handles resolved once so the
+# per-dispatch cost is a single locked int add.  Readable via
+# paddle.monitor.get_stat("eager_cache_hit"/"eager_cache_miss"/
+# "eager_cache_uncacheable").
+_CACHE_STATS = [None]
+
+
+def _cache_stat(kind_idx):
+    stats = _CACHE_STATS[0]
+    if stats is None:
+        from paddle_tpu.framework.monitor import StatRegistry
+        reg = StatRegistry.instance()
+        stats = (reg.get("eager_cache_hit"), reg.get("eager_cache_miss"),
+                 reg.get("eager_cache_uncacheable"))
+        _CACHE_STATS[0] = stats
+    stats[kind_idx].increase()
+
+
+_HIT, _MISS, _UNC = 0, 1, 2
 
 
 class _Unhashable(Exception):
@@ -726,16 +753,19 @@ def _cached_dispatch(fn, frozen, tensor_pos, grad_pos, kwargs):
     from paddle_tpu.framework.flags import flag
     if not flag("eager_op_jit_cache"):
         return None
+    for f in frozen:
+        if _is_tracer(f):
+            return None  # inside an outer trace: no nested jit, not counted
     keyed = _op_cache_key(fn, frozen, tensor_pos, grad_pos, kwargs)
     if keyed is None:
+        _cache_stat(_UNC)
         return None
     key, runtime_pos = keyed
-    for p in runtime_pos:
-        if _is_tracer(frozen[p]):
-            return None            # inside an outer trace: no nested jit
     entry = _OP_CACHE.get(key)
     if entry is _UNCACHEABLE:
+        _cache_stat(_UNC)
         return None
+    hit = entry is not None
     if entry is None:
         if len(_OP_CACHE) >= _OP_CACHE_MAX:
             for _ in range(_OP_CACHE_MAX // 8):
@@ -748,7 +778,9 @@ def _cached_dispatch(fn, frozen, tensor_pos, grad_pos, kwargs):
     except Exception:
         # value-dependent python control flow etc. — never try again
         _OP_CACHE[key] = _UNCACHEABLE
+        _cache_stat(_UNC)
         return None
+    _cache_stat(_HIT if hit else _MISS)
     if grad_pos:
         out, vjp = res
         return out, (lambda cts, _v=vjp: _vjp_call(_v, cts))

@@ -72,5 +72,8 @@ define_flag("max_inplace_grad_add", 0, "compat no-op")
 define_flag("eager_op_jit_cache", True,
             "compiled (fwd, vjp) fast path for eager op dispatch, keyed on "
             "op semantics — plays the reference's generated core.ops role "
-            "(pybind/op_function_generator.cc)")
+            "(pybind/op_function_generator.cc).  Cached fns must be pure in "
+            "(args, kwargs, closure, defaults): mutable module-level state "
+            "read inside an op is frozen at first call.  Disable for impure "
+            "custom ops.")
 define_flag("conv_workspace_size_limit", 512, "compat no-op")

@@ -20,13 +20,32 @@ def _mean_var_1pass(a, axes, keepdims=False):
     both accumulators in one multi-output reduction fusion: profiled on
     one chip, ResNet-50's step time is dominated by exactly these
     BN-stat passes, not the convs.  Accumulation in f32 keeps bf16
-    activations numerically safe; the clamp guards the catastrophic
-    cancellation the two-pass form avoids analytically.
+    activations numerically safe.
+
+    Plain E[x^2]-E[x]^2 cancels catastrophically when |mean| >> std, so the
+    accumulation is shifted by a per-channel constant K (one sample along the
+    reduced axes, stop-gradient): var = E[(x-K)^2] - E[x-K]^2.  The shift is a
+    single elementwise subtract inside the same fusion — the one-read property
+    is preserved, and the residuals it accumulates are O(std), not O(mean).
     """
     af = a.astype(jnp.float32)
-    m = jnp.mean(af, axis=axes, keepdims=keepdims)
-    msq = jnp.mean(af * af, axis=axes, keepdims=keepdims)
-    v = jnp.maximum(msq - m * m, 0.0)
+    if any(a.shape[ax] == 0 for ax in axes):
+        # empty reduction: slice_in_dim would be out of bounds; the stats are
+        # NaN either way, so take the unshifted form
+        m = jnp.mean(af, axis=axes, keepdims=keepdims)
+        v = jnp.zeros_like(m)
+        return m.astype(a.dtype), v.astype(a.dtype)
+    k = jax.lax.stop_gradient(af)
+    for ax in axes:
+        k = jax.lax.slice_in_dim(k, 0, 1, axis=ax)
+    d = af - k
+    md = jnp.mean(d, axis=axes, keepdims=True)
+    msq = jnp.mean(d * d, axis=axes, keepdims=True)
+    v = jnp.maximum(msq - md * md, 0.0)
+    m = md + k
+    if not keepdims:
+        m = jnp.squeeze(m, axis=axes)
+        v = jnp.squeeze(v, axis=axes)
     return m.astype(a.dtype), v.astype(a.dtype)
 
 

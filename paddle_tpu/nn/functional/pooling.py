@@ -204,6 +204,10 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "max_pool3d(return_mask=True) is not implemented; "
+            "use max_pool2d or file an issue")
     return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
                  "max", ceil_mode, True, "max_pool3d")
 

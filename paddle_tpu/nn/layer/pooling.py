@@ -11,12 +11,21 @@ __all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
 
 
 class _PoolNd(Layer):
+    # kwargs the functional actually honors; anything else raises instead of
+    # being silently dropped (NDHWC data would otherwise pool the wrong axes)
+    _allowed = ("name",)
+
     def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
         super().__init__()
+        unsupported = set(kwargs) - set(self._allowed)
+        if unsupported:
+            raise ValueError(
+                f"{type(self).__name__} does not support kwargs "
+                f"{sorted(unsupported)}")
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
-        self.kwargs = kwargs
+        self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
 
     def extra_repr(self):
         return (f"kernel_size={self.kernel_size}, stride={self.stride}, "
@@ -24,53 +33,69 @@ class _PoolNd(Layer):
 
 
 class AvgPool1D(_PoolNd):
+    _allowed = ("exclusive", "ceil_mode", "data_format", "name")
+
     def forward(self, x):
         return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            **{k: v for k, v in self.kwargs.items()
-                               if k in ("exclusive", "ceil_mode")})
+                            **self.kwargs)
 
 
 class AvgPool2D(_PoolNd):
+    _allowed = ("exclusive", "ceil_mode", "divisor_override", "data_format",
+                "name")
+
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            **{k: v for k, v in self.kwargs.items()
-                               if k in ("exclusive", "ceil_mode",
-                                        "data_format")})
+                            **self.kwargs)
 
 
 class AvgPool3D(_PoolNd):
+    _allowed = ("exclusive", "ceil_mode", "divisor_override", "data_format",
+                "name")
+
     def forward(self, x):
         return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            **{k: v for k, v in self.kwargs.items()
-                               if k in ("exclusive", "ceil_mode",
-                                        "data_format")})
+                            **self.kwargs)
 
 
 class MaxPool1D(_PoolNd):
+    _allowed = ("return_mask", "ceil_mode", "data_format", "name")
+
     def forward(self, x):
         return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            **{k: v for k, v in self.kwargs.items()
-                               if k in ("return_mask",)})
+                            **self.kwargs)
 
 
 class MaxPool2D(_PoolNd):
+    _allowed = ("return_mask", "ceil_mode", "data_format", "name")
+
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            **{k: v for k, v in self.kwargs.items()
-                               if k in ("ceil_mode", "data_format",
-                                        "return_mask")})
+                            **self.kwargs)
 
 
 class MaxPool3D(_PoolNd):
+    _allowed = ("return_mask", "ceil_mode", "data_format", "name")
+
     def forward(self, x):
-        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kwargs)
 
 
 class _AdaptivePoolNd(Layer):
+    # kwargs the functional actually honors; anything else raises instead of
+    # being silently dropped (NDHWC data would otherwise pool the wrong axes)
+    _allowed = ("name",)
+
     def __init__(self, output_size, **kwargs):
         super().__init__()
+        unsupported = set(kwargs) - set(self._allowed)
+        if unsupported:
+            raise ValueError(
+                f"{type(self).__name__} does not support kwargs "
+                f"{sorted(unsupported)}")
         self.output_size = output_size
-        self.kwargs = kwargs
+        self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
 
 
 class AdaptiveAvgPool1D(_AdaptivePoolNd):
@@ -79,28 +104,35 @@ class AdaptiveAvgPool1D(_AdaptivePoolNd):
 
 
 class AdaptiveAvgPool2D(_AdaptivePoolNd):
+    _allowed = ("data_format", "name")
+
     def forward(self, x):
-        return F.adaptive_avg_pool2d(
-            x, self.output_size,
-            **{k: v for k, v in self.kwargs.items()
-               if k in ("data_format",)})
+        return F.adaptive_avg_pool2d(x, self.output_size, **self.kwargs)
 
 
 class AdaptiveAvgPool3D(_AdaptivePoolNd):
+    _allowed = ("data_format", "name")
+
     def forward(self, x):
-        return F.adaptive_avg_pool3d(x, self.output_size)
+        return F.adaptive_avg_pool3d(x, self.output_size, **self.kwargs)
 
 
 class AdaptiveMaxPool1D(_AdaptivePoolNd):
+    _allowed = ("return_mask", "name")
+
     def forward(self, x):
-        return F.adaptive_max_pool1d(x, self.output_size)
+        return F.adaptive_max_pool1d(x, self.output_size, **self.kwargs)
 
 
 class AdaptiveMaxPool2D(_AdaptivePoolNd):
+    _allowed = ("return_mask", "name")
+
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size, **self.kwargs)
 
 
 class AdaptiveMaxPool3D(_AdaptivePoolNd):
+    _allowed = ("return_mask", "name")
+
     def forward(self, x):
-        return F.adaptive_max_pool3d(x, self.output_size)
+        return F.adaptive_max_pool3d(x, self.output_size, **self.kwargs)

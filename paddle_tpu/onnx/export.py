@@ -429,8 +429,11 @@ class _Converter:
                     "to": proto.DTYPE_TO_ONNX["int64"]})
             out = self.emit("Gather", [data, idx], attrs={"axis": axis})
             if not tuple(idx_aval.shape[:-1]):
-                # scalar index: output keeps slice dims only
-                pass
+                # scalar index: indices were padded to shape [1], so Gather
+                # keeps a leading 1 jax collapses — reshape to the jax aval.
+                oshape = [int(d) for d in eqn.outvars[0].aval.shape]
+                out = self.emit("Reshape", [
+                    out, self.const(np.array(oshape, np.int64), "shape")])
             self.bind(eqn.outvars[0], out)
             return
         raise NotImplementedError(

@@ -143,8 +143,11 @@ def node(op_type: str, inputs: List[str], outputs: List[str],
 
 
 def value_info(name: str, elem_type: int, shape) -> bytes:
-    dims = b"".join(f_bytes(1, f_varint(1, int(d))) for d in shape)
-    tshape = f_bytes(2, dims) if shape is not None else b""
+    if shape is not None:
+        dims = b"".join(f_bytes(1, f_varint(1, int(d))) for d in shape)
+        tshape = f_bytes(2, dims)
+    else:
+        tshape = b""
     ttype = f_bytes(1, f_varint(1, elem_type) + tshape)   # tensor_type
     return f_string(1, name) + f_bytes(2, ttype)
 

@@ -127,6 +127,28 @@ def test_embedding_gather(tmp_path):
     assert any(n["op_type"] == "Gather" for n in m["graph"]["nodes"])
 
 
+def test_scalar_index_gather(tmp_path):
+    # x[0] lowers to gather with a scalar (collapsed) index — the exported
+    # Gather pads indices to shape [1], so export must squeeze the result
+    # back to the jax aval shape (advisor r3: onnx/export.py p_gather).
+    class Pick(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return self.lin(x)[0]
+
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    _roundtrip(Pick(), "pick", [x], tmpdir=str(tmp_path))
+
+
+def test_value_info_shapeless():
+    # shape=None must emit a shapeless tensor_type, not raise (advisor r3)
+    vi = proto.value_info("x", 1, None)
+    assert isinstance(vi, bytes) and len(vi) > 0
+
+
 def test_groupwise_and_dilated_conv(tmp_path):
     net = nn.Sequential(
         nn.Conv2D(8, 8, 3, padding=2, dilation=2, groups=4), nn.ReLU())
