@@ -69,6 +69,12 @@ define_flag("use_bf16_matmul", True,
 define_flag("cudnn_deterministic", False,
             "accepted for compat; XLA on TPU is deterministic by default")
 define_flag("max_inplace_grad_add", 0, "compat no-op")
+define_flag("gpt_fused_ce", False,
+            "route gpt_loss through the blockwise Pallas linear+softmax-CE "
+            "kernel (ops/pallas/fused_ce.py): trades nothing vs XLA on "
+            "step time (XLA runs the unfused head at ~MXU peak on v5e) "
+            "but eliminates the (B,S,V) f32 logits buffer — enable when "
+            "HBM is the binding constraint")
 define_flag("eager_op_jit_cache", True,
             "compiled (fwd, vjp) fast path for eager op dispatch, keyed on "
             "op semantics — plays the reference's generated core.ops role "

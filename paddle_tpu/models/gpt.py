@@ -219,6 +219,15 @@ def _make_stage(cfg: GPTConfig, manual_sp: bool):
     unroll = getattr(cfg, "scan_unroll", 1)
 
     def stage_fn(local_params, h):
+        depth = jax.tree_util.tree_leaves(local_params)[0].shape[0]
+        if unroll >= depth:
+            # fully unrolled: static t[i] slices instead of lax.scan.  The
+            # scan's stacked-grad dynamic-update-slice chain (measured
+            # ~18 ms/step on GPT-2 345M) becomes static pads XLA fuses.
+            for i in range(depth):
+                lp = jax.tree_util.tree_map(lambda t: t[i], local_params)
+                h, _ = body(h, lp)
+            return h
         out, _ = jax.lax.scan(lambda carry, lp: body(carry, lp), h,
                               local_params, unroll=unroll)
         return out
@@ -236,7 +245,7 @@ def _stack_params(ln1_w, ln1_b, qkv_w, qkv_b, prj_w, prj_b, ln2_w, ln2_b,
 
 def _gpt_forward(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
                  prj_w, prj_b, ln2_w, ln2_b, fc_w, fc_b, out_w, out_b,
-                 lnf_w, lnf_b, ids):
+                 lnf_w, lnf_b, ids, features_only: bool = False):
     mesh = get_mesh()
     B, S = ids.shape
 
@@ -259,6 +268,8 @@ def _gpt_forward(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
         x = stage_fn(stacked, x)
 
     x = _ln(x, lnf_w, lnf_b)
+    if features_only:
+        return _mark(x, "dp", "sp", None)
     logits = x @ wte.T                                 # tied head
     return _mark(logits, "dp", "sp", "mp")
 
@@ -321,17 +332,65 @@ def _gpt_1f1b_loss(cfg: GPTConfig, wte, wpe, ln1_w, ln1_b, qkv_w, qkv_b,
 _1F1B_CACHE: dict = {}
 
 
+def _gpt_fused_ce_loss(cfg: GPTConfig, *args):
+    """Forward to the final LN, then blockwise Pallas linear+softmax-CE
+    against the tied embedding — the (B, S, V) logits never reach HBM
+    (reference fused-op tier role, operators/fused/ +
+    softmax_with_cross_entropy_op.*)."""
+    from paddle_tpu.ops.pallas.fused_ce import fused_linear_cross_entropy
+    params, (ids, labels) = args[:-2], args[-2:]
+    wte = params[0]
+    B, S = ids.shape
+    h = _gpt_forward(cfg, *params, ids, features_only=True)    # (B,S,H)
+    # next-token labels with a -1 sentinel on the final position (same
+    # convention as the 1F1B head)
+    lab = jnp.concatenate(
+        [labels[:, 1:], jnp.full((B, 1), -1, labels.dtype)], axis=1)
+    lab_flat = lab.reshape(B * S)
+    loss_n = fused_linear_cross_entropy(
+        h.reshape(B * S, h.shape[-1]), wte, lab_flat)
+    w = (lab_flat >= 0).astype(jnp.float32)
+    return jnp.sum(loss_n * w) / (B * (S - 1))
+
+
+def _use_fused_ce() -> bool:
+    from paddle_tpu.framework.flags import flag
+    return bool(flag("gpt_fused_ce"))
+
+
 def gpt_loss(model, input_ids, labels):
-    """Causal-LM cross entropy (f32 logits softmax); labels == input
-    tokens, shifted internally.  Under pp>1 with schedule_mode=1 the whole
-    pipeline+loss runs as one interleaved 1F1B program."""
+    """Causal-LM cross entropy (f32 softmax); labels == input tokens,
+    shifted internally.  Under pp>1 with schedule_mode=1 the whole
+    pipeline+loss runs as one interleaved 1F1B program.  On a single
+    device with a TPU attached, the head+CE runs as the fused Pallas
+    blockwise kernel (no (B, S, V) logits in HBM)."""
+    from paddle_tpu.ops.pallas import fused_ce
     cfg = getattr(model, "config", None)
+    mesh = get_mesh()
     if cfg is not None and getattr(cfg, "schedule_mode", 0) == 1 and \
-            get_mesh().shape.get("pp", 1) > 1:
+            mesh.shape.get("pp", 1) > 1:
         params = [model._parameters[n] for n in _PARAM_ORDER]
         fn = partial(_gpt_1f1b_loss, cfg)
         return apply1(fn, *params, input_ids, labels,
                       name="gpt_loss_1f1b")
+    B, S = input_ids.shape
+    single_dev = math.prod(mesh.shape.values()) == 1
+    if cfg is not None and single_dev and _use_fused_ce() and \
+            fused_ce.supported(B * S, cfg.hidden_size):
+        # fused head+CE needs the pre-head hiddens, so it takes the whole
+        # forward as one pure fn (mesh-off fast path; under a mesh the
+        # logits path keeps its mp sharding annotations).
+        #
+        # Opt-in (FLAGS_gpt_fused_ce): measured on v5e, XLA runs the
+        # unfused head+CE at ~MXU peak (13 ms for the 3×845 GF passes at
+        # B=8·S=1024·V=50k), so the kernel buys no time — what it buys is
+        # the 1.65 GB (B,S,V) f32 logits buffer, lifting the max
+        # no-remat batch from 8 to 12+.  Use it when HBM, not step time,
+        # is the binding constraint.
+        params = [model._parameters[n] for n in _PARAM_ORDER]
+        fn = partial(_gpt_fused_ce_loss, cfg)
+        return apply1(fn, *params, input_ids, labels,
+                      name="gpt_loss_fused")
     logits = model(input_ids)
 
     def ce(logits, ids):

@@ -321,5 +321,8 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) is not implemented")
     return _adaptive_pool(x, output_size, 3, "max", False,
                           "adaptive_max_pool3d")

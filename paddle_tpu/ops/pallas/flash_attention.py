@@ -65,6 +65,8 @@ _INTERPRET = False
 
 _NEG_INF = float("-inf")
 
+from paddle_tpu.ops.pallas.common import dot_nt as _dot_nt  # noqa: E402
+
 
 def _backend_is_tpu() -> bool:
     return jax.default_backend() in ("tpu", "axon")
@@ -213,10 +215,12 @@ def _fwd_kernel(*args, scale, causal, block_k, block_q, n_kb, off,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale       # (bq, d)
-        k = k_ref[0].astype(jnp.float32)               # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = q @ k.T                                    # (bq, bk)
+        q = q_ref[0]                                   # (bq, d) input dtype
+        k = k_ref[0]                                   # (bk, d)
+        v = v_ref[0]
+        # MXU at input rate (bf16 on chip), f32 accumulation; scale applied
+        # to the f32 product
+        s = _dot_nt(q, k) * scale                      # (bq, bk) f32
         s = _mask_tile(s, bias_ref, qs_ref, ks_ref)
         if causal:
             q_idx = qi * block_q + jax.lax.broadcasted_iota(
@@ -233,7 +237,8 @@ def _fwd_kernel(*args, scale, causal, block_k, block_q, n_kb, off,
         alpha = jnp.where(jnp.isfinite(m_prev), alpha, 0.0)
         m_scr[...] = m_new
         l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[...] = acc_scr[...] * alpha + p @ v
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(kb == n_kb - 1)
     def _finish():
@@ -347,8 +352,9 @@ def _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal):
 
 def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off,
                bias_ref=None, qs_ref=None, ks_ref=None):
-    """Recompute the (bq, bk) probability tile from saved lse."""
-    s = (q @ k.T) * scale
+    """Recompute the (bq, bk) probability tile from saved lse.  q/k stay in
+    input dtype (bf16 on chip); the product accumulates f32."""
+    s = _dot_nt(q, k) * scale
     s = _mask_tile(s, bias_ref, qs_ref, ks_ref)
     if causal:
         q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -397,17 +403,18 @@ def _bwd_dq_kernel(*args, scale, causal, block_q, block_k, n_kb, off,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                               # (bq, 1)
         delta = delta_ref[0]
         p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
                        off, bias_ref, qs_ref, ks_ref)
-        dp = do @ v.T                                  # (bq, bk)
+        dp = _dot_nt(do, v)                            # (bq, bk) f32
         ds = p * (dp - delta)
-        acc_scr[...] += (ds @ k) * scale
+        acc_scr[...] += jnp.dot(ds.astype(k.dtype), k,
+                                preferred_element_type=jnp.float32) * scale
 
     @pl.when(kb == n_kb - 1)
     def _finish():
@@ -437,18 +444,23 @@ def _bwd_dkv_kernel(*args, scale, causal, block_q, block_k, n_qb, off,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
                        off, bias_ref, qs_ref, ks_ref)
-        dv_scr[...] += p.T @ do
-        dp = do @ v.T
+        # contract the query axis: pT@do and dsT@q with bf16 operands
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = _dot_nt(do, v)
         ds = p * (dp - delta)
-        dk_scr[...] += (ds.T @ q) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
 
     @pl.when(qi == n_qb - 1)
     def _finish():
@@ -482,15 +494,15 @@ def _bwd_dbias_kernel(*args, scale, causal, block_q, block_k, n_qb, n_r,
     def _init():
         db_scr[...] = jnp.zeros_like(db_scr)
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0]
     delta = delta_ref[0]
     p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
                    off, bias_ref, qs_ref, ks_ref)
-    dp = do @ v.T
+    dp = _dot_nt(do, v)
     ds = p * (dp - delta)
     if sq_full:
         db_scr[...] += ds

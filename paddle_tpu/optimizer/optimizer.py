@@ -331,12 +331,13 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 name=None):
+                 use_fused=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._lazy_mode = lazy_mode
+        self._use_fused = use_fused
 
     def init_state(self, value):
         return {"moment1": jnp.zeros_like(value),
@@ -348,14 +349,32 @@ class Adam(Optimizer):
 
     def update(self, param, grad, state, lr):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
-        m = b1 * state["moment1"] + (1 - b1) * grad
-        v = b2 * state["moment2"] + (1 - b2) * grad * grad
         b1p = state["beta1_pow"] * b1
         b2p = state["beta2_pow"] * b2
         lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        if self._use_fused:
+            from paddle_tpu.ops.pallas import fused_adam
+            if fused_adam.supported():
+                new_p, m, v = fused_adam.fused_adam_update(
+                    param, grad, state["moment1"], state["moment2"],
+                    lr_t=lr_t, beta1=b1, beta2=b2, eps=eps,
+                    wd_lr=self._fused_wd_lr(lr))
+                return new_p, {"moment1": m, "moment2": v,
+                               "beta1_pow": b1p, "beta2_pow": b2p}
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * grad * grad
         new_p = param - lr_t * m / (jnp.sqrt(v) + eps)
         return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
                        "beta2_pow": b2p}
+
+    def _fused_wd_lr(self, lr):
+        return 0.0  # Adam's L2 decay arrives inside the grad (regularizer)
+
+    def _fused_active(self):
+        if not self._use_fused:
+            return False
+        from paddle_tpu.ops.pallas import fused_adam
+        return fused_adam.supported()
 
     def update_sparse(self, param, sr, state, lr):
         """adam_op.h lazy_mode SelectedRows branch: moments and param move
@@ -387,17 +406,23 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False, use_fused=False,
+                 name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip)
+                         None, grad_clip, use_fused=use_fused)
         self._coeff = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _apply_decay(self, p, param, grad):
         return grad  # decoupled — handled in update via param name check
 
+    def _fused_wd_lr(self, lr):
+        return lr * float(self._coeff)   # decoupled decay inside the kernel
+
     def update(self, param, grad, state, lr):
         new_p, new_state = super().update(param, grad, state, lr)
+        if self._fused_active():
+            return new_p, new_state      # decay already applied in-kernel
         decay = lr * float(self._coeff)
         new_p = new_p - decay * param
         return new_p, new_state
