@@ -377,6 +377,12 @@ class TrainStep:
             self._cache[sig] = fn
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())
+        # for compiled_text(): only the jit fn + input avals (cheap tuple);
+        # param/state avals are derived lazily from live model state there
+        self._last_fn = fn
+        self._last_input_avals = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs)
+        self._last_key_aval = jax.ShapeDtypeStruct(key.shape, key.dtype)
         from paddle_tpu.profiler import RecordEvent
         with RecordEvent("TrainStep"):
             new_params, self._opt_states, new_buffers, loss = fn(
@@ -398,6 +404,25 @@ class TrainStep:
         if self.optimizer._lr_scheduler is not None:
             pass  # user steps the scheduler explicitly, paddle-style
         return Tensor(loss)
+
+    def compiled_text(self) -> str:
+        """Backend-optimized HLO of the most recent step signature (perf
+        ledgers / fusion inspection; see perf/resnet50_ledger.py).
+        lower().compile() builds a fresh executable — the XLA compile
+        cache usually makes it fast, but budget a compile on first use."""
+        if getattr(self, "_last_fn", None) is None:
+            raise RuntimeError("compiled_text() needs one executed step")
+        aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa:E731
+        params = {n: aval(p._data) for n, p in
+                  self.model.named_parameters()}
+        buffers = {n: aval(b._data) for n, b in self.model.named_buffers()
+                   if b is not None}
+        states = jax.tree_util.tree_map(aval, self._opt_states)
+        key = self._last_key_aval
+        lr = jax.ShapeDtypeStruct((), jnp.float32)
+        return self._last_fn.lower(
+            params, states, buffers, key, lr,
+            *self._last_input_avals).compile().as_text()
 
 
 # ---------------------------------------------------------------------------

@@ -22,27 +22,30 @@ def _mean_var_1pass(a, axes, keepdims=False):
     BN-stat passes, not the convs.  Accumulation in f32 keeps bf16
     activations numerically safe.
 
-    Plain E[x^2]-E[x]^2 cancels catastrophically when |mean| >> std, so the
-    accumulation is shifted by a per-channel constant K (one sample along the
-    reduced axes, stop-gradient): var = E[(x-K)^2] - E[x-K]^2.  The shift is a
-    single elementwise subtract inside the same fusion — the one-read property
-    is preserved, and the residuals it accumulates are O(std), not O(mean).
+    Numerics (advisor r3: E[x^2]-E[x]^2 cancels when |mean| >> std):
+    - low-precision inputs (bf16/f16, the AMP hot path) keep the one-pass
+      form — any cancellation error in the f32 accumulators is below the
+      input's own quantization (bf16 ULP at |x| dominates), so the clamp
+      is a true no-op there.  Shift-K variants were measured and
+      rejected: a slice-K costs ResNet-50 ~16% and a running-mean-K
+      ~40% (both break XLA's multi-output stat-fusion shape).
+    - float inputs that CAN carry sub-cancellation variance (f32/f64)
+      take the exact two-pass form instead — the reference's semantics,
+      at the cost of the second activation read.
     """
     af = a.astype(jnp.float32)
     if any(a.shape[ax] == 0 for ax in axes):
-        # empty reduction: slice_in_dim would be out of bounds; the stats are
-        # NaN either way, so take the unshifted form
+        # empty reduction: the stats are NaN either way; keep it finite
         m = jnp.mean(af, axis=axes, keepdims=keepdims)
         v = jnp.zeros_like(m)
         return m.astype(a.dtype), v.astype(a.dtype)
-    k = jax.lax.stop_gradient(af)
-    for ax in axes:
-        k = jax.lax.slice_in_dim(k, 0, 1, axis=ax)
-    d = af - k
-    md = jnp.mean(d, axis=axes, keepdims=True)
-    msq = jnp.mean(d * d, axis=axes, keepdims=True)
-    v = jnp.maximum(msq - md * md, 0.0)
-    m = md + k
+    if a.dtype in (jnp.float32, jnp.float64):
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        v = jnp.mean(jnp.square(af - m), axis=axes, keepdims=True)
+    else:
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        msq = jnp.mean(af * af, axis=axes, keepdims=True)
+        v = jnp.maximum(msq - m * m, 0.0)
     if not keepdims:
         m = jnp.squeeze(m, axis=axes)
         v = jnp.squeeze(v, axis=axes)

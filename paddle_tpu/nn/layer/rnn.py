@@ -263,12 +263,19 @@ class _RNNBase(Layer):
                     self.add_parameter(f"bias_hh{sfx}", b_hh)
 
     def _cell_fn(self):
+        """Pre-projected step: the input projection ``x @ W_ihᵀ`` for ALL
+        timesteps is hoisted out of the scan as one (T·B, in)·(in, G·H)
+        matmul (the reference's fusion_lstm/fusion_gru optimization,
+        operators/fused/fusion_lstm_op.cc:190 — "x·Wx for the whole batch
+        before the recurrence"), so the scan body carries only the small
+        h·W_hh recurrent matmul + gates.  ``step(carry, gi_t, w_hh,
+        b_hh)`` consumes the pre-projected gate input."""
         mode = self.MODE
 
-        def step(carry, xt, w_ih, w_hh, b_ih, b_hh):
+        def step(carry, gi, w_hh, b_hh):
             if mode.startswith("LSTM"):
                 hp, cp = carry
-                z = xt @ w_ih.T + hp @ w_hh.T + b_ih + b_hh
+                z = gi + hp @ w_hh.T + b_hh
                 i, f, g, o = jnp.split(z, 4, axis=-1)
                 i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
                            jax.nn.sigmoid(o))
@@ -278,7 +285,6 @@ class _RNNBase(Layer):
                 return (hn, cn), hn
             if mode.startswith("GRU"):
                 hp = carry
-                gi = xt @ w_ih.T + b_ih
                 gh = hp @ w_hh.T + b_hh
                 ir, iz, ic = jnp.split(gi, 3, axis=-1)
                 hr, hz, hc = jnp.split(gh, 3, axis=-1)
@@ -289,7 +295,7 @@ class _RNNBase(Layer):
                 return hn, hn
             hp = carry
             act = jnp.tanh if mode.endswith("TANH") else jax.nn.relu
-            hn = act(xt @ w_ih.T + hp @ w_hh.T + b_ih + b_hh)
+            hn = act(gi + hp @ w_hh.T + b_hh)
             return hn, hn
         return step
 
@@ -340,11 +346,13 @@ class _RNNBase(Layer):
                     h0 = h0_all[idx]
                     carry0 = (h0, c0_all[idx]) if is_lstm else h0
                     seq_d = jnp.flip(out, axis=0) if d == 1 else out
+                    # fusion_lstm/fusion_gru: one big input projection for
+                    # every timestep before the recurrence
+                    gi_seq = seq_d @ w_ih.T + b_ih       # (T, B, G·H)
 
-                    def body(carry, xt_, _w_ih=w_ih, _w_hh=w_hh, _b_ih=b_ih,
-                             _b_hh=b_hh):
-                        return step(carry, xt_, _w_ih, _w_hh, _b_ih, _b_hh)
-                    carry_f, ys = jax.lax.scan(body, carry0, seq_d)
+                    def body(carry, gi_t, _w_hh=w_hh, _b_hh=b_hh):
+                        return step(carry, gi_t, _w_hh, _b_hh)
+                    carry_f, ys = jax.lax.scan(body, carry0, gi_seq)
                     if d == 1:
                         ys = jnp.flip(ys, axis=0)
                     dir_outs.append(ys)

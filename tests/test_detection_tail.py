@@ -1,0 +1,145 @@
+"""Detection-tail ops (round-4 verdict item 8): R-CNN/RetinaNet target
+stages + roi_perspective_transform, numeric OpTest-style checks.
+
+Reference: operators/detection/rpn_target_assign_op.cc,
+generate_proposal_labels_op.cc, generate_mask_labels_op.cc +
+mask_util.cc, retinanet_detection_output_op.cc,
+roi_perspective_transform_op.cu.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def test_rpn_target_assign_basic():
+    anchors = np.asarray([
+        [0, 0, 10, 10],      # overlaps gt0 well
+        [1, 1, 11, 11],      # overlaps gt0 moderately
+        [50, 50, 60, 60],    # background
+        [100, 100, 110, 110],  # background
+        [4, 4, 14, 14],      # middling overlap -> ignore band
+    ], np.float32)
+    gt = [np.asarray([[0, 0, 10, 10]], np.float32)]
+    loc, score, tgt_bbox, tgt_label, inw = V.rpn_target_assign(
+        anchors, gt, rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+        use_random=False, rpn_straddle_thresh=-1)
+    loc = loc.numpy()
+    lab = tgt_label.numpy()
+    # anchor 0 is a perfect match -> fg; anchors 2,3 bg
+    assert 0 in loc
+    assert set(lab.tolist()) <= {0, 1}
+    assert (lab == 1).sum() == len(loc)
+    # the perfect-match anchor's bbox target is (0,0,0,0)
+    i0 = list(loc).index(0)
+    np.testing.assert_allclose(tgt_bbox.numpy()[i0], 0.0, atol=1e-6)
+    assert inw.numpy().shape == (len(loc), 4)
+
+
+def test_rpn_target_assign_force_matches_best_anchor():
+    # no anchor reaches the 0.7 threshold, but every gt must claim its
+    # argmax anchor
+    anchors = np.asarray([[0, 0, 8, 8], [20, 20, 30, 30]], np.float32)
+    gt = [np.asarray([[0, 0, 16, 16]], np.float32)]
+    loc, score, tb, lab, _ = V.rpn_target_assign(
+        anchors, gt, use_random=False, rpn_straddle_thresh=-1)
+    assert 0 in loc.numpy()
+
+
+def test_retinanet_target_assign_labels_and_fgnum():
+    anchors = np.asarray([
+        [0, 0, 10, 10], [40, 40, 50, 50], [0, 0, 9, 11]], np.float32)
+    gt = [np.asarray([[0, 0, 10, 10]], np.float32)]
+    gl = [np.asarray([7], np.int64)]
+    loc, score, tb, lab, inw, fg_num = V.retinanet_target_assign(
+        anchors, gt, gl, positive_overlap=0.5, negative_overlap=0.4)
+    lab = lab.numpy()
+    assert int(fg_num.numpy()[0]) >= 1
+    assert 7 in lab            # class label, not 0/1
+    assert (lab == 0).sum() >= 1
+
+
+def test_generate_proposal_labels_sampling_and_targets():
+    rois = [np.asarray([
+        [0, 0, 10, 10],       # fg vs gt0
+        [0, 0, 9, 12],        # fg-ish
+        [30, 30, 42, 42],     # bg
+        [60, 60, 70, 70],     # bg
+    ], np.float32)]
+    gcls = [np.asarray([3], np.int64)]
+    crowd = [np.asarray([0], np.int64)]
+    gt = [np.asarray([[0, 0, 10, 10]], np.float32)]
+    (out_rois, labels, tgts, inw, outw, nums) = V.generate_proposal_labels(
+        rois, gcls, crowd, gt, batch_size_per_im=6, fg_fraction=0.5,
+        fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+        class_nums=5, use_random=False)
+    labels = labels.numpy()
+    tgts = tgts.numpy()
+    assert int(nums.numpy()[0]) == len(labels)
+    fg = labels > 0
+    assert fg.any() and (labels == 0).any()
+    assert (labels[fg] == 3).all()
+    # fg targets live at the class-3 slot, nowhere else
+    assert np.abs(tgts[fg][:, 12:16]).sum() >= 0     # slot exists
+    assert np.abs(tgts[~fg]).sum() == 0
+    assert (inw.numpy()[fg][:, 12:16] == 1).all()
+    assert (outw.numpy() == (inw.numpy() > 0)).all()
+
+
+def test_generate_mask_labels_rasterizes_polygon():
+    # square polygon covering the left half of the roi
+    rois = [np.asarray([[0, 0, 16, 16]], np.float32)]
+    labels = [np.asarray([2], np.int64)]
+    crowd = [np.asarray([0], np.int64)]
+    segms = [[[np.asarray([[0, 0], [8, 0], [8, 16], [0, 16]],
+                          np.float32)]]]
+    gcls = [np.asarray([2], np.int64)]
+    mask_rois, has_mask, mask = V.generate_mask_labels(
+        None, gcls, crowd, segms, rois, labels, num_classes=4,
+        resolution=8)
+    m = mask.numpy().reshape(1, 4, 8, 8)
+    assert int(has_mask.numpy()[0]) == 1
+    # class-2 plane holds the half mask; other planes are -1
+    assert (m[0, 0] == -1).all() and (m[0, 3] == -1).all()
+    plane = m[0, 2]
+    assert (plane[:, :3] == 1).all()      # left half inside
+    assert (plane[:, 5:] == 0).all()      # right half outside
+
+
+def test_retinanet_detection_output_decodes_and_nms():
+    anchors = [np.asarray([[0, 0, 10, 10], [40, 40, 50, 50]], np.float32)]
+    # zero deltas -> boxes == anchors
+    deltas = [np.zeros((2, 4), np.float32)]
+    scores = [np.asarray([[0.9, 0.01], [0.02, 0.8]], np.float32)]
+    out = V.retinanet_detection_output(
+        deltas, scores, anchors, im_info=np.asarray([100, 100, 1.0]),
+        score_threshold=0.05)
+    out = out.numpy()
+    assert out.shape == (2, 6)
+    assert out[0, 1] >= out[1, 1]              # sorted by score
+    best = out[0]
+    assert best[0] == 0.0 and abs(best[1] - 0.9) < 1e-6
+    np.testing.assert_allclose(best[2:], [0, 0, 10, 10], atol=1e-4)
+
+
+def test_roi_perspective_transform_identity_quad():
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal((1, 2, 12, 12)).astype(np.float32)
+    # axis-aligned quad == plain crop of a 4x4 region, upsampled to 4x4
+    # grid exactly on pixel centers
+    quad = np.asarray([[2, 3, 5, 3, 5, 6, 2, 6]], np.float32)
+    out = V.roi_perspective_transform(paddle.to_tensor(img), quad, 4, 4)
+    o = out.numpy()
+    assert o.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(o[0, :, 0, 0], img[0, :, 3, 2], atol=1e-4)
+    np.testing.assert_allclose(o[0, :, 3, 3], img[0, :, 6, 5], atol=1e-4)
+
+
+def test_roi_perspective_transform_grad_flows():
+    img = paddle.to_tensor(np.ones((1, 1, 8, 8), np.float32))
+    img.stop_gradient = False
+    quad = np.asarray([[0, 0, 7, 0, 7, 7, 0, 7]], np.float32)
+    out = V.roi_perspective_transform(img, quad, 4, 4)
+    out.sum().backward()
+    g = img.grad.numpy()
+    assert np.isfinite(g).all() and g.sum() > 0

@@ -84,8 +84,12 @@ def test_gpt_loss_fused_path_matches_xla_path():
 
     from paddle_tpu.parallel.mesh import get_mesh, make_mesh, set_mesh
 
-    cfg = gpt_tiny(num_layers=2, remat=False)
+    # hidden_size must satisfy fused_ce.supported (H % 128 == 0) or the
+    # flag silently falls through to the unfused path and the test
+    # compares XLA with itself
+    cfg = gpt_tiny(num_layers=2, remat=False, hidden_size=128)
     model = GPT(cfg)
+    assert fused_ce.supported(2 * 128, cfg.hidden_size)
     ids = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, size=(2, 128)).astype(np.int32))
     prev = get_mesh()
