@@ -317,10 +317,10 @@ def bench_widedeep_ps(on_accel, extra_legs=True):
     # AsyncCommunicator.  vs_baseline = remote/in-process ratio. ---------
     import subprocess
     import sys as _sys
-    from paddle_tpu.distributed.ps.service import (PsClient,
+    from paddle_tpu.distributed.ps.service import (SERVER_BOOT, PsClient,
                                                    RemoteEmbeddingTable)
     srv = subprocess.Popen(
-        [_sys.executable, "-m", "paddle_tpu.distributed.ps.service",
+        [_sys.executable, "-c", SERVER_BOOT,
          "--port", "0", "--table", f"emb:{V}:{E + 1}:adagrad:0.05",
          "--n-workers", "1"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,

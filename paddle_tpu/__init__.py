@@ -293,3 +293,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     """static data layer → InputSpec (the capture-tier equivalent)."""
     from paddle_tpu.static import InputSpec
     return InputSpec(shape, dtype=dtype, name=name)
+
+
+# last: the 1.x compat namespace closes the import cycle over this module
+from paddle_tpu import fluid  # noqa: E402,F401

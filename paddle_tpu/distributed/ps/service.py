@@ -424,6 +424,19 @@ def serve(port: int, table_specs: Sequence[str], host: str = "127.0.0.1",
     srv.serve_forever()
 
 
+# Spawn recipe for a server subprocess: the server is host-tier only
+# (numpy tables + TCP) and must NOT contend for the accelerator the
+# trainer holds — and the platform override must land BEFORE any
+# paddle_tpu import (a ``-m paddle_tpu...`` child imports the package
+# first, which initializes the backend; the env var alone is not
+# honored once the plugin is registered).  Use:
+#   subprocess.Popen([sys.executable, "-c", SERVER_BOOT, *args])
+SERVER_BOOT = ("import jax, sys; "
+               "jax.config.update('jax_platforms', 'cpu'); "
+               "from paddle_tpu.distributed.ps.service import _main; "
+               "sys.exit(_main())")
+
+
 def _main():
     ap = argparse.ArgumentParser(description="paddle_tpu PS shard server")
     ap.add_argument("--port", type=int, default=0)

@@ -1,0 +1,87 @@
+"""``paddle.fluid`` compat namespace (the 1.x/2.0-era import surface).
+
+Reference: python/paddle/fluid/__init__.py — the pre-2.0 API root that
+2.0-era scripts still import for ``fluid.layers``, ``fluid.dygraph``,
+``fluid.io`` and the Place/ParamAttr types.
+
+Scope (same design as ``paddle_tpu.static``): everything that operates
+on *values* maps directly onto the 2.x functional surface; the
+static-graph *program builders* (Program/Executor/scopes and the
+param-creating layers like ``layers.fc``) raise with a pointer to the
+TPU-native replacement — a documented decision, not an accident
+(SURVEY.md: ProgramDesc/executors are n/a-by-design under XLA).
+"""
+from __future__ import annotations
+
+import paddle_tpu as _paddle
+from paddle_tpu.core import (CPUPlace, CUDAPinnedPlace, CUDAPlace,
+                             TPUPlace, Tensor)
+from paddle_tpu.nn.layer.common import ParamAttr
+from paddle_tpu import regularizer  # noqa: F401
+from paddle_tpu.framework import io as _fio
+
+from paddle_tpu.fluid import layers  # noqa: E402,F401
+from paddle_tpu.fluid import dygraph  # noqa: E402,F401
+from paddle_tpu.fluid import initializer  # noqa: E402,F401
+from paddle_tpu.fluid import io  # noqa: E402,F401
+from paddle_tpu.fluid import optimizer  # noqa: E402,F401
+
+__all__ = ["layers", "dygraph", "initializer", "io", "optimizer",
+           "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "TPUPlace",
+           "ParamAttr", "LoDTensor", "core", "default_main_program",
+           "default_startup_program", "Program", "Executor",
+           "program_guard", "regularizer"]
+
+LoDTensor = Tensor
+
+
+class _Core:
+    """Minimal ``fluid.core`` stand-in (VarDesc dtype enum + Places)."""
+
+    CPUPlace = CPUPlace
+    CUDAPlace = CUDAPlace
+    CUDAPinnedPlace = CUDAPinnedPlace
+
+    class VarDesc:
+        class VarType:
+            FP16 = "float16"
+            BF16 = "bfloat16"
+            FP32 = "float32"
+            FP64 = "float64"
+            INT8 = "int8"
+            INT16 = "int16"
+            INT32 = "int32"
+            INT64 = "int64"
+            BOOL = "bool"
+
+
+core = _Core()
+
+
+def _static_only(name):
+    raise RuntimeError(
+        f"fluid.{name} is static-graph machinery the TPU-native runtime "
+        f"replaces: capture with paddle_tpu.jit (to_static/TrainStep) "
+        f"instead — see MIGRATING.md ('Static graph').")
+
+
+def default_main_program():
+    _static_only("default_main_program")
+
+
+def default_startup_program():
+    _static_only("default_startup_program")
+
+
+def program_guard(*a, **k):
+    _static_only("program_guard")
+
+
+from paddle_tpu.static import Executor, Program  # noqa: E402,F401
+
+save = _fio.save
+load = _fio.load
+
+
+def is_compiled_with_cuda():
+    return False

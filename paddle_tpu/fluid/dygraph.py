@@ -1,0 +1,50 @@
+"""``fluid.dygraph`` compat (reference: python/paddle/fluid/dygraph/ —
+the 1.x imperative surface 2.0 scripts still import)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import paddle_tpu as _p
+from paddle_tpu.core import Tensor
+from paddle_tpu.nn import Layer, LayerList, ParameterList, Sequential
+from paddle_tpu.autograd import no_grad  # noqa: F401
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
+
+__all__ = ["Layer", "LayerList", "ParameterList", "Sequential",
+           "to_variable", "guard", "enabled", "enable_dygraph",
+           "disable_dygraph", "no_grad", "DataParallel", "grad"]
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """1.x name for to_tensor."""
+    t = _p.to_tensor(np.asarray(value))
+    if dtype is not None:
+        t = t.astype(dtype)
+    return t
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Dygraph IS the default execution model here — the guard is a
+    documented no-op kept so 1.x scripts run unchanged."""
+    yield
+
+
+def enabled() -> bool:
+    return True
+
+
+def enable_dygraph(place=None):
+    return None
+
+
+def disable_dygraph():
+    raise RuntimeError(
+        "static-graph mode does not exist in the TPU-native runtime; "
+        "capture with paddle_tpu.jit instead (MIGRATING.md)")
+
+
+def grad(*args, **kwargs):
+    return _p.grad(*args, **kwargs)

@@ -1,0 +1,24 @@
+"""``fluid.initializer`` compat — 1.x initializer class names mapped to
+the 2.x nn.initializer surface (reference:
+python/paddle/fluid/initializer.py)."""
+from paddle_tpu.nn.initializer import (Assign, Constant, KaimingNormal,
+                                       KaimingUniform, Normal,
+                                       TruncatedNormal, Uniform,
+                                       XavierNormal, XavierUniform)
+
+__all__ = ["Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier",
+           "MSRA", "NumpyArrayInitializer",
+           "ConstantInitializer", "UniformInitializer",
+           "NormalInitializer", "XavierInitializer", "MSRAInitializer"]
+
+# 1.x aliases (fluid exported both Foo and FooInitializer)
+Xavier = XavierNormal
+MSRA = KaimingNormal
+NumpyArrayInitializer = Assign
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+TruncatedNormalInitializer = TruncatedNormal
+KaimingUniformInitializer = KaimingUniform

@@ -1,0 +1,43 @@
+"""``fluid.io`` compat (reference: python/paddle/fluid/io.py — 1.x
+save/load + the reader decorators that predate DataLoader)."""
+from __future__ import annotations
+
+from paddle_tpu.framework.io import load, save  # noqa: F401
+from paddle_tpu.reader import (buffered, cache, chain, compose, firstn,
+                               map_readers, shuffle, xmap_readers)  # noqa: F401
+from paddle_tpu.io import DataLoader  # noqa: F401
+from paddle_tpu.static import (load_inference_model,
+                               save_inference_model)  # noqa: F401
+
+__all__ = ["save", "load", "save_inference_model", "load_inference_model",
+           "DataLoader", "shuffle", "buffered", "cache", "chain",
+           "compose", "firstn", "map_readers", "xmap_readers",
+           "save_params", "load_params", "save_persistables",
+           "load_persistables"]
+
+
+def _params_of(program_or_layer):
+    params = getattr(program_or_layer, "state_dict", None)
+    if params is None:
+        raise RuntimeError(
+            "fluid.io.save_params/load_params take a Layer here (there is "
+            "no Program); pass the model (MIGRATING.md)")
+    return program_or_layer
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """1.x signature kept; ``main_program`` slot takes the Layer."""
+    model = _params_of(main_program if main_program is not None
+                       else executor)
+    save(model.state_dict(), f"{dirname}/{filename or 'params'}.pdparams")
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    model = _params_of(main_program if main_program is not None
+                       else executor)
+    model.set_state_dict(
+        load(f"{dirname}/{filename or 'params'}.pdparams"))
+
+
+save_persistables = save_params
+load_persistables = load_params

@@ -1,0 +1,456 @@
+"""``fluid.layers`` — the 1.x functional surface, mapped onto the 2.x
+API (reference: python/paddle/fluid/layers/{nn,tensor,ops,control_flow,
+loss,sequence_lod,detection}.py, ~35k LoC of op wrappers).
+
+Two tiers, by design:
+  * value→value functions (elementwise/reduce/activation/shape/loss/
+    comparison/control-flow/detection/sequence) map 1:1 onto
+    paddle_tpu's functional API with their fluid-era signatures and
+    quirks (``act=`` strings, ``axis=-1`` broadcast arg, 1.x argument
+    orders) — they run eagerly AND under jit capture like everything
+    else.
+  * parameter-creating graph builders (fc, embedding, conv2d,
+    batch_norm, ...) were static-graph ops that minted persistable
+    Variables inside a Program; there is no Program here, so they raise
+    with the nn.Layer replacement named.  Unknown names raise
+    AttributeError with the same guidance (module __getattr__).
+"""
+from __future__ import annotations
+
+from functools import partial as _partial
+
+import numpy as _np
+
+import paddle_tpu as _p
+import paddle_tpu.nn.functional as _F
+from paddle_tpu import static as _static
+from paddle_tpu import tensor as _tensor
+from paddle_tpu import vision as _vision
+from paddle_tpu.core import Tensor as _T
+
+# -- activations / elementwise math (fluid/layers/ops.py) -------------------
+
+abs = _tensor.abs                               # noqa: A001
+exp = _tensor.exp
+log = _tensor.log
+sqrt = _tensor.sqrt
+rsqrt = _tensor.rsqrt
+square = _tensor.square
+floor = _tensor.floor
+ceil = _tensor.ceil
+round = _tensor.round                           # noqa: A001
+sin = _tensor.sin
+cos = _tensor.cos
+tanh = _tensor.tanh
+sigmoid = _F.sigmoid
+logsigmoid = _F.log_sigmoid
+relu = _F.relu
+relu6 = _F.relu6
+leaky_relu = _F.leaky_relu
+elu = _F.elu
+selu = _F.selu
+gelu = _F.gelu
+hard_sigmoid = _F.hardsigmoid
+hard_swish = _F.hardswish
+swish = _F.swish
+softplus = _F.softplus
+softsign = _F.softsign
+softshrink = _F.softshrink
+maxout = _F.maxout
+prelu = _F.prelu
+reciprocal = _tensor.reciprocal
+softmax = _F.softmax
+log_softmax = _F.log_softmax
+erf = _tensor.erf
+pow = _tensor.pow                               # noqa: A001
+sign = _tensor.sign
+clip = _tensor.clip
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """clip_by_norm_op: scale x down so its L2 norm is at most max_norm."""
+    norm = _tensor.sqrt(_tensor.sum(_tensor.square(x)))
+    factor = _tensor.clip(max_norm / _tensor.maximum(
+        norm, _p.to_tensor(1e-12)), max=1.0)
+    return x * factor
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return _apply_act(out, act)
+
+
+def _apply_act(out, act):
+    if act is None:
+        return out
+    fn = {"relu": _F.relu, "sigmoid": _F.sigmoid, "tanh": _tensor.tanh,
+          "softmax": _F.softmax, "gelu": _F.gelu,
+          "leaky_relu": _F.leaky_relu}.get(act)
+    if fn is None:
+        raise ValueError(f"unsupported act {act!r}")
+    return fn(out)
+
+
+# -- elementwise binary (fluid's axis-broadcast wrappers) -------------------
+
+def _elementwise(op, x, y, axis=-1, act=None, name=None):
+    if axis != -1 and getattr(y, "ndim", 0) < getattr(x, "ndim", 0):
+        # fluid's axis arg: align y's dims starting at ``axis``
+        import paddle_tpu.tensor.manipulation as _m
+        extra = x.ndim - axis - y.ndim
+        for _ in range(max(extra, 0)):
+            y = _m.unsqueeze(y, -1)
+    return _apply_act(op(x, y), act)
+
+
+elementwise_add = _partial(_elementwise, _tensor.add)
+elementwise_sub = _partial(_elementwise, _tensor.subtract)
+elementwise_mul = _partial(_elementwise, _tensor.multiply)
+elementwise_div = _partial(_elementwise, _tensor.divide)
+elementwise_min = _partial(_elementwise, _tensor.minimum)
+elementwise_max = _partial(_elementwise, _tensor.maximum)
+elementwise_mod = _partial(_elementwise, _tensor.remainder)
+elementwise_floordiv = _partial(_elementwise, _tensor.floor_divide)
+elementwise_pow = _partial(_elementwise, _tensor.pow)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """mul_op: flatten x to 2-D at x_num_col_dims, y likewise, matmul."""
+    xs = x.reshape([int(_np.prod(x.shape[:x_num_col_dims])), -1])
+    ys = y.reshape([int(_np.prod(y.shape[:y_num_col_dims])), -1])
+    return _tensor.matmul(xs, ys)
+
+
+matmul = _tensor.matmul
+bmm = _tensor.bmm
+dot = _tensor.dot
+addmm = _tensor.addmm if hasattr(_tensor, "addmm") else None
+
+
+# -- reductions (fluid dim= names) ------------------------------------------
+
+def _reduce(fn, input, dim=None, keep_dim=False, name=None):
+    return fn(input, axis=dim, keepdim=keep_dim)
+
+
+reduce_sum = _partial(_reduce, _tensor.sum)
+reduce_mean = _partial(_reduce, _tensor.mean)
+reduce_max = _partial(_reduce, _tensor.max)
+reduce_min = _partial(_reduce, _tensor.min)
+reduce_prod = _partial(_reduce, _tensor.prod)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _tensor.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _tensor.any(input, axis=dim, keepdim=keep_dim)
+
+
+mean = _tensor.mean
+sums = lambda input, out=None: _tensor.add_n(input)      # noqa: E731
+sum = _tensor.add_n                                       # noqa: A001
+logsumexp = _tensor.logsumexp
+
+
+# -- tensor creation / shape (fluid/layers/tensor.py) -----------------------
+
+fill_constant = _p.fill_constant
+zeros = lambda shape, dtype="float32", force_cpu=False: _tensor.zeros(  # noqa: E731
+    shape, dtype=dtype)
+ones = lambda shape, dtype="float32", force_cpu=False: _tensor.ones(  # noqa: E731
+    shape, dtype=dtype)
+zeros_like = _tensor.zeros_like
+ones_like = _tensor.ones_like
+full_like = _tensor.full_like
+linspace = _tensor.linspace
+range = _tensor.arange                          # noqa: A001
+arange = _tensor.arange
+assign = lambda input, output=None: _T(_np.asarray(  # noqa: E731
+    input.numpy() if isinstance(input, _T) else input))
+cast = _tensor.cast
+concat = _tensor.concat
+stack = _tensor.stack
+unstack = _tensor.unstack
+split = _tensor.split
+reshape = _tensor.reshape
+transpose = _tensor.transpose
+flatten = _tensor.flatten
+squeeze = _tensor.squeeze
+unsqueeze = _tensor.unsqueeze
+expand = _tensor.expand
+expand_as = _tensor.expand_as
+tile = _tensor.tile
+slice = _tensor.slice                           # noqa: A001
+strided_slice = _tensor.strided_slice
+gather = _tensor.gather
+gather_nd = _tensor.gather_nd
+scatter = _tensor.scatter
+scatter_nd_add = _tensor.scatter_nd_add
+shard_index = _tensor.shard_index if hasattr(_tensor, "shard_index") \
+    else None
+where = _tensor.where
+argmax = _tensor.argmax
+argmin = _tensor.argmin
+argsort = lambda input, axis=-1, descending=False, name=None: (  # noqa: E731
+    _tensor.sort(input, axis=axis, descending=descending),
+    _tensor.argsort(input, axis=axis, descending=descending))
+topk = _tensor.topk
+unique = _tensor.unique
+shape = _p.shape
+rank = _p.rank
+increment = lambda x, value=1.0, in_place=True: _p.increment(  # noqa: E731
+    x, value) if hasattr(_p, "increment") else x.add_(value)
+one_hot = lambda input, depth, allow_out_of_range=False: _F.one_hot(  # noqa: E731
+    input, depth)
+diag = _tensor.diag
+eye = _tensor.eye
+cumsum = _tensor.cumsum
+crop_tensor = _tensor.crop
+pad = _F.pad
+pad2d = _F.pad2d if hasattr(_F, "pad2d") else _F.pad
+meshgrid = _tensor.meshgrid
+roll = _tensor.roll
+flip = _tensor.flip
+reverse = _tensor.flip
+
+
+# -- comparison (fluid/layers/control_flow.py + compare ops) ----------------
+
+equal = _tensor.equal
+not_equal = _tensor.not_equal
+greater_than = _tensor.greater_than
+greater_equal = _tensor.greater_equal
+less_than = _tensor.less_than
+less_equal = _tensor.less_equal
+logical_and = _tensor.logical_and
+logical_or = _tensor.logical_or
+logical_not = _tensor.logical_not
+logical_xor = _tensor.logical_xor
+isfinite = _tensor.isfinite
+has_nan = _p.has_nan
+has_inf = _p.has_inf
+
+
+# -- control flow (dual-regime, static/nn.py) -------------------------------
+
+cond = _static.nn.cond
+case = _static.nn.case
+switch_case = _static.nn.switch_case
+while_loop = _static.nn.while_loop
+
+
+# -- losses (fluid/layers/loss.py) ------------------------------------------
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  name=None):
+    """fluid semantics: ``input`` is POST-softmax probabilities and the
+    result keeps the per-example shape (no mean)."""
+    eps = 1e-12
+    if soft_label:
+        return -_tensor.sum(label * _tensor.log(input + eps), axis=-1,
+                            keepdim=True)
+    g = _tensor.gather_nd(
+        input, _tensor.stack(
+            [_tensor.arange(0, int(input.shape[0]), dtype="int64"),
+             label.reshape([-1]).astype("int64")], axis=1))
+    return -_tensor.log(g + eps).reshape([-1, 1])
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = _F.cross_entropy(logits, label, soft_label=soft_label,
+                            ignore_index=ignore_index, reduction="none",
+                            axis=axis)
+    loss = _tensor.unsqueeze(loss, -1)
+    if return_softmax:
+        return loss, _F.softmax(logits, axis=axis)
+    return loss
+
+
+def square_error_cost(input, label):
+    return _tensor.square(input - label)
+
+
+def mse_loss(input, label):
+    return _F.mse_loss(input, label)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    diff = x - y
+    if inside_weight is not None:
+        diff = diff * inside_weight
+    sigma2 = sigma * sigma
+    ad = _tensor.abs(diff)
+    small = _tensor.cast(ad < (1.0 / sigma2), "float32")
+    loss = small * 0.5 * sigma2 * _tensor.square(diff) + \
+        (1.0 - small) * (ad - 0.5 / sigma2)
+    if outside_weight is not None:
+        loss = loss * outside_weight
+    return _tensor.sum(loss, axis=-1, keepdim=True)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False, name=None):
+    loss = _F.binary_cross_entropy_with_logits(x, label, reduction="none")
+    if normalize:
+        n = _tensor.sum(_tensor.cast(label != ignore_index, "float32"))
+        loss = loss / _tensor.maximum(n, _p.to_tensor(1.0))
+    return loss
+
+
+def huber_loss(input, label, delta):
+    return _F.smooth_l1_loss(input, label, reduction="none", delta=delta)
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return _F.kl_div(x, target, reduction=reduction)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    C = int(label.shape[-1])
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / C
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from paddle_tpu.metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+# -- interpolation / vision (fluid/layers/nn.py tail) -----------------------
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="bilinear", align_corners=align_corners,
+                          data_format=data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    return _F.interpolate(input, size=out_shape, scale_factor=scale,
+                          mode="nearest", data_format=data_format)
+
+
+grid_sampler = _F.grid_sample
+affine_grid = _F.affine_grid
+image_resize = resize_bilinear
+
+# detection surface re-export (fluid/layers/detection.py)
+yolo_box = _vision.ops.yolo_box
+yolov3_loss = _vision.ops.yolo_loss
+prior_box = _vision.ops.prior_box
+density_prior_box = _vision.ops.density_prior_box
+anchor_generator = _vision.ops.anchor_generator
+box_coder = _vision.ops.box_coder
+box_clip = _vision.ops.box_clip
+iou_similarity = _vision.ops.iou_similarity
+bipartite_match = _vision.ops.bipartite_match
+target_assign = _vision.ops.target_assign
+multiclass_nms = _vision.ops.multiclass_nms
+matrix_nms = _vision.ops.matrix_nms
+locality_aware_nms = _vision.ops.locality_aware_nms
+distribute_fpn_proposals = _vision.ops.distribute_fpn_proposals
+collect_fpn_proposals = _vision.ops.collect_fpn_proposals
+generate_proposals = _vision.ops.generate_proposals
+generate_proposal_labels = _vision.ops.generate_proposal_labels
+generate_mask_labels = _vision.ops.generate_mask_labels
+rpn_target_assign = _vision.ops.rpn_target_assign
+retinanet_target_assign = _vision.ops.retinanet_target_assign
+retinanet_detection_output = _vision.ops.retinanet_detection_output
+sigmoid_focal_loss = _vision.ops.sigmoid_focal_loss
+roi_align = _vision.ops.roi_align
+roi_pool = _vision.ops.roi_pool
+roi_perspective_transform = _vision.ops.roi_perspective_transform
+polygon_box_transform = _vision.ops.polygon_box_transform
+box_decoder_and_assign = _vision.ops.box_decoder_and_assign
+mine_hard_examples = _vision.ops.mine_hard_examples
+
+
+# -- sequence ops (tensor/sequence.py ragged encodings) ---------------------
+
+def _seq(name):
+    import paddle_tpu.tensor.sequence as _s
+    return getattr(_s, name, None)
+
+
+sequence_pad = _seq("sequence_pad")
+sequence_unpad = _seq("sequence_unpad")
+sequence_mask = _seq("sequence_mask")
+sequence_pool = _seq("sequence_pool")
+sequence_expand = _seq("sequence_expand")
+sequence_softmax = _seq("sequence_softmax")
+sequence_reverse = _seq("sequence_reverse")
+sequence_concat = _seq("sequence_concat")
+
+
+# -- dropout / norm functionals ---------------------------------------------
+
+def dropout(x, dropout_prob, is_test=None, seed=None,
+            dropout_implementation="downgrade_in_infer", name=None):
+    mode = ("downscale_in_infer"
+            if dropout_implementation == "downgrade_in_infer"
+            else "upscale_in_train")
+    return _F.dropout(x, p=dropout_prob,
+                      training=(not is_test) if is_test is not None
+                      else True, mode=mode)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+# -- parameter-creating graph builders: raise with the replacement ----------
+
+_STATIC_BUILDERS = {
+    "fc": "nn.Linear",
+    "embedding": "nn.Embedding",
+    "conv2d": "nn.Conv2D",
+    "conv3d": "nn.Conv3D",
+    "conv2d_transpose": "nn.Conv2DTranspose",
+    "batch_norm": "nn.BatchNorm2D",
+    "instance_norm": "nn.InstanceNorm2D",
+    "layer_norm": "nn.LayerNorm",
+    "group_norm": "nn.GroupNorm",
+    "pool2d": "nn.MaxPool2D / nn.AvgPool2D",
+    "pool3d": "nn.MaxPool3D / nn.AvgPool3D",
+    "data": "plain function arguments (trace captures shapes)",
+    "create_parameter": "paddle_tpu.nn.Layer.create_parameter",
+    "nce": "paddle_tpu.nn.functional.nce",
+    "hsigmoid": "paddle_tpu.nn.functional.hsigmoid_loss",
+    "lstm": "nn.LSTM",
+    "gru_unit": "nn.GRUCell",
+    "dynamic_lstm": "nn.LSTM",
+    "dynamic_gru": "nn.GRU",
+    "crf_decoding": "paddle_tpu.text (ViterbiDecoder)",
+    "linear_chain_crf": "paddle_tpu.text (LinearChainCrf role)",
+}
+
+
+def _make_builder_stub(name, repl):
+    def stub(*a, **k):
+        raise RuntimeError(
+            f"fluid.layers.{name} was a static-graph op that created "
+            f"persistable parameters inside a Program; the TPU-native "
+            f"equivalent is {repl} (see MIGRATING.md).")
+    stub.__name__ = name
+    return stub
+
+
+for _name, _repl in _STATIC_BUILDERS.items():
+    if _name not in globals() or globals()[_name] is None:
+        globals()[_name] = _make_builder_stub(_name, _repl)
+
+
+def __getattr__(name):
+    raise AttributeError(
+        f"fluid.layers.{name} is not in the compat surface; the 2.x API "
+        f"(paddle_tpu.nn/functional/tensor) is the supported path — see "
+        f"MIGRATING.md for the mapping table.")

@@ -1,0 +1,46 @@
+"""``fluid.optimizer`` compat — 1.x optimizer class names and their
+``parameter_list``/``regularization`` keyword spellings (reference:
+python/paddle/fluid/optimizer.py)."""
+from __future__ import annotations
+
+from paddle_tpu import optimizer as _opt
+
+__all__ = ["SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+           "Adagrad", "AdagradOptimizer", "Adam", "AdamOptimizer",
+           "Adamax", "AdamaxOptimizer", "RMSProp", "RMSPropOptimizer",
+           "Lamb", "LambOptimizer"]
+
+
+def _fluidify(cls):
+    """Accept the 1.x keyword spellings on a 2.x optimizer class."""
+
+    class Fluid(cls):
+        def __init__(self, learning_rate=0.001, parameter_list=None,
+                     regularization=None, grad_clip=None, name=None,
+                     **kw):
+            kw.setdefault("parameters", parameter_list)
+            kw.setdefault("weight_decay", regularization)
+            kw.pop("name", None)
+            super().__init__(learning_rate=learning_rate,
+                             grad_clip=grad_clip, **kw)
+
+        def minimize(self, loss, startup_program=None, parameter_list=None,
+                     no_grad_set=None):
+            """1.x loop: backward + apply in one call."""
+            loss.backward()
+            self.step()
+            self.clear_grad()
+            return [], []
+
+    Fluid.__name__ = cls.__name__
+    Fluid.__qualname__ = cls.__name__
+    return Fluid
+
+
+SGD = SGDOptimizer = _fluidify(_opt.SGD)
+Momentum = MomentumOptimizer = _fluidify(_opt.Momentum)
+Adagrad = AdagradOptimizer = _fluidify(_opt.Adagrad)
+Adam = AdamOptimizer = _fluidify(_opt.Adam)
+Adamax = AdamaxOptimizer = _fluidify(_opt.Adamax)
+RMSProp = RMSPropOptimizer = _fluidify(_opt.RMSProp)
+Lamb = LambOptimizer = _fluidify(_opt.Lamb)

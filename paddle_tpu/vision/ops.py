@@ -1,6 +1,9 @@
-"""Vision ops (parity subset of paddle/fluid/operators/detection/ — the
-reference has ~50 CV ops; these are the ones its model zoo + tests
-exercise most: box utils, NMS, RoI align/pool, yolo decode).
+"""Vision ops — full parity with paddle/fluid/operators/detection/
+(box utils, NMS family, RoI align/pool/perspective, yolo decode+loss,
+prior/density/anchor boxes, FPN ops, SSD target stages, and the
+R-CNN/RetinaNet training-target stages rpn_target_assign /
+generate_proposal_labels / generate_mask_labels /
+retinanet_{target_assign,detection_output}).
 """
 from __future__ import annotations
 
@@ -1116,7 +1119,7 @@ def _assign_anchors(anchors, gt, pos_overlap, neg_overlap):
     Force-match the best anchor of every gt (rpn_target_assign_op.cc's
     argmax-per-gt rule)."""
     labels = np.full((len(anchors),), -1, np.int64)
-    if len(gt) == 0:
+    if len(gt) == 0 or len(anchors) == 0:
         return labels, np.zeros((len(anchors),), np.int64), None
     iou = _iou_np(anchors, gt)
     best_gt = iou.argmax(axis=1)
@@ -1190,23 +1193,22 @@ def rpn_target_assign(anchor_box, gt_boxes, is_crowd=None, im_info=None,
         else:
             tgt_bbox.append(np.zeros((0, 4), np.float32))
         tgt_label.append(labels[sel])
-    return (Tensor(np.concatenate(loc_idx).astype(np.int32)),
+    loc = np.concatenate(loc_idx).astype(np.int32)
+    return (Tensor(loc),
             Tensor(np.concatenate(score_idx).astype(np.int32)),
             Tensor(np.concatenate(tgt_bbox)),
             Tensor(np.concatenate(tgt_label).astype(np.int32)),
-            Tensor(np.ones((sum(map(len, loc_idx)) and
-                            len(np.concatenate(loc_idx)) or 0, 4),
-                           np.float32)))
+            Tensor(np.ones((len(loc), 4), np.float32)))
 
 
 def retinanet_target_assign(anchor_box, gt_boxes, gt_labels,
-                            is_crowd=None, im_info=None,
-                            positive_overlap=0.5, negative_overlap=0.4,
-                            seed=0):
+                            is_crowd=None, positive_overlap=0.5,
+                            negative_overlap=0.4):
     """RetinaNet anchor assignment (reference:
     rpn_target_assign_op.cc RetinanetTargetAssign): like RPN assignment
-    but NO subsampling (focal loss owns the imbalance), class labels
-    instead of 0/1, plus fg_num for the focal-loss normalizer.
+    but NO subsampling (focal loss owns the imbalance, so there is no
+    rng and no straddle filter), class labels instead of 0/1, plus
+    fg_num for the focal-loss normalizer.
 
     Returns (loc_index, score_index, tgt_bbox, tgt_label, bbox_inside
     _weight, fg_num)."""
@@ -1295,8 +1297,9 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
         labels = np.zeros((len(sel),), np.int64)
         labels[:len(fg)] = gcls[argm[fg]] if len(gt) else 0
         roi_sel = cand[sel]
-        # expanded per-class targets
-        C = 1 if is_cls_agnostic else class_nums
+        # expanded per-class targets; class-agnostic keeps the reference's
+        # 2-slot layout (bg slot 0 unused, fg targets at slot 1)
+        C = 2 if is_cls_agnostic else class_nums
         tgts = np.zeros((len(sel), 4 * C), np.float32)
         inw = np.zeros_like(tgts)
         if len(fg) and len(gt):

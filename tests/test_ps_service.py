@@ -222,11 +222,10 @@ class TestTwoProcess:
         """VERDICT's 2-process bar: a real PS server process + this trainer
         process, Wide&Deep-style sparse+dense model, loss parity with the
         in-process table run."""
+        from paddle_tpu.distributed.ps.service import SERVER_BOOT
         env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)     # server needs no accelerator
-        env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.Popen(
-            [sys.executable, "-m", "paddle_tpu.distributed.ps.service",
+            [sys.executable, "-c", SERVER_BOOT,
              "--port", "0", "--table", "emb:50:4:sgd:0.5",
              "--n-workers", "1"],
             stdout=subprocess.PIPE, text=True, env=env,

@@ -21,10 +21,19 @@ JAX_PLATFORMS=cpu python tools/print_signatures.py --check
 
 if [ -f tools/op_bench_baseline.json ]; then
   echo "== op benchmark regression gate =="
-  # threshold sized for remote-chip timing variance (the tunnel adds
-  # up to ~2x run-to-run jitter); real regressions are larger still
-  python tools/op_bench.py --compare tools/op_bench_baseline.json \
-      --threshold 1.0 --iters 20
+  if [ -f tools/op_bench_thresholds.json ]; then
+    # per-op thresholds sized from the measured run-to-run distribution
+    # (perf/variance_study.py, max(0.15, 6×CV)); the gate is verified to
+    # catch a planted 1.3x regression (tests/test_op_bench_gate.py)
+    python tools/op_bench.py --compare tools/op_bench_baseline.json \
+        --thresholds tools/op_bench_thresholds.json --iters 20
+  else
+    # no measured distribution yet: blanket fallback wide enough for
+    # tunnel jitter — run perf/variance_study.py on the chip to arm
+    # the real per-op thresholds
+    python tools/op_bench.py --compare tools/op_bench_baseline.json \
+        --threshold 1.0 --iters 20
+  fi
 else
   echo "== op benchmark gate skipped (no tools/op_bench_baseline.json) =="
 fi

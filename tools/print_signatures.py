@@ -23,6 +23,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# signature walking never needs the accelerator; pin CPU before the
+# paddle_tpu import so the tool runs even while a trainer holds the chip
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "API.spec")
 
@@ -67,6 +73,12 @@ MODULES = [
     "paddle_tpu.version",
     "paddle_tpu.sysconfig",
     "paddle_tpu.incubate",
+    "paddle_tpu.fluid",
+    "paddle_tpu.fluid.layers",
+    "paddle_tpu.fluid.dygraph",
+    "paddle_tpu.fluid.initializer",
+    "paddle_tpu.fluid.io",
+    "paddle_tpu.fluid.optimizer",
     "paddle_tpu.incubate.optimizer",
     "paddle_tpu.utils",
 ]
