@@ -89,7 +89,7 @@ _CONFIG_DEFAULTS: Dict[str, Dict[str, Any]] = {
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},
     "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
     "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
-                    "sparsity": [0.999]},
+                    "sparsity": [0.999], "momentum": 0.9},
     "lamb_configs": {"lamb_weight_decay": 0.01,
                      "exclude_from_weight_decay": []},
     "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,

@@ -64,6 +64,15 @@ class CompiledStrategy:
                 model, loss_fn, optimizer, mesh=self.mesh,
                 k_steps=max(1, k), begin_step=cfg.get("begin_step", 1),
                 adaptive=adaptive, **dp_meta_kw)
+        if "DGCOptimizer" in self.applied_meta_list:
+            from paddle_tpu.parallel.dp_meta import DGCTrainStep
+            cfg = self.strategy.dgc_configs
+            return DGCTrainStep(
+                model, loss_fn, optimizer, mesh=self.mesh,
+                momentum=cfg.get("momentum", 0.9),
+                sparsity=cfg.get("sparsity", [0.999]),
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1), **dp_meta_kw)
         if "FP16AllReduceOptimizer" in self.applied_meta_list:
             from paddle_tpu.parallel.dp_meta import (
                 CompressedAllReduceTrainStep)
@@ -157,10 +166,22 @@ def compile_strategy(strategy: Optional[DistributedStrategy],
                 f"meta-optimizer exclusion DAG)")
         applied.append(name)
     if strategy.dgc:
-        # top-k sparse allreduce: the bandwidth motivation doesn't apply on
-        # ICI and XLA's reduce stays dense — record as skipped, not applied
-        skipped.append(("DGCOptimizer",
-                        "n/a on ICI: XLA allreduce stays dense"))
+        if pure_dp_conflicts:
+            raise ValueError(
+                f"DGCOptimizer is a pure data-parallel strategy and cannot "
+                f"compose with {pure_dp_conflicts} (reference meta-opt DAG)")
+        if strategy.localsgd or strategy.adaptive_localsgd:
+            raise ValueError(
+                "DGC compresses the gradient exchange; LocalSGD replaces "
+                "it with parameter averaging — pick one")
+        if strategy.fp16_allreduce:
+            raise ValueError(
+                "DGC and fp16_allreduce both own the gradient exchange — "
+                "pick one")
+        # real top-k sparse exchange (all_gather of k values+indices per
+        # tensor) — the win is on DCN multi-host; on a single-pod ICI mesh
+        # a dense psum is usually faster, which the strategy doc notes
+        applied.append("DGCOptimizer")
     if strategy.lamb:
         applied.append("LambOptimizer")
         optimizer_swap = "lamb"
@@ -179,7 +200,7 @@ def compile_strategy(strategy: Optional[DistributedStrategy],
         applied.append("FP16AllReduceOptimizer")
     owns_dp_comm = any(m in applied for m in (
         "LocalSGDOptimizer", "AdaptiveLocalSGDOptimizer",
-        "FP16AllReduceOptimizer"))
+        "FP16AllReduceOptimizer", "DGCOptimizer"))
     if (mesh.shape.get("dp", 1) > 1 and not owns_dp_comm) \
             or len(applied) == 0:
         applied.append("GraphExecutionOptimizer")  # plain dp allreduce tier

@@ -37,16 +37,16 @@ from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.parallel.mesh import get_mesh
 from paddle_tpu.tensor.random import default_generator
 
-__all__ = ["LocalSGDTrainStep", "CompressedAllReduceTrainStep"]
+__all__ = ["LocalSGDTrainStep", "CompressedAllReduceTrainStep",
+           "DGCTrainStep"]
 
 
-def _require_pure_dp(mesh: Mesh):
+def _require_pure_dp(mesh: Mesh, who: str = "this strategy"):
     extra = {a: s for a, s in mesh.shape.items() if a != "dp" and s > 1}
     if extra:
         raise ValueError(
-            f"LocalSGD / compressed-allreduce are pure data-parallel "
-            f"strategies (as in the reference meta-opt DAG); mesh also has "
-            f"{extra}")
+            f"{who} is a pure data-parallel strategy (as in the reference "
+            f"meta-opt DAG); mesh also has {extra}")
 
 
 def _loss_closure(model: Layer, loss_fn: Callable, amp_level=None,
@@ -104,7 +104,7 @@ class LocalSGDTrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh or get_mesh()
-        _require_pure_dp(self.mesh)
+        _require_pure_dp(self.mesh, "LocalSGD")
         self.dp = self.mesh.shape.get("dp", 1)
         self.k_steps = int(k_steps)
         self._init_k = int(k_steps)
@@ -249,7 +249,7 @@ class CompressedAllReduceTrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh or get_mesh()
-        _require_pure_dp(self.mesh)
+        _require_pure_dp(self.mesh, "compressed-allreduce")
         self.compress_dtype = jnp.dtype(compress_dtype)
         self.amp_level = amp_level
         self.amp_dtype = jnp.bfloat16 if str(amp_dtype) in (
@@ -312,4 +312,177 @@ class CompressedAllReduceTrainStep:
             p._data = new_params[n]
         for n, b in named_buffers.items():
             b._data = new_buffers[n]
+        return Tensor(loss)
+
+
+class DGCTrainStep:
+    """Deep Gradient Compression (reference:
+    fleet/meta_optimizers/dgc_optimizer.py + operators/dgc_op.*, after
+    Lin et al. '18): each replica keeps a momentum buffer ``u`` and an
+    error accumulator ``v``; every step only the top-k entries of ``v``
+    (by magnitude, per tensor) are exchanged, with error feedback and
+    momentum-factor masking on the rest.
+
+    TPU-native collective: the top-k is a FIXED-size ``lax.top_k``
+    (k static per sparsity stage), and the exchange is an
+    ``all_gather`` of (values, indices) over ``dp`` followed by a
+    scatter-add — the wire really carries k·dp·8 bytes instead of the
+    dense tensor, which is the point of DGC on DCN-connected hosts.
+    (On a single-pod ICI mesh a dense psum is usually faster — the
+    strategy docstring says so — but the semantics here are the
+    reference's, so multi-host DCN deployments get the real algorithm.)
+
+    Momentum correction lives INSIDE the compressor (the reference
+    forces DGCMomentumOptimizer for the same reason); pair it with a
+    plain SGD outer optimizer unless you know better.
+
+    Rampup: ``sparsity`` is the reference's stage list; before
+    ``rampup_begin_step`` the step runs a dense pmean, then stages
+    advance every ``rampup_step`` calls (one recompile per distinct k).
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 mesh: Optional[Mesh] = None, momentum: float = 0.9,
+                 sparsity=(0.999,), rampup_begin_step: int = 0,
+                 rampup_step: int = 1, amp_level=None,
+                 amp_dtype="bfloat16", recompute=False):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh or get_mesh()
+        _require_pure_dp(self.mesh, "DGC")
+        self.dp = self.mesh.shape.get("dp", 1)
+        self.momentum = float(momentum)
+        self.sparsity = [float(s) for s in sparsity]
+        self.rampup_begin_step = int(rampup_begin_step)
+        self.rampup_step = max(1, int(rampup_step))
+        self.amp_level = amp_level
+        self.amp_dtype = jnp.bfloat16 if str(amp_dtype) in (
+            "bfloat16", "bf16") else jnp.float16
+        self.recompute = recompute
+        self._opt_states = None
+        self._uv = None          # per-replica (dp, ...) momentum/error
+        self._fns = {}           # sparsity stage -> compiled step
+        self._step = 0
+
+    # -- sparsity schedule --------------------------------------------------
+    def _current_sparsity(self) -> float:
+        if self._step < self.rampup_begin_step:
+            return 0.0
+        stage = (self._step - self.rampup_begin_step) // self.rampup_step
+        return self.sparsity[min(stage, len(self.sparsity) - 1)]
+
+    def _ensure_uv(self, params):
+        if self._uv is not None:
+            return
+        def z(p):
+            return jnp.zeros((self.dp,) + p.shape, jnp.float32)
+        u = {n: z(p) for n, p in params.items()
+             if jnp.issubdtype(p.dtype, jnp.floating)}
+        v = {n: z(p) for n, p in params.items()
+             if jnp.issubdtype(p.dtype, jnp.floating)}
+        shard = NamedSharding(self.mesh, P("dp"))
+        self._uv = (jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, shard), u),
+            jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, shard), v))
+
+    def _build(self, n_inputs, sparsity):
+        mesh = self.mesh
+        opt = self.optimizer
+        m = self.momentum
+        dp = self.dp
+        loss_from = _loss_closure(self.model, self.loss_fn, self.amp_level,
+                                  self.amp_dtype, self.recompute)
+
+        def compress(g, u, v):
+            """One tensor: momentum correction + error feedback + top-k
+            exchange.  u, v, g are per-shard (local) values."""
+            g = g.astype(jnp.float32)
+            if sparsity <= 0.0:
+                # dense rampup stage: classic momentum on the averaged
+                # grad (the reference trains with the plain momentum
+                # optimizer until rampup_begin_step)
+                gbar = jax.lax.pmean(g, "dp")
+                u = m * u + gbar        # identical across shards
+                return u.astype(g.dtype), u, v
+            u = m * u + g
+            v = v + u
+            flat = v.reshape(-1)
+            size = flat.shape[0]
+            k = max(1, int(round(size * (1.0 - sparsity))))
+            _, idx = jax.lax.top_k(jnp.abs(flat), k)
+            vals = flat[idx]
+            g_vals = jax.lax.all_gather(vals, "dp")      # (dp, k)
+            g_idx = jax.lax.all_gather(idx, "dp")
+            dense = jnp.zeros((size,), jnp.float32).at[
+                g_idx.reshape(-1)].add(g_vals.reshape(-1)) / dp
+            # clear exchanged entries locally (error feedback + momentum
+            # factor masking)
+            flat_v = flat.at[idx].set(0.0)
+            flat_u = u.reshape(-1).at[idx].set(0.0)
+            return (dense.reshape(v.shape).astype(g.dtype),
+                    flat_u.reshape(u.shape), flat_v.reshape(v.shape))
+
+        def local(params, buffers, key, u, v, *inputs):
+            (loss, new_buffers), grads = jax.value_and_grad(
+                lambda p: loss_from(p, buffers, key, list(inputs)),
+                has_aux=True)(params)
+            out_g, out_u, out_v = {}, {}, {}
+            for n, g in grads.items():
+                if n in u:
+                    # u/v arrive as the (1, ...) per-shard block of the
+                    # (dp, ...) stacked buffers — work on the unstacked view
+                    agg, u2, v2 = compress(g, u[n][0], v[n][0])
+                    out_g[n] = agg.astype(g.dtype)  # keep the param dtype
+                    out_u[n] = u2[None]
+                    out_v[n] = v2[None]
+                else:
+                    out_g[n] = jax.lax.pmean(g, "dp")
+            return jax.lax.pmean(loss, "dp"), new_buffers, out_g, \
+                out_u, out_v
+
+        from jax import shard_map
+        in_specs = (P(), P(), P(), P("dp"), P("dp")) + (P("dp"),) * n_inputs
+        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=(P(), P(), P(), P("dp"), P("dp")),
+                           check_vma=False)
+
+        def step(params, states, buffers, key, lr, u, v, *inputs):
+            loss, new_buffers, grads, u2, v2 = mapped(
+                params, buffers, key, u, v, *inputs)
+            new_params, new_states = opt.functional_update(
+                params, grads, states, lr=lr)
+            return new_params, new_states, new_buffers, loss, u2, v2
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 5, 6))
+
+    def __call__(self, *inputs):
+        model = self.model
+        named_params = {n: p for n, p in model.named_parameters()}
+        named_buffers = {n: b for n, b in model.named_buffers()
+                         if b is not None}
+        params = {n: p._data for n, p in named_params.items()}
+        buffers = {n: b._data for n, b in named_buffers.items()}
+        if self._opt_states is None:
+            self._opt_states = self.optimizer.functional_init_states(params)
+        self._ensure_uv(params)
+        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        sp = self._current_sparsity()
+        fn = self._fns.get(sp)
+        if fn is None:
+            fn = self._fns[sp] = self._build(len(arrs), sp)
+        key = default_generator.split()
+        lr = jnp.float32(self.optimizer.get_lr())
+        u, v = self._uv
+        new_params, self._opt_states, new_buffers, loss, u2, v2 = fn(
+            params, self._opt_states, buffers, key, lr, u, v, *arrs)
+        self._uv = (u2, v2)
+        for n, p in named_params.items():
+            p._data = new_params[n]
+        for n, b in named_buffers.items():
+            b._data = new_buffers[n]
+        self.optimizer._global_step += 1
+        self._step += 1
         return Tensor(loss)

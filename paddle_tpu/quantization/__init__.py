@@ -139,18 +139,92 @@ def quant_post_weights(model: Layer, bits: int = 8) -> Dict[str, dict]:
     """post_training_quantization.py weight path: per-channel int8 weights
     + float scales for every Linear weight; returns the deployment dict
     {param_name: {"int": int8 array, "scale": [out] scales}}."""
-    qm = _qmax(bits)
     out = {}
     for name, p in model.named_parameters():
         if p._data.ndim != 2 or not name.endswith("weight"):
             continue
-        w = np.asarray(p._data, np.float32)
-        scale = np.maximum(np.abs(w).max(axis=0), 1e-8)      # per out-col
-        q = np.clip(np.round(w / scale * qm), -qm, qm).astype(np.int8)
-        out[name] = {"int": q, "scale": (scale / qm).astype(np.float32)}
+        q, scale = _quantize_weight(np.asarray(p._data), bits)
+        out[name] = {"int": q, "scale": scale}
     return out
 
 
 def dequant_weights(packed: Dict[str, dict]) -> Dict[str, np.ndarray]:
     return {n: d["int"].astype(np.float32) * d["scale"]
             for n, d in packed.items()}
+
+
+# ---------------------------------------------------------------------------
+# int8 inference EXECUTION (round 4): the deployment tier that actually
+# runs the quantized matmul, not just packs weights
+# ---------------------------------------------------------------------------
+
+
+class Int8InferenceLinear(Layer):
+    """Linear executed as an int8×int8→int32 matmul (reference role:
+    inference/tensorrt int8 + operators/fake_quantize followed by the
+    quantized kernel; TPU-native: the MXU runs s8 matmuls at 2× the
+    bf16 rate, so this is the idiomatic deployment path).
+
+    Weights: per-out-channel symmetric int8 (from quant_post_weights).
+    Activations: dynamic per-tensor abs-max (the reference's
+    moving-average observer becomes a static scale when calibrated;
+    dynamic is the calibration-free default).
+    """
+
+    def __init__(self, w_int8: np.ndarray, w_scale: np.ndarray, bias=None):
+        super().__init__()
+        self._w_q = jnp.asarray(w_int8, jnp.int8)          # (in, out)
+        self._w_scale = jnp.asarray(w_scale, jnp.float32)  # (out,)
+        self._bias = None if bias is None else jnp.asarray(
+            np.asarray(bias), jnp.float32)
+
+    def forward(self, x):
+        wq, ws, b = self._w_q, self._w_scale, self._bias
+
+        def _run(a):
+            af = a.astype(jnp.float32)
+            s_x = jnp.maximum(jnp.max(jnp.abs(af)), 1e-8) / 127.0
+            a_q = jnp.clip(jnp.round(af / s_x), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                a_q, wq, (((a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (s_x * ws)
+            if b is not None:
+                y = y + b
+            return y.astype(a.dtype) if a.dtype != jnp.float32 else y
+        from paddle_tpu.core import apply1
+        return apply1(_run, x, name="int8_linear")
+
+
+def _quantize_weight(w: np.ndarray, bits: int = 8):
+    """Per-out-channel symmetric int8 pack — the single source of truth
+    shared by quant_post_weights (pack) and Int8InferenceLinear
+    (deploy) so the two paths can never diverge numerically."""
+    qm = _qmax(bits)
+    w = np.asarray(w, np.float32)
+    scale = np.maximum(np.abs(w).max(axis=0), 1e-8)
+    q = np.clip(np.round(w / scale * qm), -qm, qm).astype(np.int8)
+    return q, (scale / qm).astype(np.float32)
+
+
+def _int8_of(linear) -> "Int8InferenceLinear":
+    q, scale = _quantize_weight(np.asarray(linear.weight._data))
+    bias = linear.bias._data if getattr(linear, "bias", None) is not None \
+        else None
+    return Int8InferenceLinear(q, scale, bias)
+
+
+def convert_to_int8_inference(model: Layer) -> Layer:
+    """Swap every nn.Linear for an Int8InferenceLinear — the PTQ deploy
+    step (post_training_quantization.py convert).  A bare Linear is
+    converted and RETURNED (it cannot be swapped in place); use the
+    return value."""
+    from paddle_tpu.nn.layer.common import Linear
+    if isinstance(model, Linear):
+        return _int8_of(model)
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, Linear):
+            model._sub_layers[name] = _int8_of(child)
+        else:
+            convert_to_int8_inference(child)
+    return model

@@ -161,6 +161,67 @@ def eager_vs_jit_bench(iters=30, batch=64):
     return out
 
 
+def eager_transformer_bench(iters=20, batch=8, seq=128, d_model=256):
+    """Eager dispatch-cache effectiveness on a transformer block (round-4
+    verdict item 9: LeNet alone doesn't show whether the ~9x transfers
+    to attention-heavy eager code).  Times a TransformerEncoderLayer
+    fwd+bwd+SGD eager step with the (fwd,vjp) cache off vs on, and
+    reports the monitor hit/miss/uncacheable counters."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.framework.flags import flag, set_flags
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((batch, seq, d_model))
+                         .astype(np.float32))
+
+    def eager_step(model, opt):
+        out = model(x)
+        loss = (out * out).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    results = {}
+    stats = {}
+    prior = flag("eager_op_jit_cache")
+    try:
+        for mode in ("nocache", "cached"):
+            paddle.seed(0)
+            model = paddle.nn.TransformerEncoderLayer(
+                d_model=d_model, nhead=4, dim_feedforward=4 * d_model)
+            opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                       parameters=model.parameters())
+            set_flags({"eager_op_jit_cache": mode == "cached"})
+            monitor.reset_all_stats()
+            for _ in range(3):
+                loss = eager_step(model, opt)
+            _sync(loss)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = eager_step(model, opt)
+            _sync(loss)
+            results[mode] = (time.perf_counter() - t0) / iters * 1e3
+            stats[mode] = {k: v for k, v in monitor.all_stats().items()
+                           if k.startswith("eager_cache")}
+    finally:
+        set_flags({"eager_op_jit_cache": prior})
+    s = stats["cached"]
+    total = sum(s.values()) or 1
+    out = {"name": "eager_transformer_block",
+           "nocache_ms": round(results["nocache"], 3),
+           "cached_ms": round(results["cached"], 3),
+           "cache_speedup": round(results["nocache"] / results["cached"],
+                                  2),
+           "hit": s.get("eager_cache_hit", 0),
+           "miss": s.get("eager_cache_miss", 0),
+           "uncacheable": s.get("eager_cache_uncacheable", 0),
+           "hit_rate": round(s.get("eager_cache_hit", 0) / total, 3)}
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def _scan_time(fn, args, reps=30):
     """Time fn amortized inside one jit (tunnel RTT would otherwise
     dominate): scan reps iterations with a data dependency, fence with a
@@ -313,6 +374,9 @@ def main(argv=None):
                     help="pallas blockwise CE vs unfused XLA")
     ap.add_argument("--fused-rnn", action="store_true",
                     help="pre-projected vs in-loop LSTM input projection")
+    ap.add_argument("--eager-transformer", action="store_true",
+                    help="eager dispatch cache on a transformer block "
+                         "+ hit-rate counters")
     ap.add_argument("--config", help="JSON list of op configs")
     ap.add_argument("--save", help="write results JSON here")
     ap.add_argument("--compare", help="baseline JSON to gate against")
@@ -332,7 +396,7 @@ def main(argv=None):
             with open(a.save, "w") as f:
                 json.dump([r], f, indent=1)
         return 0
-    if a.fused_adam or a.fused_ce or a.fused_rnn:
+    if a.fused_adam or a.fused_ce or a.fused_rnn or a.eager_transformer:
         rs = []
         if a.fused_adam:
             rs.append(fused_adam_bench())
@@ -340,6 +404,8 @@ def main(argv=None):
             rs.append(fused_ce_bench())
         if a.fused_rnn:
             rs.append(fused_rnn_bench())
+        if a.eager_transformer:
+            rs.append(eager_transformer_bench())
         if a.save:
             with open(a.save, "w") as f:
                 json.dump(rs, f, indent=1)
