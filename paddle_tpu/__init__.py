@@ -295,5 +295,6 @@ def data(name, shape, dtype="float32", lod_level=0):
     return InputSpec(shape, dtype=dtype, name=name)
 
 
-# last: the 1.x compat namespace closes the import cycle over this module
-from paddle_tpu import fluid  # noqa: E402,F401
+# last: the 1.x compat namespaces close the import cycle over this module
+from paddle_tpu import fluid  # noqa: E402
+from paddle_tpu import dataset  # noqa: E402,F401

@@ -1,0 +1,61 @@
+"""``paddle.dataset.common`` (reference: dataset/common.py) — the shared
+reader utilities 1.x scripts import; download() is a guided error in
+this zero-egress environment (md5file/split/cluster_files_reader keep
+their semantics)."""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str, save_name=None):
+    from paddle_tpu.utils.download import get_path_from_url
+    return get_path_from_url(url, os.path.join(DATA_HOME, module_name),
+                             md5sum)
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """dataset/common.py split: dump a reader into line_count-sized
+    pickle shards."""
+    if not callable(reader):
+        raise TypeError("reader should be callable")
+    lines = []
+    idx = 0
+    for d in reader():
+        lines.append(d)
+        if len(lines) == line_count:
+            with open(suffix % idx, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            idx += 1
+    if lines:
+        with open(suffix % idx, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """dataset/common.py cluster_files_reader: this trainer's shard of a
+    split() output."""
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        for i, path in enumerate(file_list):
+            if i % trainer_count == trainer_id:
+                with open(path, "rb") as f:
+                    for d in loader(f):
+                        yield d
+
+    return reader

@@ -1,0 +1,26 @@
+"""``paddle.dataset.imdb`` (reference: dataset/imdb.py) — readers
+yielding (word-id list, 0/1 label); 0 = positive, like the reference."""
+from __future__ import annotations
+
+
+def word_dict(data_file=None, cutoff=150):
+    from paddle_tpu.text.datasets import Imdb
+    return Imdb(data_file=data_file, mode="train", cutoff=cutoff).word_idx
+
+
+def _reader(mode, data_file=None, cutoff=150):
+    def reader():
+        from paddle_tpu.text.datasets import Imdb
+        ds = Imdb(data_file=data_file, mode=mode, cutoff=cutoff)
+        for ids, lab in ds:
+            yield list(ids), int(lab)
+
+    return reader
+
+
+def train(word_idx=None, data_file=None):
+    return _reader("train", data_file)
+
+
+def test(word_idx=None, data_file=None):
+    return _reader("test", data_file)

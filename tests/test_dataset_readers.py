@@ -1,0 +1,104 @@
+"""``paddle.dataset`` 1.x reader-creator surface (reference:
+python/paddle/dataset/*) — readers over generated local fixtures, plus
+the common.py split/cluster utilities."""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import dataset
+
+
+def _write_mnist(tmp, n=8):
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(n, 28, 28), dtype=np.uint8)
+    labs = rng.integers(0, 10, size=(n,), dtype=np.uint8)
+    ip = os.path.join(tmp, "imgs.gz")
+    lp = os.path.join(tmp, "labs.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labs.tobytes())
+    return ip, lp, imgs, labs
+
+
+def test_mnist_reader_format(tmp_path):
+    ip, lp, imgs, labs = _write_mnist(str(tmp_path))
+    reader = dataset.mnist.train(image_path=ip, label_path=lp)
+    samples = list(reader())
+    assert len(samples) == 8
+    x, y = samples[0]
+    assert x.shape == (784,) and x.dtype == np.float32
+    assert float(x.min()) >= -1.0 and float(x.max()) <= 1.0
+    np.testing.assert_allclose(
+        x, imgs[0].reshape(-1).astype(np.float32) / 127.5 - 1.0)
+    assert y == int(labs[0])
+
+
+def test_mnist_reader_composes_with_paddle_batch(tmp_path):
+    ip, lp, _, _ = _write_mnist(str(tmp_path))
+    batched = paddle.batch(dataset.mnist.train(image_path=ip,
+                                               label_path=lp), 3)
+    batches = list(batched())
+    assert [len(b) for b in batches] == [3, 3, 2]
+
+
+def test_uci_housing_reader(tmp_path):
+    rng = np.random.default_rng(1)
+    raw = np.concatenate([rng.standard_normal((20, 13)),
+                          rng.uniform(5, 50, (20, 1))], axis=1)
+    path = os.path.join(str(tmp_path), "housing.data")
+    np.savetxt(path, raw)
+    tr = list(dataset.uci_housing.train(data_file=path)())
+    te = list(dataset.uci_housing.test(data_file=path)())
+    assert len(tr) == 16 and len(te) == 4
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_missing_files_raise_guided_error():
+    with pytest.raises(Exception, match="[Mm][Nn][Ii][Ss][Tt]"):
+        list(dataset.mnist.train(image_path="/nonexistent/x.gz",
+                                 label_path="/nonexistent/y.gz")())
+
+
+def test_common_split_and_cluster_reader(tmp_path):
+    os.chdir(tmp_path)
+    data = [(i, i * i) for i in range(10)]
+    dataset.common.split(lambda: iter(data), 4,
+                         suffix=str(tmp_path / "part-%05d.pickle"))
+    shard0 = list(dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), trainer_count=2, trainer_id=0)())
+    shard1 = list(dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), trainer_count=2, trainer_id=1)())
+    assert sorted(shard0 + shard1) == data
+    assert len(shard0) + len(shard1) == 10
+
+
+def test_md5file(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"hello")
+    assert dataset.common.md5file(str(p)) == \
+        "5d41402abc4b2a76b9719d911017c592"
+
+
+def test_all_reader_creators_exist():
+    for mod, fns in [
+        (dataset.cifar, ["train10", "test10", "train100", "test100"]),
+        (dataset.imdb, ["train", "test", "word_dict"]),
+        (dataset.imikolov, ["train", "test", "build_dict"]),
+        (dataset.movielens, ["train", "test", "max_user_id",
+                             "max_movie_id"]),
+        (dataset.flowers, ["train", "test", "valid"]),
+        (dataset.voc2012, ["train", "test", "val"]),
+        (dataset.wmt14, ["train", "test"]),
+        (dataset.wmt16, ["train", "test", "validation"]),
+        (dataset.conll05, ["test", "get_dict"]),
+    ]:
+        for fn in fns:
+            assert callable(getattr(mod, fn)), (mod.__name__, fn)

@@ -73,6 +73,9 @@ MODULES = [
     "paddle_tpu.version",
     "paddle_tpu.sysconfig",
     "paddle_tpu.incubate",
+    "paddle_tpu.dataset",
+    "paddle_tpu.dataset.common",
+    "paddle_tpu.dataset.mnist",
     "paddle_tpu.fluid",
     "paddle_tpu.fluid.layers",
     "paddle_tpu.fluid.dygraph",
