@@ -628,7 +628,32 @@ def bench_masked_flash(on_accel):
           t_plain / t_masked)
 
 
+def _device_alive(timeout_s: int = 240) -> bool:
+    """Probe device init in a subprocess with a hard deadline: a wedged
+    accelerator lease makes jax.devices() block forever in a retry loop
+    (observed after a killed client), and a bench that hangs is worse
+    than one that reports the outage."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, numpy as np; "
+             "np.asarray(jax.numpy.ones((2, 2)).sum()); print('ok')"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    # probe BEFORE any jax/paddle import: package import itself
+    # initializes the backend, and a wedged lease blocks it forever
+    if not _device_alive():
+        _emit("device_unavailable", 0.0,
+              "accelerator init timed out (wedged lease?)", 0.0)
+        raise SystemExit(2)
+
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.parallel import make_mesh, set_mesh
