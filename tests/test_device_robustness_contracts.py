@@ -1,0 +1,53 @@
+"""Contracts for the accelerator-outage hardening (round 4): these
+recipes were earned against an actually-wedged device lease — a child
+process that initializes the accelerator backend blocks forever, so
+every host-only subprocess must pin the cpu platform BEFORE importing
+paddle_tpu, and long-running entrypoints must probe liveness with a
+deadline.  Guard the shape of the recipes so refactors can't silently
+regress them."""
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _src(*rel):
+    with open(os.path.join(REPO, *rel)) as f:
+        return f.read()
+
+
+def test_server_boot_pins_cpu_before_package_import():
+    from paddle_tpu.distributed.ps.service import SERVER_BOOT
+    upd = SERVER_BOOT.index("jax.config.update('jax_platforms', 'cpu')")
+    imp = SERVER_BOOT.index("from paddle_tpu")
+    assert upd < imp
+
+
+def test_ps_spawners_use_server_boot():
+    assert "SERVER_BOOT" in _src("bench.py")
+    assert "SERVER_BOOT" in _src("tests", "test_ps_service.py")
+    # no one spawns the raw -m module (which imports the package first)
+    for f in (("bench.py",), ("tests", "test_ps_service.py")):
+        assert "-m\", \"paddle_tpu.distributed.ps" not in _src(*f)
+
+
+def test_print_signatures_pins_cpu():
+    src = _src("tools", "print_signatures.py")
+    assert "jax.config.update(\"jax_platforms\", \"cpu\")" in src
+    assert src.index("jax_platforms") < src.index("MODULES")
+
+
+def test_bench_probes_device_liveness_first():
+    src = _src("bench.py")
+    main = src[src.index("def main():"):]
+    assert "_device_alive" in main
+    # the probe must run before the paddle import inside main
+    assert main.index("_device_alive") < main.index(
+        "import paddle_tpu as paddle")
+
+
+def test_dryrun_parent_never_touches_devices_on_accelerator():
+    src = _src("__graft_entry__.py")
+    fn = src[src.index("def dryrun_multichip"):]
+    # the platform-chain check happens before any jax.devices() call
+    assert fn.index("jax_platforms") < fn.index("len(jax.devices())")
