@@ -1,5 +1,8 @@
 """``paddle.dataset.imikolov`` (reference: dataset/imikolov.py) — PTB
-n-gram readers yielding window_size-tuples of word ids."""
+n-gram readers yielding window_size-tuples of word ids.  The readers
+tokenize with the ``word_idx`` the caller passes (the 1.x contract), so
+a dict built with a non-default ``min_word_freq`` stays consistent with
+the ids the reader yields."""
 from __future__ import annotations
 
 
@@ -13,7 +16,7 @@ def _reader(mode, word_idx, n, data_file=None):
     def reader():
         from paddle_tpu.text.datasets import Imikolov
         ds = Imikolov(data_file=data_file, mode=mode, data_type="NGRAM",
-                      window_size=n)
+                      window_size=n, word_idx=word_idx)
         for gram in ds:
             yield tuple(int(v) for v in gram)
 

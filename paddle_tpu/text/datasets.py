@@ -29,13 +29,16 @@ class Imdb(Dataset):
     """IMDB sentiment (reference: text/datasets/imdb.py; aclImdb_v1 tar)."""
 
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
-                 cutoff: int = 150):
+                 cutoff: int = 150, word_idx: Optional[dict] = None):
         self.mode = mode
         data_file = data_file or os.path.join(_CACHE, "imdb",
                                               "aclImdb_v1.tar.gz")
         _need(data_file, "Imdb")
         # vocab is built over train+test (reference imdb.py _build_work_dict
-        # scans aclImdb/((train)|(test))/...), so both modes share ids
+        # scans aclImdb/((train)|(test))/...), so both modes share ids.  A
+        # caller-supplied word_idx (the 1.x reader-creator contract, where
+        # imdb.train(word_idx) tokenizes with the dict the caller built)
+        # skips the freq pass and is used verbatim.
         vocab_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
         mode_pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
         docs, labels = [], []
@@ -45,19 +48,25 @@ class Imdb(Dataset):
                 vm = vocab_pat.match(member.name)
                 if not vm:
                     continue
+                if word_idx is not None and not mode_pat.match(member.name):
+                    continue
                 text = tf.extractfile(member).read().decode(
                     "utf-8", "ignore").lower()
                 words = re.sub(r"[^a-z]+", " ", text).split()
-                for w in words:
-                    freq[w] = freq.get(w, 0) + 1
+                if word_idx is None:
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
                 if mode_pat.match(member.name):
                     docs.append(words)
                     labels.append(0 if vm.group(2) == "pos" else 1)
-        kept = [w for w, c in sorted(freq.items(),
-                                     key=lambda kv: (-kv[1], kv[0]))
-                if c > cutoff]  # reference keeps freq > cutoff
-        self.word_idx = {w: i for i, w in enumerate(kept)}
-        self.word_idx["<unk>"] = len(self.word_idx)
+        if word_idx is not None:
+            self.word_idx = dict(word_idx)
+        else:
+            kept = [w for w, c in sorted(freq.items(),
+                                         key=lambda kv: (-kv[1], kv[0]))
+                    if c > cutoff]  # reference keeps freq > cutoff
+            self.word_idx = {w: i for i, w in enumerate(kept)}
+            self.word_idx["<unk>"] = len(self.word_idx)
         unk = self.word_idx["<unk>"]
         self.docs = [np.asarray([self.word_idx.get(w, unk) for w in d],
                                 np.int64) for d in docs]
@@ -75,26 +84,34 @@ class Imikolov(Dataset):
 
     def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
                  window_size: int = 5, mode: str = "train",
-                 min_word_freq: int = 50):
+                 min_word_freq: int = 50,
+                 word_idx: Optional[dict] = None):
         data_file = data_file or os.path.join(
             _CACHE, "imikolov", "simple-examples.tgz")
         _need(data_file, "Imikolov")
         member = {"train": "./simple-examples/data/ptb.train.txt",
                   "test": "./simple-examples/data/ptb.valid.txt"}[mode]
-        freq = {}
         with tarfile.open(data_file) as tf:
-            train = tf.extractfile(
-                "./simple-examples/data/ptb.train.txt").read().decode()
-            for w in train.split():
-                freq[w] = freq.get(w, 0) + 1
+            if word_idx is None:
+                freq = {}
+                train = tf.extractfile(
+                    "./simple-examples/data/ptb.train.txt").read().decode()
+                for w in train.split():
+                    freq[w] = freq.get(w, 0) + 1
             text = tf.extractfile(member).read().decode()
-        vocab = [w for w, c in sorted(freq.items(),
-                                      key=lambda kv: (-kv[1], kv[0]))
-                 if c >= min_word_freq and w != "<unk>"]
-        self.word_idx = {w: i for i, w in enumerate(vocab)}
-        self.word_idx["<unk>"] = len(self.word_idx)
-        self.word_idx["<s>"] = len(self.word_idx)
-        self.word_idx["<e>"] = len(self.word_idx)
+        if word_idx is not None:
+            # 1.x reader-creator contract: ids come from the dict the
+            # caller built (possibly with a non-default min_word_freq)
+            self.word_idx = dict(word_idx)
+        else:
+            # reference build_dict keeps strictly freq > min_word_freq
+            vocab = [w for w, c in sorted(freq.items(),
+                                          key=lambda kv: (-kv[1], kv[0]))
+                     if c > min_word_freq and w != "<unk>"]
+            self.word_idx = {w: i for i, w in enumerate(vocab)}
+            self.word_idx["<unk>"] = len(self.word_idx)
+            self.word_idx["<s>"] = len(self.word_idx)
+            self.word_idx["<e>"] = len(self.word_idx)
         unk = self.word_idx["<unk>"]
         self.data = []
         for line in text.split("\n"):

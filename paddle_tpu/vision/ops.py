@@ -409,7 +409,11 @@ def bipartite_match(dist_matrix, match_type="bipartite",
     return Tensor(indices), Tensor(dist)
 
 
-def _nms_keep(boxes, scores, thresh, top_k=-1):
+def _nms_keep(boxes, scores, thresh, top_k=-1, eta=1.0):
+    """Greedy NMS.  ``eta < 1`` enables the reference's adaptive decay
+    (NMSFast in multiclass_nms_op.cc): after each kept box the threshold
+    is multiplied by eta while it stays above 0.5, loosening suppression
+    for later, lower-scored boxes."""
     order = np.argsort(-scores)
     keep = []
     while order.size:
@@ -429,12 +433,14 @@ def _nms_keep(boxes, scores, thresh, top_k=-1):
             (boxes[rest, 3] - boxes[rest, 1])
         iou = inter / np.maximum(ai + ar - inter, 1e-10)
         order = rest[iou <= thresh]
+        if eta < 1.0 and thresh > 0.5:
+            thresh *= eta
     return np.asarray(keep, np.int64)
 
 
 def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
                    keep_top_k=100, nms_threshold=0.3, normalized=True,
-                   background_label=-1, return_index=False):
+                   nms_eta=1.0, background_label=-1, return_index=False):
     """Per-class NMS + cross-class top-k (reference:
     operators/detection/multiclass_nms_op).  ``bboxes`` (N, M, 4),
     ``scores`` (N, C, M).  Returns (out (K, 6) [label, score, x1..y2],
@@ -456,7 +462,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
             if nms_top_k > 0 and len(cand) > nms_top_k:
                 top = np.argsort(-cs)[:nms_top_k]
                 cand, cs = cand[top], cs[top]
-            keep = _nms_keep(b[n, cand], cs, nms_threshold)
+            keep = _nms_keep(b[n, cand], cs, nms_threshold, eta=nms_eta)
             for k in keep:
                 dets.append((c, cs[k], *b[n, cand[k]], n * M + cand[k]))
         dets.sort(key=lambda r: -r[1])
@@ -1120,6 +1126,12 @@ def _assign_anchors(anchors, gt, pos_overlap, neg_overlap):
     argmax-per-gt rule)."""
     labels = np.full((len(anchors),), -1, np.int64)
     if len(gt) == 0 or len(anchors) == 0:
+        # no (non-crowd) gt: every anchor is below negative_overlap, so the
+        # reference marks them all background — images without objects still
+        # contribute negative samples (rpn_target_assign_op.cc's rule that
+        # max_overlap < neg_overlap => label 0).  Callers pass only
+        # in-bounds anchors, so labelling all of them 0 is safe.
+        labels[:] = 0
         return labels, np.zeros((len(anchors),), np.int64), None
     iou = _iou_np(anchors, gt)
     best_gt = iou.argmax(axis=1)
@@ -1450,7 +1462,7 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
     dets = []
     for cls in np.unique(c):
         m = c == cls
-        keep = _nms_keep(b[m], s[m], nms_threshold)
+        keep = _nms_keep(b[m], s[m], nms_threshold, eta=nms_eta)
         for k in keep:
             dets.append([float(cls), s[m][k], *b[m][k]])
     dets.sort(key=lambda d: -d[1])

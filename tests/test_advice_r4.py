@@ -1,0 +1,125 @@
+"""Regression tests for the round-4 advisor findings.
+
+1. Empty-gt images must still yield background (negative) samples from
+   target-assign ops (reference rpn_target_assign_op.cc labels anchors
+   below negative_overlap as background regardless of gt presence).
+2. ``paddle.dataset.imikolov/imdb`` readers must tokenize with the
+   ``word_idx`` the caller passes (the 1.x reader-creator contract).
+3. ``retinanet_detection_output(nms_eta<1)`` applies the adaptive
+   threshold decay (NMSFast in multiclass_nms_op.cc).
+"""
+import io
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.vision import ops as vops
+
+
+def _tar_add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+class TestEmptyGtBackground:
+    ANCHORS = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [5, 5, 15, 15], [40, 40, 60, 60]], np.float32)
+
+    def test_rpn_target_assign_empty_gt_samples_negatives(self):
+        loc, score, tbox, tlab, _ = vops.rpn_target_assign(
+            self.ANCHORS, [np.zeros((0, 4), np.float32)],
+            im_info=np.array([[100.0, 100.0, 1.0]]),
+            rpn_batch_size_per_im=4, use_random=False)
+        assert len(np.asarray(loc._data)) == 0          # no foreground
+        lab = np.asarray(tlab._data)
+        assert len(lab) == 4 and (lab == 0).all()        # all background
+
+    def test_retinanet_target_assign_empty_gt(self):
+        out = vops.retinanet_target_assign(
+            self.ANCHORS, [np.zeros((0, 4), np.float32)],
+            [np.zeros((0,), np.int64)])
+        lab = np.asarray(out[3]._data)
+        assert len(lab) == 4 and (lab == 0).all()
+        assert int(np.asarray(out[5]._data)[0]) == 1     # fg_num floor
+
+    def test_all_crowd_gt_still_samples_negatives(self):
+        gt = np.array([[0, 0, 10, 10]], np.float32)
+        out = vops.retinanet_target_assign(
+            self.ANCHORS, [gt], [np.ones((1,), np.int64)],
+            is_crowd=[np.array([True])])
+        lab = np.asarray(out[3]._data)
+        assert len(lab) == 4 and (lab == 0).all()
+
+
+class TestNmsEta:
+    def test_adaptive_eta_keeps_more_boxes(self):
+        # chain of boxes each ~0.6 IoU with the previous: a fixed 0.7
+        # threshold keeps all, eta decay pushes the threshold below the
+        # chain IoU and suppresses some
+        boxes = np.array([[0, 0, 10, 10], [2.5, 0, 12.5, 10],
+                          [5, 0, 15, 10], [7.5, 0, 17.5, 10]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+        fixed = vops._nms_keep(boxes, scores, 0.7)
+        decay = vops._nms_keep(boxes, scores, 0.7, eta=0.5)
+        assert len(decay) < len(fixed)
+
+    def test_retinanet_detection_output_eta_plumbed(self):
+        # decay applies after each kept box, so it first bites on the
+        # third candidate (reference NMSFast updates adaptive_threshold
+        # post-iteration)
+        anchors = np.array([[0, 0, 10, 10], [1, 0, 11, 10],
+                            [2, 0, 12, 10]], np.float32)
+        deltas = np.zeros((3, 4), np.float32)
+        scores = np.array([[0.9], [0.8], [0.7]], np.float32)
+        loose = vops.retinanet_detection_output(
+            [deltas], [scores], [anchors], nms_threshold=0.9)
+        tight = vops.retinanet_detection_output(
+            [deltas], [scores], [anchors], nms_threshold=0.9, nms_eta=0.1)
+        assert len(np.asarray(loose._data)) == 3
+        assert len(np.asarray(tight._data)) < 3
+
+
+class TestReaderWordIdx:
+    def _imikolov_tgz(self, path):
+        with tarfile.open(path, "w:gz") as tf:
+            _tar_add(tf, "./simple-examples/data/ptb.train.txt",
+                     b"a a a b b c\na b a\n")
+            _tar_add(tf, "./simple-examples/data/ptb.valid.txt",
+                     b"a b\n")
+        return path
+
+    def test_imikolov_reader_uses_supplied_dict(self, tmp_path):
+        from paddle_tpu.dataset import imikolov
+        p = self._imikolov_tgz(str(tmp_path / "ptb.tgz"))
+        # non-default min_word_freq: keep words seen >=2 times (a, b)
+        wd = imikolov.build_dict(min_word_freq=2, data_file=p)
+        assert "a" in wd and "b" in wd and "c" not in wd
+        ids = set()
+        for gram in imikolov.train(wd, 2, data_file=p)():
+            ids.update(gram)
+        # every id the reader yields indexes the supplied dict; 'c' maps
+        # to the dict's <unk>, not to an id from a freq-50 rebuild
+        assert ids <= set(wd.values())
+        unk = wd["<unk>"]
+        assert unk in ids
+
+    def _imdb_tgz(self, path):
+        with tarfile.open(path, "w:gz") as tf:
+            _tar_add(tf, "aclImdb/train/pos/0.txt", b"good good fine")
+            _tar_add(tf, "aclImdb/train/neg/0.txt", b"bad bad awful")
+            _tar_add(tf, "aclImdb/test/pos/0.txt", b"good fine")
+            _tar_add(tf, "aclImdb/test/neg/0.txt", b"bad awful")
+        return path
+
+    def test_imdb_reader_uses_supplied_dict(self, tmp_path):
+        from paddle_tpu.dataset import imdb
+        p = self._imdb_tgz(str(tmp_path / "imdb.tgz"))
+        wd = imdb.word_dict(data_file=p, cutoff=1)   # keep freq>1 words
+        assert "good" in wd and "bad" in wd
+        for ids, lab in imdb.train(wd, data_file=p)():
+            assert set(ids) <= set(wd.values())
+            assert lab in (0, 1)
+        # with no dict the reader still works (self-built vocab)
+        rows = list(imdb.test(data_file=p)())
+        assert len(rows) == 2
