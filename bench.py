@@ -358,6 +358,54 @@ def bench_widedeep_ps(on_accel, extra_legs=True):
         srv.terminate()
 
 
+def bench_widedeep_device(on_accel):
+    """The heter-PS device tier (VERDICT r4 #2): a 10M-row x 64 table
+    RESIDENT IN HBM, range-sharded over the mesh, trained through
+    DeviceEmbeddingTrainStep — dedup + collective exchange + touched-
+    rows adagrad, all inside one XLA step, nothing crossing the host
+    boundary.  On the single bench chip the exchange degenerates to
+    K=1 (sharding correctness is held by tests/test_device_table.py
+    and the driver dryrun); the measured number is the device-resident
+    pull->train->push cycle against the SAME W&D shape the 100M host-
+    table leg runs, so the two tiers are directly comparable.
+    Reference: framework/fleet/heter_ps/hashtable.h, ps_gpu_wrapper.cc."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.ps import (DeviceEmbeddingTrainStep,
+                                           MeshShardedEmbedding)
+    from paddle_tpu.models import WideDeepHost
+    from paddle_tpu.parallel import get_mesh
+
+    if on_accel:
+        B, V, E = 16384, 10_000_000, 64
+    else:
+        B, V, E = 256, 50_000, 8
+    fields, dense_dim = 26, 13
+    emb = MeshShardedEmbedding(V, E + 1, mesh_axis="dp", seed=0)
+    model = WideDeepHost(embedding_dim=E, num_fields=fields,
+                         dense_dim=dense_dim)
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    def loss_fn(m, rows, x, y):
+        return F.binary_cross_entropy_with_logits(m(rows, x), y).mean()
+
+    step = DeviceEmbeddingTrainStep(model, loss_fn, opt, emb,
+                                    mesh=get_mesh(), table_lr=0.05)
+    rng = np.random.default_rng(0)
+    ids = (rng.zipf(1.3, size=(B, fields)) % V).astype(np.int32)
+    x = paddle.to_tensor(rng.standard_normal((B, dense_dim))
+                         .astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 2, size=(B, 1)).astype(np.float32))
+    first = float(step(ids, x, y))
+    iters = 20 if on_accel else 3
+    dt, last = _timeit(lambda: step(ids, x, y), 2, iters)
+    eps = B * iters / dt
+    trains = float(last) < first
+    _emit("widedeep_device_sharded_10M_examples_per_sec", eps,
+          "examples/s", 1.0 if trains else 0.0)
+
+
 def _gen_image_dataset(root, n_images, size, classes):
     """Directory-per-class JPEG tree (generated once, cached on disk) —
     the file-fed ResNet leg's input.  Deterministic content."""
@@ -628,18 +676,21 @@ def bench_masked_flash(on_accel):
           t_plain / t_masked)
 
 
-def _device_alive(timeout_s: int = 240) -> bool:
+_PROBE_CODE = ("import jax, numpy as np; "
+               "np.asarray(jax.numpy.ones((2, 2)).sum()); print('ok')")
+
+
+def _device_alive(timeout_s: int = 240, probe_code: str = _PROBE_CODE) -> bool:
     """Probe device init in a subprocess with a hard deadline: a wedged
     accelerator lease makes jax.devices() block forever in a retry loop
     (observed after a killed client), and a bench that hangs is worse
-    than one that reports the outage."""
+    than one that reports the outage.  ``probe_code`` is injectable so a
+    hanging device can be simulated in tests."""
     import subprocess
     import sys
     try:
         r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, numpy as np; "
-             "np.asarray(jax.numpy.ones((2, 2)).sum()); print('ok')"],
+            [sys.executable, "-c", probe_code],
             capture_output=True, text=True, timeout=timeout_s)
         return r.returncode == 0 and "ok" in r.stdout
     except subprocess.TimeoutExpired:
@@ -663,6 +714,7 @@ def main():
 
     for bench in (bench_bert, bench_resnet50, bench_gpt2_345m,
                   bench_widedeep, bench_widedeep_ps,
+                  bench_widedeep_device,
                   bench_resnet50_filefed, bench_lenet,
                   bench_longseq_flash, bench_masked_flash):
         # one retry: the remote-compile tunnel occasionally drops a

@@ -7,12 +7,17 @@ distributed/table/common_sparse_table.cc), async communicators
 tables (framework/fleet/heter_ps/).  Capability = embeddings far larger
 than one device, updated sparsely, with async/geo consistency modes.
 
-TPU-native mapping, two tiers:
+TPU-native mapping, three tiers:
 
 - **Device tier — ``ShardedEmbedding``**: the table lives in HBM sharded
   over a mesh axis (rows split).  XLA partitions the gather and the
   scatter-add gradient; this is the SparseCore-style path and replaces the
   GPU heter-PS (hashtable.h) for tables that fit the slice.
+- **Device exchange tier — ``MeshShardedEmbedding`` (device_table.py)**:
+  range-sharded table + explicit per-step dedup / all-gather id exchange /
+  psum_scatter row return — the heter_ps pull_sparse/push_sparse cycle
+  (heter_comm.h) as XLA collectives, for tables that fit aggregate HBM
+  but not one chip.
 - **Host tier — ``HostEmbeddingTable`` + ``DistributedEmbedding``**: the
   table lives in host RAM (numpy, trillion-scale capable), rows are pulled
   per batch to the device and gradient rows pushed back into a host-side
@@ -36,12 +41,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import Parameter, Tensor, apply1
+from paddle_tpu.distributed.ps.device_table import (
+    DeviceEmbeddingTrainStep, MeshShardedEmbedding, mesh_sharded_lookup)
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.parallel.mesh import DistAttr
 
-__all__ = ["HashEmbeddingTable",
+__all__ = ["HashEmbeddingTable", "MeshShardedEmbedding",
+           "DeviceEmbeddingTrainStep",
            "ShardedEmbedding", "HostEmbeddingTable", "DistributedEmbedding",
-           "AsyncCommunicator", "PSTrainStep"]
+           "AsyncCommunicator", "PSTrainStep", "mesh_sharded_lookup"]
 
 
 class ShardedEmbedding(Layer):
