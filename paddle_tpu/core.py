@@ -625,6 +625,9 @@ _amp_hook = [None]
 _OP_CACHE: dict = {}
 _OP_CACHE_MAX = 1024
 _UNCACHEABLE = object()
+# strong refs for identity-keyed singletons (jnp.ufunc instances), so a
+# cache key's id() can never be reused by a new object
+_PINNED_FNS: dict = {}
 
 # telemetry: monitor counters (STAT_ADD role) — handles resolved once so the
 # per-dispatch cost is a single locked int add.  Readable via
@@ -675,7 +678,24 @@ def _fn_token(fn, depth=0):
         return ("p", _fn_token(fn.func, depth), _hash_token(fn.args, depth),
                 _hash_token(fn.keywords, depth))
     if getattr(fn, "__self__", None) is not None:
-        raise _Unhashable          # bound method: self not part of code/cells
+        # bound method: deliberately uncacheable.  An identity key on
+        # ``self`` would freeze its *state* into the compiled entry (a
+        # Layer's weights at first call), silently violating the purity
+        # requirement above — and after the RNG-as-argument fix the
+        # measured transformer miss tail contains no bound methods.
+        raise _Unhashable
+    if isinstance(fn, jnp.ufunc):
+        # jnp.ufunc singletons (jnp.add — Tensor.__add__'s op) define
+        # __eq__ without __hash__; pin the instance and key by identity.
+        # Only module-level jnp singletons qualify — a ufunc minted per
+        # call (jnp.frompyfunc) would pin unboundedly and mint a fresh
+        # key every call, churning the cache (same policy as the
+        # '<locals>' guard below).
+        name = getattr(fn, "__name__", "")
+        if getattr(jnp, name, None) is not fn:
+            raise _Unhashable
+        _PINNED_FNS[id(fn)] = fn
+        return ("u", name, id(fn))
     code = getattr(fn, "__code__", None)
     if code is None:
         # builtin / PjitFunction singletons (jnp.matmul, jax.nn.relu):

@@ -163,9 +163,12 @@ class MeshShardedEmbedding(Layer):
         self.weight.dist_attr = DistAttr((mesh_axis, None))
 
     def forward(self, x):
+        # hoisted into cells (not self attributes) so the dispatch-cache
+        # key can hash them — a closure over self is uncacheable
+        axis, cap = self.mesh_axis, self.capacity
         return apply1(
-            lambda w, ids: mesh_sharded_lookup(
-                w, ids, axis=self.mesh_axis, capacity=self.capacity),
+            lambda w, ids: mesh_sharded_lookup(w, ids, axis=axis,
+                                               capacity=cap),
             self.weight, x, name="mesh_sharded_embedding")
 
 

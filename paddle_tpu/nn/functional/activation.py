@@ -106,11 +106,11 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
         from paddle_tpu.tensor.random import default_generator
         k = default_generator.split()
 
-        def _rrelu(a):
-            slope = jax.random.uniform(k, a.shape, dtype=a.dtype,
+        def _rrelu(a, key):
+            slope = jax.random.uniform(key, a.shape, dtype=a.dtype,
                                        minval=lower, maxval=upper)
             return jnp.where(a >= 0, a, slope * a)
-        return apply1(_rrelu, x, name="rrelu")
+        return apply1(_rrelu, x, k, name="rrelu")
     mid = (lower + upper) / 2.0
     return leaky_relu(x, mid)
 
@@ -197,16 +197,16 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from paddle_tpu.tensor.random import default_generator
     k = default_generator.split()
 
-    def _gs(a):
-        g = jax.random.gumbel(k, a.shape, dtype=a.dtype)
+    def _gs(a, key):
+        g = jax.random.gumbel(key, a.shape, dtype=a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis, keepdims=True)
             y_hard = jnp.zeros_like(y)
             y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
                                         inplace=False)
-            y = y_hard + jax.lax.stop_gradient(y) - jax.lax.stop_gradient(y) \
-                + (y - jax.lax.stop_gradient(y))
-            y = y_hard - jax.lax.stop_gradient(y) + y
+            # straight-through: value y_hard, gradient of the soft y
+            # (parenthesized so the value term cancels exactly)
+            y = y_hard + (y - jax.lax.stop_gradient(y))
         return y
-    return apply1(_gs, x, name="gumbel_softmax")
+    return apply1(_gs, x, k, name="gumbel_softmax")

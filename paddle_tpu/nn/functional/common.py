@@ -32,16 +32,19 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         return apply1(lambda a: jnp.zeros_like(a), x, name="dropout")
     k = default_generator.split()
 
-    def _dropout(a):
+    # the key rides as a runtime argument, NOT a closure cell: cells are
+    # part of the dispatch-cache key, so a per-call key value would make
+    # every dropout uncacheable (the round-4 eager-transformer miss tail)
+    def _dropout(a, key):
         shape = list(a.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
             shape = [s if i in axes else 1 for i, s in enumerate(shape)]
-        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
-    return apply1(_dropout, x, name="dropout")
+    return apply1(_dropout, x, k, name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -62,13 +65,13 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
 
-    def _ad(a):
-        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+    def _ad(a, key):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
         q = 1.0 - p
         a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
         b_coef = -a_coef * alpha_p * p
         return a_coef * jnp.where(keep, a, alpha_p) + b_coef
-    return apply1(_ad, x, name="alpha_dropout")
+    return apply1(_ad, x, k, name="alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
