@@ -64,10 +64,29 @@ def save(obj: Any, path: str, protocol: int = 4, **configs):
         pickle.dump(payload, f, protocol=protocol)
 
 
+def dumps(obj: Any, protocol: int = 4) -> bytes:
+    """save() to an in-memory payload (the encrypted-model path —
+    plaintext weights never touch disk)."""
+    return _SENTINEL + pickle.dumps(_to_serializable(obj),
+                                    protocol=protocol)
+
+
 def load(path: str, return_numpy: bool = False, **configs):
+    # streamed, not slurped: multi-GB checkpoints must not hold an
+    # extra whole-file copy in RAM
     with open(path, "rb") as f:
         head = f.read(len(_SENTINEL))
         if head != _SENTINEL:
             f.seek(0)
         payload = pickle.load(f)
+    return _from_serializable(payload, return_numpy=return_numpy)
+
+
+def loads(data: bytes, return_numpy: bool = False):
+    """load() from an in-memory payload (the decrypted-model path)."""
+    import io as _io
+    buf = _io.BytesIO(data)
+    if buf.read(len(_SENTINEL)) != _SENTINEL:
+        buf.seek(0)
+    payload = pickle.load(buf)
     return _from_serializable(payload, return_numpy=return_numpy)

@@ -25,7 +25,8 @@ class Config:
     path; device selection collapses to wherever jax put the program)."""
 
     def __init__(self, prog_file: Optional[str] = None,
-                 params_file: Optional[str] = None):
+                 params_file: Optional[str] = None,
+                 decrypt_key=None):
         # jit.save writes <path>.pdmodel/<path>.pdparams — accept either
         # the bare prefix or the .pdmodel path
         p = prog_file or ""
@@ -35,6 +36,13 @@ class Config:
         self._use_gpu = False
         self._enable_profile = False
         self._flags: Dict[str, object] = {}
+        self._decrypt_key = decrypt_key
+
+    def set_cipher_key(self, key):
+        """Key for models saved with jit.save(..., encrypt_key=...) —
+        the encrypted-deployment path (reference:
+        analysis_predictor.cc:145 loading through AESCipher)."""
+        self._decrypt_key = key
 
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
         p = prog_file or ""
@@ -92,7 +100,8 @@ class Predictor:
     def __init__(self, config: Config):
         from paddle_tpu import jit
         self.config = config
-        self._layer = jit.load(config.model_prefix)
+        self._layer = jit.load(config.model_prefix,
+                               decrypt_key=config._decrypt_key)
         n_in = max(1, len(getattr(self._layer._exported, "in_avals", []))
                    - len(self._layer._params))
         self._inputs = {f"input_{i}": PredictorTensor(f"input_{i}")

@@ -451,17 +451,51 @@ class TranslatedLayer(Layer):
         return Tensor(out)
 
 
-def save(layer, path, input_spec=None, **configs):
+def _cipher_for(key):
+    """(AESCipher, key_bytes) for a user-supplied key.  Raw 16/24/32-byte
+    keys (cipher_utils-style key files, the reference's
+    framework/io/crypto/cipher_utils.cc loading) are used verbatim at
+    their own AES strength; any str passphrase or other length is
+    sha256-hashed to a full 32-byte AES-256 key — one rule, no
+    length-dependent forks."""
+    import hashlib
+
+    from paddle_tpu.framework.crypto import AESCipher
+    if isinstance(key, (bytes, bytearray)) and len(key) in (16, 24, 32):
+        kb = bytes(key)
+    else:
+        if isinstance(key, str):
+            key = key.encode()
+        kb = hashlib.sha256(bytes(key)).digest()
+    return AESCipher(len(kb)), kb
+
+
+def save(layer, path, input_spec=None, encrypt_key=None, **configs):
     """paddle.jit.save parity: state dict + StableHLO export.
 
     Writes ``path.pdparams`` (weights) and — when ``input_spec`` is given and
     jax.export is available — ``path.pdmodel`` (serialized StableHLO).
+
+    ``encrypt_key``: encrypt both artifacts (AES-CTR + HMAC-SHA256,
+    framework.crypto — the reference predictor's encrypted-model
+    deployment path, inference/api/analysis_predictor.cc:145).  Load
+    with ``jit.load(path, decrypt_key=...)`` or
+    ``inference.Config(..., decrypt_key=...)``.
     """
+    from paddle_tpu.framework.io import dumps as _dumps
     from paddle_tpu.framework.io import save as _save
     if isinstance(layer, StaticFunction):
         sf = layer
         layer = sf._layer
-    _save(layer.state_dict(), path + ".pdparams")
+    if encrypt_key is not None:
+        # serialize in memory and write ciphertext only — plaintext
+        # weights must never hit the filesystem, even transiently
+        cipher, kb = _cipher_for(encrypt_key)
+        blob = cipher.encrypt(_dumps(layer.state_dict()), kb)
+        with open(path + ".pdparams", "wb") as f:
+            f.write(blob)
+    else:
+        _save(layer.state_dict(), path + ".pdparams")
     if input_spec:
         try:
             from jax import export as jax_export
@@ -531,21 +565,43 @@ def save(layer, path, input_spec=None, **configs):
                     stacklevel=2)
                 exp = jax_export.export(jax.jit(pure))(
                     *param_shapes, *buffer_shapes, *spec_shapes(False))
+            blob = bytes(exp.serialize())
+            if encrypt_key is not None:
+                cipher, kb = _cipher_for(encrypt_key)
+                blob = cipher.encrypt(blob, kb)
             with open(path + ".pdmodel", "wb") as f:
-                f.write(exp.serialize())
+                f.write(blob)
         finally:
             if was_training:
                 layer.train()
 
 
-def load(path, **configs):
-    """paddle.jit.load parity."""
-    from paddle_tpu.framework.io import load as _load
-    state = _load(path + ".pdparams")
+def _read_artifact(path, decrypt_key):
+    """Read a saved artifact, decrypting in memory when it carries the
+    crypto magic (plaintext never touches disk on load)."""
+    from paddle_tpu.framework import crypto
+    with open(path, "rb") as f:
+        data = f.read()
+    if data.startswith(crypto._MAGIC):
+        if decrypt_key is None:
+            raise ValueError(
+                f"{path} is encrypted — pass decrypt_key= (jit.load) or "
+                "Config(decrypt_key=...) (inference)")
+        cipher, kb = _cipher_for(decrypt_key)
+        data = cipher.decrypt(data, kb)
+    return data
+
+
+def load(path, decrypt_key=None, **configs):
+    """paddle.jit.load parity.  ``decrypt_key`` loads artifacts written
+    with ``jit.save(..., encrypt_key=...)``; HMAC failure (wrong key or
+    tampered file) raises instead of returning garbage weights."""
+    from paddle_tpu.framework.io import loads as _loads
+    state = _loads(_read_artifact(path + ".pdparams", decrypt_key))
     if os.path.exists(path + ".pdmodel"):
         from jax import export as jax_export
-        with open(path + ".pdmodel", "rb") as f:
-            exp = jax_export.deserialize(f.read())
+        exp = jax_export.deserialize(
+            _read_artifact(path + ".pdmodel", decrypt_key))
         params = [np.asarray(v._data if isinstance(v, Tensor) else v)
                   for v in state.values()]
         return TranslatedLayer(exp, [jnp.asarray(p) for p in params])
