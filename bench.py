@@ -406,6 +406,46 @@ def bench_widedeep_device(on_accel):
           "examples/s", 1.0 if trains else 0.0)
 
 
+def bench_int8_resnet18(on_accel):
+    """Int8 inference vs bf16 on ResNet-18 (VERDICT r4 #6): the PTQ
+    deploy pass (convert_to_int8_inference) swaps every conv/linear for
+    the s8 x s8 -> s32 MXU path; vs_baseline = int8/bf16 throughput
+    ratio, and the top-1 agreement with the float model is asserted
+    before timing so a broken quantization can't post a fast number.
+    Reference: contrib/slim + inference/api/mkldnn_quantizer.cc."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import to_static
+    from paddle_tpu.quantization import convert_to_int8_inference
+    from paddle_tpu.vision.models import resnet18
+
+    B, hw = (128, 224) if on_accel else (8, 32)
+    # two SEPARATE instances with identical weights: to_static returns
+    # the same Layer object and convert_to_int8_inference mutates in
+    # place, so one instance would make the "bf16 baseline" time int8
+    paddle.seed(0)
+    net = resnet18(num_classes=1000)
+    net.eval()
+    paddle.seed(0)
+    net_q = resnet18(num_classes=1000)
+    net_q.eval()
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (B, 3, hw, hw)).astype(np.float32))
+
+    f32 = to_static(net)
+    ref = np.asarray(f32(x)._data)
+    qnet = convert_to_int8_inference(net_q)
+    q = to_static(qnet)
+    got = np.asarray(q(x)._data)
+    agree = float((got.argmax(1) == ref.argmax(1)).mean())
+    iters = 20 if on_accel else 3
+    dt_f, _ = _timeit(lambda: f32(x), 2, iters)
+    dt_q, _ = _timeit(lambda: q(x), 2, iters)
+    ips = B * iters / dt_q
+    _emit("resnet18_int8_infer_images_per_sec", ips, "images/s",
+          (dt_f / dt_q) if agree >= 0.7 else 0.0)
+    _emit("resnet18_int8_top1_agreement", agree, "fraction", agree)
+
+
 def _gen_image_dataset(root, n_images, size, classes):
     """Directory-per-class JPEG tree (generated once, cached on disk) —
     the file-fed ResNet leg's input.  Deterministic content."""
@@ -714,7 +754,7 @@ def main():
 
     for bench in (bench_bert, bench_resnet50, bench_gpt2_345m,
                   bench_widedeep, bench_widedeep_ps,
-                  bench_widedeep_device,
+                  bench_widedeep_device, bench_int8_resnet18,
                   bench_resnet50_filefed, bench_lenet,
                   bench_longseq_flash, bench_masked_flash):
         # one retry: the remote-compile tunnel occasionally drops a

@@ -31,11 +31,23 @@ from paddle_tpu.nn.layer.layers import Layer
 __all__ = ["fake_quantize_dequantize_abs_max",
            "fake_channel_wise_quantize_dequantize_abs_max",
            "MovingAverageAbsMaxObserver", "QuantizedLinear",
-           "ImperativeQuantAware", "quant_post_weights", "dequant_weights"]
+           "ImperativeQuantAware", "quant_post_weights", "dequant_weights",
+           "Int8InferenceLinear", "Int8InferenceConv2D",
+           "convert_to_int8_inference"]
 
 
 def _qmax(bits: int) -> float:
     return float(2 ** (bits - 1) - 1)
+
+
+def _quant_act(a):
+    """Dynamic per-tensor abs-max activation quantization — the single
+    activation rule shared by the Int8Inference layers (same
+    single-source-of-truth policy as _quantize_weight).  Returns
+    (a_int8, scale)."""
+    af = a.astype(jnp.float32)
+    s_x = jnp.maximum(jnp.max(jnp.abs(af)), 1e-8) / 127.0
+    return jnp.clip(jnp.round(af / s_x), -127, 127).astype(jnp.int8), s_x
 
 
 def fake_quantize_dequantize_abs_max(x, bits: int = 8, name=None):
@@ -182,9 +194,7 @@ class Int8InferenceLinear(Layer):
         wq, ws, b = self._w_q, self._w_scale, self._bias
 
         def _run(a):
-            af = a.astype(jnp.float32)
-            s_x = jnp.maximum(jnp.max(jnp.abs(af)), 1e-8) / 127.0
-            a_q = jnp.clip(jnp.round(af / s_x), -127, 127).astype(jnp.int8)
+            a_q, s_x = _quant_act(a)
             acc = jax.lax.dot_general(
                 a_q, wq, (((a.ndim - 1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32)
@@ -196,14 +206,68 @@ class Int8InferenceLinear(Layer):
         return apply1(_run, x, name="int8_linear")
 
 
-def _quantize_weight(w: np.ndarray, bits: int = 8):
+class Int8InferenceConv2D(Layer):
+    """Conv2D executed as an s8 x s8 -> s32 convolution (the conv leg of
+    the reference's int8 deployment tier — contrib/slim/ + the MKLDNN/
+    TensorRT quantized conv kernels, inference/api/mkldnn_quantizer.cc;
+    TPU-native: the MXU runs s8 convs at 2x the bf16 rate).
+
+    Weights: per-OUT-CHANNEL symmetric int8 (scale over the (I, kh, kw)
+    slice, the channel-wise rule of fake_channel_wise_quantize_op).
+    Activations: dynamic per-tensor abs-max, like Int8InferenceLinear.
+    NCHW layout (the vision zoo's default).
+    """
+
+    def __init__(self, w_int8: np.ndarray, w_scale: np.ndarray, bias=None,
+                 stride=1, padding=0, dilation=1, groups: int = 1):
+        super().__init__()
+        from paddle_tpu.nn.functional.conv import _norm_padding, _tuplify
+        self._w_q = jnp.asarray(w_int8, jnp.int8)          # (O, I, kh, kw)
+        self._w_scale = jnp.asarray(w_scale, jnp.float32)  # (O,)
+        self._bias = None if bias is None else jnp.asarray(
+            np.asarray(bias), jnp.float32)
+        # same normalization as F.conv2d, so any paddle padding spelling
+        # (int, per-dim, pairs, SAME/VALID) behaves identically
+        self._stride = _tuplify(stride, 2)
+        self._padding = _norm_padding(padding, 2)
+        self._dilation = _tuplify(dilation, 2)
+        self._groups = int(groups)
+
+    def forward(self, x):
+        wq, ws, b = self._w_q, self._w_scale, self._bias
+        strides, pad = self._stride, self._padding
+        dil, groups = self._dilation, self._groups
+
+        def _run(a):
+            a_q, s_x = _quant_act(a)
+            dn = jax.lax.conv_dimension_numbers(
+                a.shape, wq.shape, ("NCHW", "OIHW", "NCHW"))
+            acc = jax.lax.conv_general_dilated(
+                a_q, wq, window_strides=strides, padding=pad,
+                rhs_dilation=dil, dimension_numbers=dn,
+                feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (s_x * ws)[None, :, None, None]
+            if b is not None:
+                y = y + b[None, :, None, None]
+            return y.astype(a.dtype) if a.dtype != jnp.float32 else y
+        return apply1(_run, x, name="int8_conv2d")
+
+
+def _quantize_weight(w: np.ndarray, bits: int = 8, out_axis: int = 1):
     """Per-out-channel symmetric int8 pack — the single source of truth
-    shared by quant_post_weights (pack) and Int8InferenceLinear
-    (deploy) so the two paths can never diverge numerically."""
+    shared by quant_post_weights (pack) and the Int8Inference layers
+    (deploy) so the two paths can never diverge numerically.
+    ``out_axis``: which axis holds output channels (1 for Linear's
+    (in, out); 0 for Conv2D's (O, I, kh, kw))."""
     qm = _qmax(bits)
     w = np.asarray(w, np.float32)
-    scale = np.maximum(np.abs(w).max(axis=0), 1e-8)
-    q = np.clip(np.round(w / scale * qm), -qm, qm).astype(np.int8)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != out_axis)
+    scale = np.maximum(np.abs(w).max(axis=reduce_axes), 1e-8)
+    shape = [1] * w.ndim
+    shape[out_axis] = -1
+    q = np.clip(np.round(w / scale.reshape(shape) * qm), -qm, qm) \
+        .astype(np.int8)
     return q, (scale / qm).astype(np.float32)
 
 
@@ -214,17 +278,39 @@ def _int8_of(linear) -> "Int8InferenceLinear":
     return Int8InferenceLinear(q, scale, bias)
 
 
-def convert_to_int8_inference(model: Layer) -> Layer:
-    """Swap every nn.Linear for an Int8InferenceLinear — the PTQ deploy
-    step (post_training_quantization.py convert).  A bare Linear is
-    converted and RETURNED (it cannot be swapped in place); use the
-    return value."""
+def _int8_of_conv(conv) -> "Int8InferenceConv2D":
+    q, scale = _quantize_weight(np.asarray(conv.weight._data), out_axis=0)
+    bias = conv.bias._data if getattr(conv, "bias", None) is not None \
+        else None
+    return Int8InferenceConv2D(q, scale, bias, stride=conv._stride,
+                               padding=conv._padding,
+                               dilation=conv._dilation,
+                               groups=conv._groups)
+
+
+def convert_to_int8_inference(model: Layer,
+                              convert_conv: bool = True) -> Layer:
+    """Swap every nn.Linear (and, by default, every NCHW nn.Conv2D) for
+    its Int8Inference counterpart — the PTQ deploy step
+    (post_training_quantization.py convert) over the vision zoo.  A bare
+    Linear/Conv2D is converted and RETURNED (it cannot be swapped in
+    place); use the return value."""
     from paddle_tpu.nn.layer.common import Linear
+    from paddle_tpu.nn.layer.conv import Conv2D
+
+    def _convertible_conv(m):
+        return (convert_conv and isinstance(m, Conv2D)
+                and m._data_format == "NCHW")
+
     if isinstance(model, Linear):
         return _int8_of(model)
+    if _convertible_conv(model):
+        return _int8_of_conv(model)
     for name, child in list(model._sub_layers.items()):
         if isinstance(child, Linear):
             model._sub_layers[name] = _int8_of(child)
+        elif _convertible_conv(child):
+            model._sub_layers[name] = _int8_of_conv(child)
         else:
-            convert_to_int8_inference(child)
+            convert_to_int8_inference(child, convert_conv=convert_conv)
     return model
