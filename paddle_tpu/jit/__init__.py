@@ -309,7 +309,11 @@ class TrainStep:
         self._opt_states: Optional[dict] = None
 
     # -- pure step ----------------------------------------------------------
-    def _make_step(self, param_names, buffer_names, n_inputs, lr_is_arg):
+    def _build_one_step(self):
+        """The shared step body: forward + grad (with optional micro-batch
+        gradient-merge) + optimizer update.  Both the per-call jit
+        (_make_step) and the device-resident loop (_make_multi_step) wrap
+        exactly this function, so their training semantics cannot drift."""
         model = self.model
         loss_fn = self.loss_fn
         opt = self.optimizer
@@ -328,7 +332,7 @@ class TrainStep:
             # activations, rebuild them during the grad sweep.
             loss_from = jax.checkpoint(loss_from, static_argnums=())
 
-        def step(params, opt_states, buffers, key, lr, *inputs):
+        def one_step(params, opt_states, buffers, key, lr, inputs):
             micro = self.accumulate_steps
             if micro > 1:
                 def micro_body(carry, xs):
@@ -355,8 +359,113 @@ class TrainStep:
                 opt, grads, params, opt_states, lr)
             return new_params, new_states, new_buffers, loss
 
+        return one_step
+
+    def _make_step(self):
+        one_step = self._build_one_step()
+
+        def step(params, opt_states, buffers, key, lr, *inputs):
+            return one_step(params, opt_states, buffers, key, lr,
+                            list(inputs))
+
         donate = (0, 1, 2) if self.donate else ()
         return jax.jit(step, donate_argnums=donate)
+
+    # -- device-resident multi-step loop ------------------------------------
+    def _make_multi_step(self):
+        """Like _make_step, but lax.scan's ``n_steps`` optimizer steps
+        inside ONE compiled computation: the host (and the dispatch
+        tunnel) is touched once per loop, not once per step.  This is the
+        role of the reference's DeviceWorker batch loop — one Executor
+        invocation trains many batches with no Python in between
+        (paddle/fluid/framework/device_worker.cc HogwildWorker::TrainFiles
+        loops device_reader->Next() inside a single C++ call)."""
+        one_step = self._build_one_step()
+
+        def body(carry, xs, lr):
+            p, st, bufs, k = carry
+            k, sub = jax.random.split(k)
+            np_, nst, nb, l = one_step(p, st, bufs, sub, lr, list(xs))
+            return (np_, nst, nb, k), l
+
+        def multi(params, opt_states, buffers, key, lr, *stacked):
+            (params, opt_states, buffers, _), losses = jax.lax.scan(
+                lambda c, xs: body(c, xs, lr),
+                (params, opt_states, buffers, key), tuple(stacked))
+            return params, opt_states, buffers, losses
+
+        def multi_unrolled(params, opt_states, buffers, key, lr, *stacked):
+            # straight-line K steps: no scan, so the carry is never
+            # double-buffered — the right shape when params+opt states fill
+            # most of HBM and a scan's extra live copy would spill
+            carry = (params, opt_states, buffers, key)
+            losses = []
+            for i in range(int(stacked[0].shape[0])):
+                carry, l = body(carry, [s[i] for s in stacked], lr)
+                losses.append(l)
+            params, opt_states, buffers, _ = carry
+            return params, opt_states, buffers, jnp.stack(losses)
+
+        donate = (0, 1, 2) if self.donate else ()
+        return (jax.jit(multi, donate_argnums=donate),
+                jax.jit(multi_unrolled, donate_argnums=donate))
+
+    def multi_step(self, *inputs, unroll: bool = False):
+        """Run K optimizer steps in one device dispatch.
+
+        Each input carries a leading steps axis: shape (K, B, ...) — K
+        consecutive batches, prefetched to the device up front.  The loop
+        body is identical to ``__call__``; per-step losses come back as a
+        (K,)-shaped Tensor after the single round trip.  Use for small
+        fast steps where host dispatch latency is comparable to device
+        step time (high-latency links, small models).
+
+        ``unroll=True`` emits the K steps as straight-line code instead of
+        a lax.scan: compile time scales with K, but the scan's
+        double-buffered carry (a second live copy of params + optimizer
+        states) disappears — required when model+states fill most of HBM.
+        """
+        model = self.model
+        named_params = {n: p for n, p in model.named_parameters()}
+        named_buffers = {n: b for n, b in model.named_buffers()
+                         if b is not None}
+        params = {n: p._data for n, p in named_params.items()}
+        buffers = {n: b._data for n, b in named_buffers.items()}
+        if self._opt_states is None:
+            self._opt_states = self.optimizer.functional_init_states(params)
+        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        sig = ("multi", bool(unroll)) + _sig_of(list(named_params.values())) \
+            + _sig_of(arrs)
+        fn = self._cache.get(sig)
+        if fn is None:
+            scan_fn, unrolled_fn = self._make_multi_step()
+            fn = unrolled_fn if unroll else scan_fn
+            self._cache[sig] = fn
+        key = default_generator.split()
+        lr = jnp.float32(self.optimizer.get_lr())
+        self._last_fn = fn
+        self._last_input_avals = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs)
+        self._last_key_aval = jax.ShapeDtypeStruct(key.shape, key.dtype)
+        from paddle_tpu.profiler import RecordEvent
+        with RecordEvent("TrainStep.multi_step"):
+            new_params, self._opt_states, new_buffers, losses = fn(
+                params, self._opt_states, buffers, key, lr, *arrs)
+        from paddle_tpu.framework.flags import flag
+        if flag("check_nan_inf"):
+            # same per-step guard as __call__, swept over the K losses in
+            # one host sync
+            if not bool(jnp.all(jnp.isfinite(losses))):
+                raise FloatingPointError(
+                    "TrainStep.multi_step produced a non-finite loss "
+                    "(FLAGS_check_nan_inf is set)")
+        for n, p in named_params.items():
+            p._data = new_params[n]
+        for n, b in named_buffers.items():
+            b._data = new_buffers[n]
+        self.optimizer._global_step += int(arrs[0].shape[0])
+        return Tensor(losses)
 
     def __call__(self, *inputs):
         model = self.model
@@ -372,8 +481,7 @@ class TrainStep:
         sig = _sig_of(list(named_params.values())) + _sig_of(arrs)
         fn = self._cache.get(sig)
         if fn is None:
-            fn = self._make_step(list(named_params), list(named_buffers),
-                                 len(arrs), True)
+            fn = self._make_step()
             self._cache[sig] = fn
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())

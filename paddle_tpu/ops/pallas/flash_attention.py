@@ -283,16 +283,45 @@ def _mask_inputs(bias, qseg, kseg):
     return ins
 
 
+def _fold(x, b, h):
+    """(B, S, H, D) → (B*H, S, D) — the kernels' tiling layout."""
+    s, d = x.shape[1], x.shape[3]
+    return jnp.einsum("bshd->bhsd", x).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h):
+    """(B*H, S, D) → (B, S, H, D)."""
+    s, d = x.shape[1], x.shape[2]
+    return jnp.einsum("bhsd->bshd", x.reshape(b, h, s, d))
+
+
 def _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal):
     """Returns (out (B,S,H,D), lse (B*H, Sq, 1) float32)."""
+    b, sq, h, d = q.shape
+    out_f, lse = _flash_fwd_folded(_fold(q, b, h), _fold(k, b, h),
+                                   _fold(v, b, h), bias, qseg, kseg,
+                                   scale, causal, h)
+    return _unfold(out_f, b, h), lse
+
+
+def _flash_fwd_folded(qt, kt, vt, bias, qseg, kseg, scale, causal, h):
+    """Core forward on pre-folded (B*H, S, D) operands.
+
+    Returns (out (B*H, Sq, D), lse (B*H, Sq, 1) f32).  Folding is split
+    out so the custom-vjp can keep the folded operands as residuals: the
+    backward kernels want exactly this layout, and re-deriving it from
+    (B,S,H,D) residuals cost a measured ~5 ms/step of pure HBM copies on
+    the GPT-2 345M profile (perf/gpt2_mfu_analysis.md, 'copy' row).
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+    bh, sq, d = qt.shape
+    b = bh // h
+    sk = kt.shape[1]
     has_bias = bias is not None
     has_segs = qseg is not None
-    block_q, block_k = _blocks_for(sq, sk, d, q.dtype, causal,
+    block_q, block_k = _blocks_for(sq, sk, d, qt.dtype, causal,
                                    has_bias or has_segs)
     n_kb = sk // block_k
     if has_bias:
@@ -300,11 +329,6 @@ def _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal):
         g_map = _bias_g_map(bb, hb, h)
     else:
         sqb, g_map = 1, None
-
-    # fold batch and heads; put seq last-but-one for tiling
-    qt = jnp.einsum("bshd->bhsd", q).reshape(b * h, sq, d)
-    kt = jnp.einsum("bshd->bhsd", k).reshape(b * h, sk, d)
-    vt = jnp.einsum("bshd->bhsd", v).reshape(b * h, sk, d)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, block_q=block_q, n_kb=n_kb,
@@ -315,7 +339,7 @@ def _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal):
     with jax.enable_x64(False):
         out, lse = pl.pallas_call(
             kernel,
-            grid=(b * h, sq // block_q, n_kb),
+            grid=(bh, sq // block_q, n_kb),
             in_specs=[
                 pl.BlockSpec((1, block_q, d),
                              lambda bh, qi, kb: (bh, qi, 0)),
@@ -332,8 +356,8 @@ def _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal):
                              lambda bh, qi, kb: (bh, qi, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-                jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+                jax.ShapeDtypeStruct((bh, sq, d), qt.dtype),
+                jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block_q, 1), jnp.float32),
@@ -342,7 +366,7 @@ def _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal):
             ],
             interpret=_INTERPRET,
         )(qt, kt, vt, *_mask_inputs(bias, qseg, kseg))
-    return jnp.einsum("bhsd->bshd", out.reshape(b, h, sq, d)), lse
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -514,16 +538,23 @@ def _bwd_dbias_kernel(*args, scale, causal, block_q, block_k, n_qb, n_r,
         db_ref[0] = db_scr[...].astype(db_ref.dtype)
 
 
-def _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, do, scale, causal,
-               want_dbias=True):
+def _flash_bwd_folded(qt, kt, vt, bias, qseg, kseg, ot, lse, do, scale,
+                      causal, h, want_dbias=True):
+    """Backward on the pre-folded residuals saved by the forward.
+
+    ``qt/kt/vt/ot`` are (B*H, S, D) — exactly the kernels' layout, so the
+    only layout transpose left in the whole backward is folding the
+    incoming ``do`` cotangent and unfolding the dq/dk/dv results.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+    bh, sq, d = qt.shape
+    b = bh // h
+    sk = kt.shape[1]
     has_bias = bias is not None
     has_segs = qseg is not None
-    block_q, block_k = _blocks_for(sq, sk, d, q.dtype, causal,
+    block_q, block_k = _blocks_for(sq, sk, d, qt.dtype, causal,
                                    has_bias or has_segs)
     n_qb = sq // block_q
     n_kb = sk // block_k
@@ -535,14 +566,10 @@ def _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, do, scale, causal,
     else:
         sqb, g_map = 1, None
 
-    qt = jnp.einsum("bshd->bhsd", q).reshape(b * h, sq, d)
-    kt = jnp.einsum("bshd->bhsd", k).reshape(b * h, sk, d)
-    vt = jnp.einsum("bshd->bhsd", v).reshape(b * h, sk, d)
-    dot = jnp.einsum("bshd->bhsd", do).reshape(b * h, sq, d)
+    dot = _fold(do, b, h)
     # delta_i = sum_d dO_i · O_i  (softmax-jacobian row term), O(S·D)
-    delta = jnp.einsum("bshd,bshd->bsh", do.astype(jnp.float32),
-                       o.astype(jnp.float32))
-    delta = jnp.einsum("bsh->bhs", delta).reshape(b * h, sq, 1)
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1, keepdims=True)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, kb: (bh, qi, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, qi, kb: (bh, kb, 0))
@@ -560,12 +587,12 @@ def _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, do, scale, causal,
             functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k, n_kb=n_kb,
                               off=off, has_bias=has_bias, has_segs=has_segs),
-            grid=(b * h, n_qb, n_kb),
+            grid=(bh, n_qb, n_kb),
             in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
             + _mask_specs(pl, b, h, sqb, g_map, block_q, block_k,
                           has_bias, has_segs, "qk"),
             out_specs=q_spec,
-            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            out_shape=jax.ShapeDtypeStruct((bh, sq, d), qt.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
             interpret=_INTERPRET,
         )(qt, kt, vt, dot, lse, delta, *mask_ins)
@@ -574,15 +601,15 @@ def _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, do, scale, causal,
             functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k, n_qb=n_qb,
                               off=off, has_bias=has_bias, has_segs=has_segs),
-            grid=(b * h, n_kb, n_qb),
+            grid=(bh, n_kb, n_qb),
             in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
                       row_spec_t]
             + _mask_specs(pl, b, h, sqb, g_map, block_q, block_k,
                           has_bias, has_segs, "kq"),
             out_specs=[k_spec_t, k_spec_t],
             out_shape=[
-                jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-                jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), kt.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), vt.dtype),
             ],
             scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                             pltpu.VMEM((block_k, d), jnp.float32)],
@@ -595,9 +622,8 @@ def _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, do, scale, causal,
                                 mask_ins, bias, qseg is not None, b, h, sq,
                                 sk, d, block_q, block_k, scale, causal, off)
 
-    unfold = lambda x, s: jnp.einsum(
-        "bhsd->bshd", x.reshape(b, h, s, d))
-    return (unfold(dq, sq), unfold(dk, sk), unfold(dv, sk), dbias)
+    return (_unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h),
+            dbias)
 
 
 def _dbias_call(pl, pltpu, qt, kt, vt, dot, lse, delta, mask_ins, bias,
@@ -692,14 +718,23 @@ def _flash(q, k, v, bias, qseg, kseg, causal, scale):
 
 
 def _fa_fwd(q, k, v, bias, qseg, kseg, causal, scale):
-    out, lse = _flash_fwd(q, k, v, bias, qseg, kseg, scale, causal)
-    return out, (q, k, v, bias, qseg, kseg, out, lse)
+    # fold ONCE; the folded operands + folded output are the residuals, so
+    # the backward kernels read them directly instead of re-deriving the
+    # (B*H, S, D) layout from (B,S,H,D) (a measured ~5 ms/step of copies
+    # on GPT-2 345M).  The head count is NOT a residual: the backward
+    # recovers it statically from the cotangent's (B, Sq, H, D) shape.
+    b, sq, h, d = q.shape
+    qt, kt, vt = _fold(q, b, h), _fold(k, b, h), _fold(v, b, h)
+    out_f, lse = _flash_fwd_folded(qt, kt, vt, bias, qseg, kseg, scale,
+                                   causal, h)
+    return _unfold(out_f, b, h), (qt, kt, vt, bias, qseg, kseg, out_f, lse)
 
 
 def _fa_bwd(causal, scale, res, g):
-    q, k, v, bias, qseg, kseg, o, lse = res
-    dq, dk, dv, dbias = _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, g,
-                                   scale, causal)
+    qt, kt, vt, bias, qseg, kseg, ot, lse = res
+    dq, dk, dv, dbias = _flash_bwd_folded(qt, kt, vt, bias, qseg, kseg,
+                                          ot, lse, g, scale, causal,
+                                          g.shape[2])
     dseg = None if qseg is None else jnp.zeros_like(qseg)
     dkseg = None if kseg is None else jnp.zeros_like(kseg)
     return (dq, dk, dv, dbias, dseg, dkseg)
@@ -720,9 +755,10 @@ def _flash_nodbias(q, k, v, bias, qseg, kseg, causal, scale):
 
 
 def _fa_bwd_nodbias(causal, scale, res, g):
-    q, k, v, bias, qseg, kseg, o, lse = res
-    dq, dk, dv, _ = _flash_bwd(q, k, v, bias, qseg, kseg, o, lse, g,
-                               scale, causal, want_dbias=False)
+    qt, kt, vt, bias, qseg, kseg, ot, lse = res
+    dq, dk, dv, _ = _flash_bwd_folded(qt, kt, vt, bias, qseg, kseg, ot,
+                                      lse, g, scale, causal, g.shape[2],
+                                      want_dbias=False)
     dbias = None if bias is None else jnp.zeros_like(bias)
     dseg = None if qseg is None else jnp.zeros_like(qseg)
     dkseg = None if kseg is None else jnp.zeros_like(kseg)

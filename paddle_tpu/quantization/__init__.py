@@ -298,16 +298,23 @@ def convert_to_int8_inference(model: Layer,
     from paddle_tpu.nn.layer.common import Linear
     from paddle_tpu.nn.layer.conv import Conv2D
 
+    # exact-type matches only: tensor-parallel Linear subclasses
+    # (Column/RowParallelLinear) carry sharding semantics (dist_attr,
+    # gather/reshard behaviour) that a plain Int8InferenceLinear would
+    # silently drop — they stay untouched
+    def _convertible_linear(m):
+        return type(m) is Linear
+
     def _convertible_conv(m):
-        return (convert_conv and isinstance(m, Conv2D)
+        return (convert_conv and type(m) is Conv2D
                 and m._data_format == "NCHW")
 
-    if isinstance(model, Linear):
+    if _convertible_linear(model):
         return _int8_of(model)
     if _convertible_conv(model):
         return _int8_of_conv(model)
     for name, child in list(model._sub_layers.items()):
-        if isinstance(child, Linear):
+        if _convertible_linear(child):
             model._sub_layers[name] = _int8_of(child)
         elif _convertible_conv(child):
             model._sub_layers[name] = _int8_of_conv(child)

@@ -156,3 +156,110 @@ class TestTrainStep:
                                     net_s.named_parameters()):
             np.testing.assert_allclose(_np(ps), _np(pe),
                                        rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+class TestMultiStep:
+    def test_multi_step_matches_sequential_steps(self):
+        """K scanned steps in one dispatch == K individual __call__ steps
+        (deterministic net: no dropout, so RNG key threading is moot)."""
+        paddle.seed(0)
+        net_a = SmallNet()
+        net_b = SmallNet()
+        net_b.set_state_dict(net_a.state_dict())
+        init_state = {k: np.asarray(v.numpy())
+                      for k, v in net_a.state_dict().items()}
+        K = 4
+        rng = np.random.default_rng(7)
+        xs = rng.normal(size=(K, 4, 8)).astype(np.float32)
+        ys = rng.normal(size=(K, 4, 4)).astype(np.float32)
+
+        def loss_fn(model, xb, yb):
+            return ((model(xb) - yb) ** 2).mean()
+
+        opt_a = paddle.optimizer.Adam(learning_rate=0.05,
+                                      parameters=net_a.parameters())
+        opt_b = paddle.optimizer.Adam(learning_rate=0.05,
+                                      parameters=net_b.parameters())
+        step_a = jit.TrainStep(net_a, loss_fn, opt_a)
+        step_b = jit.TrainStep(net_b, loss_fn, opt_b)
+
+        seq_losses = [float(step_a(paddle.to_tensor(xs[i]),
+                                   paddle.to_tensor(ys[i])))
+                      for i in range(K)]
+        multi_losses = step_b.multi_step(paddle.to_tensor(xs),
+                                         paddle.to_tensor(ys))
+        assert multi_losses.shape == [K]
+
+        np.testing.assert_allclose(np.asarray(multi_losses.numpy()),
+                                   np.asarray(seq_losses),
+                                   rtol=1e-4, atol=1e-5)
+        for (n, pa), (_, pb) in zip(net_a.named_parameters(),
+                                    net_b.named_parameters()):
+            np.testing.assert_allclose(_np(pb), _np(pa),
+                                       rtol=1e-4, atol=1e-5, err_msg=n)
+        assert opt_b._global_step == K
+        # the straight-line (unroll=True) variant must match too
+        paddle.seed(0)
+        net_c = SmallNet()
+        net_c.set_state_dict(
+            {k: paddle.to_tensor(v) for k, v in init_state.items()})
+        opt_c = paddle.optimizer.Adam(learning_rate=0.05,
+                                      parameters=net_c.parameters())
+        step_c = jit.TrainStep(net_c, loss_fn, opt_c)
+        unrolled = step_c.multi_step(paddle.to_tensor(xs),
+                                     paddle.to_tensor(ys), unroll=True)
+        np.testing.assert_allclose(np.asarray(unrolled.numpy()),
+                                   np.asarray(seq_losses),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_multi_step_amp_runs(self):
+        paddle.seed(1)
+        net = SmallNet()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+
+        def loss_fn(model, xb, yb):
+            return ((model(xb) - yb) ** 2).mean()
+
+        step = jit.TrainStep(net, loss_fn, opt, amp_level="O2",
+                             amp_dtype="bfloat16")
+        rng = np.random.default_rng(3)
+        xs = paddle.to_tensor(rng.normal(size=(3, 2, 8)).astype(np.float32))
+        ys = paddle.to_tensor(rng.normal(size=(3, 2, 4)).astype(np.float32))
+        losses = step.multi_step(xs, ys)
+        assert losses.shape == [3]
+        assert np.all(np.isfinite(np.asarray(losses.numpy())))
+
+    def test_multi_step_with_gradient_merge(self):
+        """accumulate_steps flows through the device loop: K scanned steps
+        each doing micro-batch gradient-merge == K individual calls."""
+        paddle.seed(2)
+        net_a = SmallNet()
+        net_b = SmallNet()
+        net_b.set_state_dict(net_a.state_dict())
+        K, micro = 3, 2
+        rng = np.random.default_rng(11)
+        xs = rng.normal(size=(K, 4, 8)).astype(np.float32)
+        ys = rng.normal(size=(K, 4, 4)).astype(np.float32)
+
+        def loss_fn(model, xb, yb):
+            return ((model(xb) - yb) ** 2).mean()
+
+        opt_a = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_a.parameters())
+        opt_b = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net_b.parameters())
+        step_a = jit.TrainStep(net_a, loss_fn, opt_a,
+                               accumulate_steps=micro)
+        step_b = jit.TrainStep(net_b, loss_fn, opt_b,
+                               accumulate_steps=micro)
+        seq = [float(step_a(paddle.to_tensor(xs[i]),
+                            paddle.to_tensor(ys[i]))) for i in range(K)]
+        multi = step_b.multi_step(paddle.to_tensor(xs),
+                                  paddle.to_tensor(ys))
+        np.testing.assert_allclose(np.asarray(multi.numpy()),
+                                   np.asarray(seq), rtol=1e-4, atol=1e-5)
+        for (n, pa), (_, pb) in zip(net_a.named_parameters(),
+                                    net_b.named_parameters()):
+            np.testing.assert_allclose(_np(pb), _np(pa),
+                                       rtol=1e-4, atol=1e-5, err_msg=n)
