@@ -361,6 +361,56 @@ class TrainStep:
 
         return one_step
 
+    def _prepare_dispatch(self, inputs):
+        """Shared prologue of __call__ and multi_step: live state grab,
+        lazy opt-state init, input conversion, RNG/lr draw."""
+        model = self.model
+        named_params = {n: p for n, p in model.named_parameters()}
+        named_buffers = {n: b for n, b in model.named_buffers()
+                         if b is not None}
+        params = {n: p._data for n, p in named_params.items()}
+        buffers = {n: b._data for n, b in named_buffers.items()}
+        if self._opt_states is None:
+            self._opt_states = self.optimizer.functional_init_states(params)
+        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        key = default_generator.split()
+        lr = jnp.float32(self.optimizer.get_lr())
+        return named_params, named_buffers, params, buffers, arrs, key, lr
+
+    def _note_avals(self, fn, arrs, key):
+        # for compiled_text(): only the jit fn + input avals (cheap tuple);
+        # param/state avals are derived lazily from live model state there
+        self._last_fn = fn
+        self._last_input_avals = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs)
+        self._last_key_aval = jax.ShapeDtypeStruct(key.shape, key.dtype)
+
+    def _commit_step(self, loss, what, named_params, new_params,
+                     named_buffers, new_buffers, new_states):
+        """Write the step's outputs into the live model, with the
+        check_nan_inf raise ordered around the writeback by donation:
+        donate=False raises BEFORE any mutation (the pre-step buffers are
+        alive, so the caller can catch and resume from valid state);
+        donate=True raises AFTER (the old buffers were consumed by the
+        jit call — an early raise would strand the model on deleted
+        arrays).  The finiteness reduce only dispatches when the flag is
+        armed — it is an eager op, i.e. one tunnel RPC per step."""
+        from paddle_tpu.framework.flags import flag
+        check = flag("check_nan_inf")
+        msg = (f"{what} produced a non-finite loss "
+               "(FLAGS_check_nan_inf is set)")
+        finite = True if not check else bool(jnp.all(jnp.isfinite(loss)))
+        if check and not self.donate and not finite:
+            raise FloatingPointError(msg)
+        self._opt_states = new_states
+        for n, p in named_params.items():
+            p._data = new_params[n]
+        for n, b in named_buffers.items():
+            b._data = new_buffers[n]
+        if check and self.donate and not finite:
+            raise FloatingPointError(msg)
+
     def _make_step(self):
         one_step = self._build_one_step()
 
@@ -424,17 +474,18 @@ class TrainStep:
         a lax.scan: compile time scales with K, but the scan's
         double-buffered carry (a second live copy of params + optimizer
         states) disappears — required when model+states fill most of HBM.
+
+        The learning rate is read ONCE at dispatch and held constant for
+        all K steps (unlike K ``__call__``s with a scheduler stepped in
+        between) — keep K within one scheduler interval, or step the
+        scheduler once per multi_step call.  RNG likewise: the host
+        generator is drawn once and per-step keys are jax.random.split
+        from it inside the loop, so stochastic layers (dropout) see
+        different — equally independent — randomness than K sequential
+        ``__call__``s, and the host generator advances once, not K times.
         """
-        model = self.model
-        named_params = {n: p for n, p in model.named_parameters()}
-        named_buffers = {n: b for n, b in model.named_buffers()
-                         if b is not None}
-        params = {n: p._data for n, p in named_params.items()}
-        buffers = {n: b._data for n, b in named_buffers.items()}
-        if self._opt_states is None:
-            self._opt_states = self.optimizer.functional_init_states(params)
-        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
-                for i in inputs]
+        named_params, named_buffers, params, buffers, arrs, key, lr = \
+            self._prepare_dispatch(inputs)
         sig = ("multi", bool(unroll)) + _sig_of(list(named_params.values())) \
             + _sig_of(arrs)
         fn = self._cache.get(sig)
@@ -442,72 +493,37 @@ class TrainStep:
             scan_fn, unrolled_fn = self._make_multi_step()
             fn = unrolled_fn if unroll else scan_fn
             self._cache[sig] = fn
-        key = default_generator.split()
-        lr = jnp.float32(self.optimizer.get_lr())
-        self._last_fn = fn
-        self._last_input_avals = tuple(
-            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs)
-        self._last_key_aval = jax.ShapeDtypeStruct(key.shape, key.dtype)
+        self._note_avals(fn, arrs, key)
         from paddle_tpu.profiler import RecordEvent
         with RecordEvent("TrainStep.multi_step"):
-            new_params, self._opt_states, new_buffers, losses = fn(
+            new_params, new_states, new_buffers, losses = fn(
                 params, self._opt_states, buffers, key, lr, *arrs)
-        from paddle_tpu.framework.flags import flag
-        if flag("check_nan_inf"):
-            # same per-step guard as __call__, swept over the K losses in
-            # one host sync
-            if not bool(jnp.all(jnp.isfinite(losses))):
-                raise FloatingPointError(
-                    "TrainStep.multi_step produced a non-finite loss "
-                    "(FLAGS_check_nan_inf is set)")
-        for n, p in named_params.items():
-            p._data = new_params[n]
-        for n, b in named_buffers.items():
-            b._data = new_buffers[n]
+        # same per-step guard as __call__, swept over the K losses in one
+        # host sync
+        self._commit_step(losses, "TrainStep.multi_step", named_params,
+                          new_params, named_buffers, new_buffers,
+                          new_states)
         self.optimizer._global_step += int(arrs[0].shape[0])
         return Tensor(losses)
 
     def __call__(self, *inputs):
-        model = self.model
-        named_params = {n: p for n, p in model.named_parameters()}
-        named_buffers = {n: b for n, b in model.named_buffers()
-                         if b is not None}
-        params = {n: p._data for n, p in named_params.items()}
-        buffers = {n: b._data for n, b in named_buffers.items()}
-        if self._opt_states is None:
-            self._opt_states = self.optimizer.functional_init_states(params)
-        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
-                for i in inputs]
+        named_params, named_buffers, params, buffers, arrs, key, lr = \
+            self._prepare_dispatch(inputs)
         sig = _sig_of(list(named_params.values())) + _sig_of(arrs)
         fn = self._cache.get(sig)
         if fn is None:
             fn = self._make_step()
             self._cache[sig] = fn
-        key = default_generator.split()
-        lr = jnp.float32(self.optimizer.get_lr())
-        # for compiled_text(): only the jit fn + input avals (cheap tuple);
-        # param/state avals are derived lazily from live model state there
-        self._last_fn = fn
-        self._last_input_avals = tuple(
-            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs)
-        self._last_key_aval = jax.ShapeDtypeStruct(key.shape, key.dtype)
+        self._note_avals(fn, arrs, key)
         from paddle_tpu.profiler import RecordEvent
         with RecordEvent("TrainStep"):
-            new_params, self._opt_states, new_buffers, loss = fn(
+            new_params, new_states, new_buffers, loss = fn(
                 params, self._opt_states, buffers, key, lr, *arrs)
-        from paddle_tpu.framework.flags import flag
-        if flag("check_nan_inf"):
-            # per-step sweep of the jitted tier (the eager per-op guard in
-            # core.apply cannot see inside the fused step) — nan_inf_utils
-            # role at step granularity; one scalar device->host sync.
-            if not bool(jnp.isfinite(loss)):
-                raise FloatingPointError(
-                    "TrainStep produced a non-finite loss "
-                    "(FLAGS_check_nan_inf is set)")
-        for n, p in named_params.items():
-            p._data = new_params[n]
-        for n, b in named_buffers.items():
-            b._data = new_buffers[n]
+        # per-step sweep of the jitted tier (the eager per-op guard in
+        # core.apply cannot see inside the fused step) — nan_inf_utils
+        # role at step granularity; one scalar device->host sync.
+        self._commit_step(loss, "TrainStep", named_params, new_params,
+                          named_buffers, new_buffers, new_states)
         self.optimizer._global_step += 1
         if self.optimizer._lr_scheduler is not None:
             pass  # user steps the scheduler explicitly, paddle-style

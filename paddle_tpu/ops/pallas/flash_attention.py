@@ -723,6 +723,12 @@ def _fa_fwd(q, k, v, bias, qseg, kseg, causal, scale):
     # (B*H, S, D) layout from (B,S,H,D) (a measured ~5 ms/step of copies
     # on GPT-2 345M).  The head count is NOT a residual: the backward
     # recovers it statically from the cotangent's (B, Sq, H, D) shape.
+    # Memory tradeoff: the folded out_f residual lives alongside the
+    # unfolded output until the backward consumes it — one extra
+    # activation-sized buffer per attention layer.  Under jax.checkpoint
+    # (remat, the near-capacity configuration) residuals are recomputed,
+    # not stored, so the cost applies only to no-remat runs with HBM to
+    # spare — exactly when the 5 ms matters more than the buffer.
     b, sq, h, d = q.shape
     qt, kt, vt = _fold(q, b, h), _fold(k, b, h), _fold(v, b, h)
     out_f, lse = _flash_fwd_folded(qt, kt, vt, bias, qseg, kseg, scale,

@@ -30,6 +30,7 @@ from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from paddle_tpu.jit import TrainStep
@@ -157,9 +158,8 @@ class ShardedTrainStep(TrainStep):
         return p_shard, opt_shard, buf_shard, in_shard
 
     # -- step build ---------------------------------------------------------
-    def _make_step(self, param_names, buffer_names, n_inputs, lr_is_arg):
-        base = super()._make_step(param_names, buffer_names, n_inputs,
-                                  lr_is_arg)
+    def _make_step(self):
+        base = super()._make_step()
         # Pull the un-jitted python callable back out: TrainStep returns
         # jax.jit(step); we re-jit with shardings, so call its wrapped fn.
         inner = base.__wrapped__
@@ -175,31 +175,62 @@ class ShardedTrainStep(TrainStep):
             out_shardings=(p_shard, opt_shard, buf_shard, repl),
             donate_argnums=donate)
 
-    def __call__(self, *inputs):
-        # place model params on the mesh once (parity: the reference's
-        # startup-program broadcast of initial params, sharding_optimizer's
-        # param→device assignment)
+    def _make_multi_step(self):
+        scan_fn, unrolled_fn = super()._make_multi_step()
+        p_shard, opt_shard, buf_shard, in_shard = self._pending_layouts
+        repl = _replicated(self.mesh)
+        # stacked inputs carry a leading K (steps) axis that stays
+        # unsharded; the per-step layout shifts right by one dim
+        stacked_in = [NamedSharding(self.mesh,
+                                    PartitionSpec(None, *s.spec))
+                      for s in in_shard]
+        donate = (0, 1, 2) if self.donate else ()
+        shardings = dict(
+            in_shardings=(p_shard, opt_shard, buf_shard, repl, repl,
+                          *stacked_in),
+            out_shardings=(p_shard, opt_shard, buf_shard, repl),
+            donate_argnums=donate)
+        return (jax.jit(scan_fn.__wrapped__, **shardings),
+                jax.jit(unrolled_fn.__wrapped__, **shardings))
+
+    def _cached_layouts(self, tag, inputs, strip_steps_axis):
+        """Memoized sharding layouts for the current param/input
+        structure.  Shapes/dtypes only — the device conversion of the
+        input payload happens once, inside the base-class step.  With
+        ``strip_steps_axis`` the layout is computed on the per-step slice
+        shapes (the stacked leading K axis must not eat the data_axes
+        annotation)."""
         model = self.model
-        named_params = {n: p for n, p in model.named_parameters()}
-        named_buffers = {n: b for n, b in model.named_buffers()
-                         if b is not None}
-        params = {n: p._data for n, p in named_params.items()}
-        buffers = {n: b._data for n, b in named_buffers.items()}
+        params = {n: p._data for n, p in model.named_parameters()}
+        buffers = {n: b._data for n, b in model.named_buffers()
+                   if b is not None}
         if self._opt_states is None:
             self._opt_states = self.optimizer.functional_init_states(params)
-        arrs = [i._data if hasattr(i, "_data") else jnp.asarray(i)
-                for i in inputs]
-        # layouts depend only on param/input structure — memoize off the
-        # hot path (the per-step cost is one key build, not a pytree walk)
-        lkey = (tuple(params), tuple((a.shape, str(a.dtype)) for a in arrs),
+        avals = [(tuple(i._data.shape), i._data.dtype)
+                 if hasattr(i, "_data") else
+                 (np.shape(i), np.asarray(i).dtype) for i in inputs]
+        slices = [jax.ShapeDtypeStruct(s[1:] if strip_steps_axis else s, d)
+                  for s, d in avals]
+        lkey = (tag, tuple(params),
+                tuple((s, str(d)) for s, d in avals),
                 self.sharding_stage)
         cache = getattr(self, "_layout_cache", None)
         if cache is None:
             cache = self._layout_cache = {}
         if lkey not in cache:
             cache[lkey] = self._layouts(params, self._opt_states, buffers,
-                                        arrs)
-        self._pending_layouts = cache[lkey]
+                                        slices)
+        return cache[lkey]
+
+    def multi_step(self, *inputs, unroll: bool = False):
+        self._pending_layouts = self._cached_layouts("multi", inputs, True)
+        return super().multi_step(*inputs, unroll=unroll)
+
+    def __call__(self, *inputs):
+        # place model params on the mesh once (parity: the reference's
+        # startup-program broadcast of initial params, sharding_optimizer's
+        # param→device assignment)
+        self._pending_layouts = self._cached_layouts("step", inputs, False)
         return super().__call__(*inputs)
 
     # -- introspection (compile-only test tier) -----------------------------
@@ -217,7 +248,7 @@ class ShardedTrainStep(TrainStep):
                 for i in inputs]
         self._pending_layouts = self._layouts(params, self._opt_states,
                                               buffers, arrs)
-        fn = self._make_step(list(params), list(buffers), len(arrs), True)
+        fn = self._make_step()
         key = jax.random.PRNGKey(0)
         lr = jnp.float32(self.optimizer.get_lr())
         lowered = fn.lower(params, self._opt_states, buffers, key, lr, *arrs)

@@ -1,0 +1,186 @@
+"""DGC exchange vs dense psum — the crossover measurement.
+
+VERDICT r4 weak 7: ``DGCTrainStep.compress`` reconstructs a dense buffer
+per tensor per step (`parallel/dp_meta.py`), and its docstring asserts
+"on a single-pod ICI mesh a dense psum is usually faster" without a
+number.  This script grounds that guidance: it times the two exchange
+strategies in isolation (no model, no optimizer) at 1M/10M/100M-element
+tensors on the virtual dp=8 CPU mesh and writes
+``perf/dgc_crossover.md``.
+
+What each arm does, per tensor, per step:
+
+  dense:  g_bar = pmean(g)                       wire: size * 4 bytes
+  dgc:    k = size*(1-sparsity); top_k(|v|);      wire: k * 8 * dp bytes
+          all_gather(vals, idx); scatter-add
+          into a dense zeros buffer; error-
+          feedback writes back into u, v
+
+The *wire* term is what DGC is for (DCN-connected hosts); the compute
+term (top_k + the dense reconstruction) is what it costs.  On a CPU
+mesh the "wire" is memcpy, so this measures the compute/memory side of
+the crossover — the side weak 7 said was unmeasured.  Pass ``--chip``
+to run on the real accelerator instead (dp=1 there, so the chip row is
+the single-shard compute cost only).
+
+Reference role: paddle/fluid/operators/dgc_op.* (the CUDA compress
+kernels) + framework/details/dgc helpers.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+if "--chip" not in sys.argv:
+    # the virtual 8-device mesh is the default; the env var alone is NOT
+    # honored once the accelerator plugin registers, so force it through
+    # jax.config too (same dance as tests/conftest.py)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8")
+
+import jax
+
+if "--chip" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SIZES = [1_000_000, 10_000_000, 100_000_000]
+SPARSITY = 0.999
+
+
+def _time(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def build(mesh, size, dp):
+    k = max(1, int(round(size * (1.0 - SPARSITY))))
+
+    def dense_local(g):
+        return jax.lax.pmean(g.astype(jnp.float32), "dp")
+
+    def dgc_local(g, u, v):
+        # the exact exchange pipeline from parallel/dp_meta.py::compress
+        g = g.astype(jnp.float32)
+        u = 0.9 * u + g
+        v = v + u
+        flat = v.reshape(-1)
+        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        vals = flat[idx]
+        g_vals = jax.lax.all_gather(vals, "dp")
+        g_idx = jax.lax.all_gather(idx, "dp")
+        dense = jnp.zeros((size,), jnp.float32).at[
+            g_idx.reshape(-1)].add(g_vals.reshape(-1)) / dp
+        flat_v = flat.at[idx].set(0.0)
+        flat_u = u.reshape(-1).at[idx].set(0.0)
+        return dense, flat_u, flat_v
+
+    specs_g = (P("dp"),)
+    dense_fn = jax.jit(shard_map(
+        lambda g: dense_local(g[0])[None],
+        mesh=mesh, in_specs=specs_g, out_specs=P("dp"), check_vma=False))
+    dgc_fn = jax.jit(shard_map(
+        lambda g, u, v: tuple(
+            o[None] for o in dgc_local(g[0], u[0], v[0])),
+        mesh=mesh, in_specs=(P("dp"),) * 3,
+        out_specs=(P("dp"),) * 3, check_vma=False),
+        donate_argnums=(1, 2))
+    return dense_fn, dgc_fn, k
+
+
+def main():
+    devs = jax.devices()
+    dp = len(devs)
+    mesh = Mesh(np.array(devs), ("dp",))
+    shard = NamedSharding(mesh, P("dp"))
+    rows = []
+    for size in SIZES:
+        rng = np.random.default_rng(size)
+        g = jax.device_put(
+            rng.standard_normal((dp, size), dtype=np.float32), shard)
+        u = jax.device_put(jnp.zeros((dp, size), jnp.float32), shard)
+        v = jax.device_put(jnp.zeros((dp, size), jnp.float32), shard)
+        dense_fn, dgc_fn, k = build(mesh, size, dp)
+        reps = 5 if size < 100_000_000 else 2
+        t_dense = _time(dense_fn, g, reps=reps)
+
+        def dgc_step(g, u, v):
+            return dgc_fn(g, u, v)
+        # donation consumes u/v; re-make per timing rep via closure state
+        out = dgc_fn(g, u, v)
+        jax.block_until_ready(out)
+        _, u2, v2 = out
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = dgc_fn(g, u2, v2)
+            _, u2, v2 = out
+        jax.block_until_ready(out)
+        t_dgc = (time.perf_counter() - t0) / reps
+
+        wire_dense = size * 4
+        wire_dgc = k * 8 * dp
+        rows.append({
+            "size": size, "k": k, "dp": dp,
+            "dense_ms": round(t_dense * 1e3, 2),
+            "dgc_ms": round(t_dgc * 1e3, 2),
+            "dgc_over_dense": round(t_dgc / t_dense, 2),
+            "wire_dense_mb": round(wire_dense / 1e6, 2),
+            "wire_dgc_mb": round(wire_dgc / 1e6, 3),
+            "wire_ratio": round(wire_dense / wire_dgc, 1),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+        del g, u, v, out, u2, v2
+
+    md = ["# DGC exchange vs dense psum — measured crossover",
+          "",
+          f"Virtual dp={dp} CPU mesh ({jax.devices()[0].platform}), "
+          f"sparsity={SPARSITY} (k=size/1000), per-tensor pipeline "
+          "identical to `parallel/dp_meta.py::compress`.",
+          "",
+          "| elements | dense psum (ms) | DGC exchange (ms) | DGC/dense | "
+          "wire dense (MB) | wire DGC (MB) | wire saving |",
+          "|---|---|---|---|---|---|---|"]
+    for r in rows:
+        md.append(
+            f"| {r['size']:,} | {r['dense_ms']} | {r['dgc_ms']} | "
+            f"{r['dgc_over_dense']}× | {r['wire_dense_mb']} | "
+            f"{r['wire_dgc_mb']} | {r['wire_ratio']}× |")
+    worst = max(r["dgc_over_dense"] for r in rows)
+    best = min(r["dgc_over_dense"] for r in rows)
+    best_wire = max(r["wire_ratio"] for r in rows)
+    md += ["",
+           "**Conclusion.** The compute side of DGC (top-k over the "
+           "error accumulator + dense scatter-add reconstruction) costs "
+           f"{best}–{worst}× a dense psum at these "
+           "sizes on this mesh, while the wire payload shrinks "
+           f"~{best_wire:.0f}×.  That is the crossover the "
+           "`DGCTrainStep` docstring asserts: on an ICI-connected pod, "
+           "where the dense all-reduce rides ~100s of GB/s links, pay "
+           "the dense psum; DGC wins only when the interconnect is the "
+           "bottleneck (DCN multi-host, where a 1000× wire saving "
+           "dwarfs the compute overhead).  Use "
+           "`DistributedStrategy.dgc` for DCN topologies and leave it "
+           "off inside a pod.",
+           ""]
+    out_path = os.path.join(os.path.dirname(__file__), "dgc_crossover.md")
+    with open(out_path, "w") as f:
+        f.write("\n".join(md))
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -243,6 +243,35 @@ def test_sharded_step_matches_single_device():
                                    atol=2e-6)
 
 
+def test_sharded_multi_step_matches_sequential():
+    # regression: the multi_step refactor changed TrainStep._make_step to
+    # zero-arg; ShardedTrainStep must track it AND shard the stacked
+    # (K, B, ...) inputs with the data axis on dim 1, not dim 0
+    rng = np.random.default_rng(1)
+    K = 3
+    xs = rng.standard_normal((K, 16, 16)).astype(np.float32)
+    ys = rng.integers(0, 4, size=(K, 16)).astype(np.int64)
+
+    model_a, opt_a = _mk()
+    step_a = ShardedTrainStep(model_a, _loss_fn, opt_a,
+                              mesh=make_mesh({"dp": 8}))
+    losses_a = [float(step_a(paddle.to_tensor(xs[i]),
+                             paddle.to_tensor(ys[i]))) for i in range(K)]
+
+    model_b, opt_b = _mk()
+    step_b = ShardedTrainStep(model_b, _loss_fn, opt_b,
+                              mesh=make_mesh({"dp": 8}))
+    multi = step_b.multi_step(paddle.to_tensor(xs), paddle.to_tensor(ys))
+    assert tuple(multi.shape) == (K,)
+    np.testing.assert_allclose(losses_a, np.asarray(multi._data),
+                               rtol=2e-5, atol=2e-6)
+    for (n, pa), (_, pb) in zip(model_a.named_parameters(),
+                                model_b.named_parameters()):
+        np.testing.assert_allclose(np.asarray(pa._data),
+                                   np.asarray(pb._data), rtol=2e-5,
+                                   atol=2e-6)
+
+
 def test_sharded_step_zero_stages_match():
     rng = np.random.default_rng(1)
     x = rng.standard_normal((16, 16)).astype(np.float32)
