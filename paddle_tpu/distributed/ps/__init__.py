@@ -161,18 +161,36 @@ class AsyncCommunicator:
         self._stop = threading.Event()
         self._thread = None
         if mode == "async":
-            self._thread = threading.Thread(target=self._worker,
-                                            daemon=True)
+            # the thread holds only a WEAK reference to the communicator:
+            # a live thread target with a strong ref would pin the (tens
+            # of GB) host table forever after the embedding is dropped —
+            # the worker exits on its own once the communicator is
+            # collected (or stop() is called)
+            import weakref
+            self._thread = threading.Thread(
+                target=AsyncCommunicator._worker_loop,
+                args=(weakref.ref(self),), daemon=True)
             self._thread.start()
 
-    def _worker(self):
-        while not self._stop.is_set():
+    @staticmethod
+    def _worker_loop(comm_ref):
+        while True:
+            comm = comm_ref()
+            if comm is None or comm._stop.is_set():
+                return
+            q = comm._q
+            del comm                 # don't pin the table across the wait
             try:
-                ids, grads = self._q.get(timeout=0.05)
+                ids, grads = q.get(timeout=0.05)
             except queue.Empty:
                 continue
-            self.table.push(ids, grads)
-            self._q.task_done()
+            comm = comm_ref()
+            if comm is None:
+                q.task_done()
+                return
+            comm.table.push(ids, grads)
+            q.task_done()
+            del comm
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
         if self.mode == "sync":

@@ -21,15 +21,13 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Optional, Tuple
-
 _PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      "flash_blocks.json")
 _cache = None
 _lock = threading.Lock()
 
-# set via force_blocks() during measurement
-_FORCE: Optional[Tuple[int, int]] = None
+# set via force_blocks() during measurement; keys "both"/"fwd"/"bwd"
+_FORCE: dict = {}
 
 CANDIDATES = [(256, 256), (256, 512), (512, 256), (512, 512),
               (1024, 512), (512, 1024)]
@@ -48,22 +46,31 @@ def _load() -> dict:
     return _cache
 
 
-def _key(sq, sk, d, dtype, causal, biased) -> str:
-    return (f"{sq}x{sk}:d{d}:{dtype}:"
+def _key(sq, sk, d, dtype, causal, biased, direction="fwd") -> str:
+    base = (f"{sq}x{sk}:d{d}:{dtype}:"
             f"{'causal' if causal else 'full'}:"
             f"{'bias' if biased else 'nobias'}")
+    # fwd keeps the historical key so shipped flash_blocks.json entries
+    # stay valid; bwd entries are suffixed
+    return base if direction == "fwd" else base + ":" + direction
 
 
-def lookup(sq, sk, d, dtype, causal, biased):
-    if _FORCE is not None:
-        return _FORCE
-    hit = _load().get(_key(sq, sk, d, str(dtype), causal, biased))
+def lookup(sq, sk, d, dtype, causal, biased, direction="fwd"):
+    forced = _FORCE.get(direction, _FORCE.get("both"))
+    if forced is not None:
+        return forced
+    c = _load()
+    hit = c.get(_key(sq, sk, d, str(dtype), causal, biased, direction))
+    if hit is None and direction != "fwd":
+        # fall back to the direction-less (fwd) measurement
+        hit = c.get(_key(sq, sk, d, str(dtype), causal, biased))
     return tuple(hit) if hit else None
 
 
-def record(sq, sk, d, dtype, causal, biased, blocks, persist=True):
+def record(sq, sk, d, dtype, causal, biased, blocks, persist=True,
+           direction="fwd"):
     c = _load()
-    c[_key(sq, sk, d, str(dtype), causal, biased)] = list(blocks)
+    c[_key(sq, sk, d, str(dtype), causal, biased, direction)] = list(blocks)
     if persist:
         try:
             with _lock, open(_PATH, "w") as f:
@@ -73,20 +80,24 @@ def record(sq, sk, d, dtype, causal, biased, blocks, persist=True):
 
 
 class force_blocks:
-    """Context manager pinning the kernel block choice (measurement)."""
+    """Context manager pinning the kernel block choice (measurement).
+    ``direction`` pins only the forward ("fwd") or backward ("bwd")
+    kernels; default pins both."""
 
-    def __init__(self, bq: int, bk: int):
+    def __init__(self, bq: int, bk: int, direction: str = "both"):
         self._blocks = (bq, bk)
+        self._direction = direction
 
     def __enter__(self):
-        global _FORCE
-        self._prev = _FORCE
-        _FORCE = self._blocks
+        self._prev = _FORCE.get(self._direction)
+        _FORCE[self._direction] = self._blocks
         return self
 
     def __exit__(self, *exc):
-        global _FORCE
-        _FORCE = self._prev
+        if self._prev is None:
+            _FORCE.pop(self._direction, None)
+        else:
+            _FORCE[self._direction] = self._prev
         return False
 
 
@@ -95,15 +106,9 @@ def _fence(x):
     np.asarray(x)
 
 
-def measure(sq, sk, d, dtype="bfloat16", causal=False, biased=False,
-            batch=1, heads=8, iters=3, persist=True, verbose=False):
-    """Time fwd+bwd per candidate on the current device; record winner."""
-    import jax
+def _bench_inputs(sq, sk, d, dtype, biased, batch, heads):
     import jax.numpy as jnp
     import numpy as np
-    import time
-
-    from paddle_tpu.ops.pallas import flash_attention as fa
 
     jdt = jnp.bfloat16 if str(dtype) == "bfloat16" else jnp.float32
     rng = np.random.default_rng(0)
@@ -114,33 +119,99 @@ def measure(sq, sk, d, dtype="bfloat16", causal=False, biased=False,
     if biased:
         bias = jnp.asarray(
             rng.standard_normal((batch, 1, 1, sk)) * 0.0, jnp.float32)
+    return q, k, v, bias
 
-    def loss(q_, k_, v_):
-        out = fa.flash_attention(q_, k_, v_, causal=causal, bias=bias)
-        return out.astype(jnp.float32).sum()
+
+def _sweep(sq, sk, make_fn, args, iters, direction="both", verbose=False):
+    """Time make_fn() per viable (bq, bk) candidate with that candidate
+    forced for ``direction``; returns {(bq, bk): seconds}."""
+    import time
 
     results = {}
     for bq, bk in CANDIDATES:
         if bq > sq or bk > sk or sq % bq or sk % bk:
             continue
         try:
-            with force_blocks(bq, bk):
-                f = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
-                val, grads = f(q, k, v)          # compile + warm
-                _fence(val)
+            with force_blocks(bq, bk, direction=direction):
+                f = make_fn()
+                out = f(*args)                   # compile + warm
+                _fence(out[0] if isinstance(out, tuple) else out)
                 t0 = time.perf_counter()
                 for _ in range(iters):
-                    val, grads = f(q, k, v)
-                _fence(val)
+                    out = f(*args)
+                _fence(out[0] if isinstance(out, tuple) else out)
                 dt = (time.perf_counter() - t0) / iters
             results[(bq, bk)] = dt
             if verbose:
-                print(f"  ({bq},{bk}): {dt*1e3:.2f} ms")
+                print(f"  {direction} ({bq},{bk}): {dt*1e3:.2f} ms")
         except Exception as e:                   # noqa: BLE001
             if verbose:
-                print(f"  ({bq},{bk}): failed {e!r}")
+                print(f"  {direction} ({bq},{bk}): failed {e!r}")
+    return results
+
+
+def _loss_fn(causal, bias):
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    def loss(q_, k_, v_):
+        out = fa.flash_attention(q_, k_, v_, causal=causal, bias=bias)
+        return out.astype(jnp.float32).sum()
+
+    return loss
+
+
+def measure(sq, sk, d, dtype="bfloat16", causal=False, biased=False,
+            batch=1, heads=8, iters=3, persist=True, verbose=False):
+    """Time fwd+bwd per candidate on the current device; record winner."""
+    import jax
+
+    q, k, v, bias = _bench_inputs(sq, sk, d, dtype, biased, batch, heads)
+    loss = _loss_fn(causal, bias)
+    results = _sweep(sq, sk,
+                     lambda: jax.jit(jax.value_and_grad(
+                         loss, argnums=(0, 1, 2))),
+                     (q, k, v), iters, verbose=verbose)
     if not results:
         return None
     best = min(results, key=results.get)
     record(sq, sk, d, dtype, causal, biased, best, persist=persist)
     return best, results
+
+
+def measure_split(sq, sk, d, dtype="bfloat16", causal=False, biased=False,
+                  batch=1, heads=8, iters=3, persist=True, verbose=False):
+    """Tune fwd and bwd block sizes independently.
+
+    Pass 1 times the forward alone per candidate and records the "fwd"
+    winner; pass 2, with the forward pinned to that winner, times
+    fwd+bwd per candidate and records the "bwd" winner (bwd-only time
+    isn't separable under jit, but with fwd pinned the candidate axis
+    only moves the backward kernels).
+    """
+    import jax
+
+    q, k, v, bias = _bench_inputs(sq, sk, d, dtype, biased, batch, heads)
+    loss = _loss_fn(causal, bias)
+
+    fwd_res = _sweep(sq, sk, lambda: jax.jit(loss), (q, k, v), iters,
+                     direction="fwd", verbose=verbose)
+    if not fwd_res:
+        return None
+    fwd_best = min(fwd_res, key=fwd_res.get)
+    record(sq, sk, d, dtype, causal, biased, fwd_best, persist=persist,
+           direction="fwd")
+
+    with force_blocks(*fwd_best, direction="fwd"):
+        bwd_res = _sweep(sq, sk,
+                         lambda: jax.jit(jax.value_and_grad(
+                             loss, argnums=(0, 1, 2))),
+                         (q, k, v), iters, direction="bwd",
+                         verbose=verbose)
+    if not bwd_res:
+        return (fwd_best, fwd_res), None
+    bwd_best = min(bwd_res, key=bwd_res.get)
+    record(sq, sk, d, dtype, causal, biased, bwd_best, persist=persist,
+           direction="bwd")
+    return (fwd_best, fwd_res), (bwd_best, bwd_res)

@@ -144,12 +144,15 @@ def _pick_block(pref: int, seq: int) -> int:
     return max(b, _MIN_BLOCK)
 
 
-def _blocks_for(sq, sk, d, dtype, causal, biased):
+def _blocks_for(sq, sk, d, dtype, causal, biased, direction="fwd"):
     """(block_q, block_k) — the measured autotune cache first (keyed on
-    shape/dtype/mask class), else the BLOCK_Q/K heuristic; either way
-    halved until it divides the sequence."""
+    shape/dtype/mask class and, for the backward, the direction: the
+    dq/dkv kernels have different per-tile reuse than the forward so
+    their winning tile can differ), else the BLOCK_Q/K heuristic; either
+    way halved until it divides the sequence."""
     from paddle_tpu.ops.pallas import autotune
-    hit = autotune.lookup(sq, sk, d, str(dtype), causal, biased)
+    hit = autotune.lookup(sq, sk, d, str(dtype), causal, biased,
+                          direction=direction)
     bq, bk = hit if hit else (BLOCK_Q, BLOCK_K)
     return _pick_block(bq, sq), _pick_block(bk, sk)
 
@@ -385,7 +388,10 @@ def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off,
         k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(q_idx + off >= k_idx, s, -jnp.inf)
     p = jnp.exp(s - lse)
-    return jnp.where(jnp.isfinite(s) & jnp.isfinite(lse), p, 0.0)
+    # masked entries (s=-inf, lse finite) already exp to 0; the only nan
+    # source is a fully-masked row (lse=-inf), so one (bq,1) row guard
+    # replaces two full-tile isfinite sweeps
+    return jnp.where(jnp.isfinite(lse), p, 0.0)
 
 
 def _split_bwd_args(args, has_bias, has_segs, n_out):
@@ -555,7 +561,7 @@ def _flash_bwd_folded(qt, kt, vt, bias, qseg, kseg, ot, lse, do, scale,
     has_bias = bias is not None
     has_segs = qseg is not None
     block_q, block_k = _blocks_for(sq, sk, d, qt.dtype, causal,
-                                   has_bias or has_segs)
+                                   has_bias or has_segs, direction="bwd")
     n_qb = sq // block_q
     n_kb = sk // block_k
     off = sk - sq

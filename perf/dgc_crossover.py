@@ -118,9 +118,8 @@ def main():
         reps = 5 if size < 100_000_000 else 2
         t_dense = _time(dense_fn, g, reps=reps)
 
-        def dgc_step(g, u, v):
-            return dgc_fn(g, u, v)
-        # donation consumes u/v; re-make per timing rep via closure state
+        # donation consumes u/v: thread each rep's outputs back in as the
+        # next rep's inputs instead of re-feeding the consumed buffers
         out = dgc_fn(g, u, v)
         jax.block_until_ready(out)
         _, u2, v2 = out

@@ -50,6 +50,15 @@ def main():
 
     out = {"bench": {}, "ops": {}}
 
+    import gc
+    raw_path = "perf/variance_raw.json"
+
+    def checkpoint():
+        # crash insurance: a wedged tunnel or host OOM mid-study must not
+        # lose the completed measurements
+        with open(raw_path, "w") as f:
+            json.dump(out, f, indent=1)
+
     for fn, metric in [
         (bench.bench_longseq_flash,
          "gpt_longseq8k_flashattn_train_tokens_per_sec"),
@@ -61,13 +70,18 @@ def main():
             v = capture_bench(fn, metric)
             vals.append(v)
             print(f"{metric} run {i+1}/{N}: {v:.1f}", flush=True)
-        out["bench"][metric] = vals
+            # the PS leg builds a ~26 GB host table per run — reclaim it
+            # before the next build, not at interpreter exit
+            gc.collect()
+            out["bench"][metric] = vals
+            checkpoint()
 
     for i in range(N):
         for cfg in op_bench.BUILTIN_SUITE:
             r = op_bench.run_one(cfg, warmup=3, iters=10)
             out["ops"].setdefault(r["name"], []).append(r["ms"])
         print(f"op suite pass {i+1}/{N} done", flush=True)
+        checkpoint()
 
     # -- write markdown ----------------------------------------------------
     lines = ["# Run-to-run variance study (round 4)", "",

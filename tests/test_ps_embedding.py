@@ -52,6 +52,34 @@ def test_async_communicator_applies_all():
     comm.stop()
 
 
+def test_async_communicator_thread_does_not_pin_table():
+    # regression: the worker thread held a strong ref to the communicator
+    # (hence the table), so every dropped DistributedEmbedding leaked its
+    # full host table — a 26 GB/run leak that OOM-killed the variance
+    # study.  The thread must hold only a weakref and exit on collection.
+    import gc
+    import time
+    import weakref
+
+    t = HostEmbeddingTable(50, 2, optimizer="sgd", learning_rate=1.0,
+                           initializer_range=0.0)
+    comm = AsyncCommunicator(t, mode="async")
+    comm.push(np.asarray([3]), np.ones((1, 2), np.float32))
+    comm.flush()
+    thread = comm._thread
+    table_ref = weakref.ref(t)
+    del comm, t
+    # the worker transiently holds a strong ref for a few bytecodes per
+    # 0.05s wait — poll rather than assert on a single collect
+    deadline = time.monotonic() + 2.0
+    while table_ref() is not None and time.monotonic() < deadline:
+        gc.collect()
+        time.sleep(0.02)
+    assert table_ref() is None, "worker thread still pins the table"
+    thread.join(timeout=2.0)
+    assert not thread.is_alive(), "worker thread did not exit"
+
+
 def test_geo_communicator_folds_every_k():
     t = HostEmbeddingTable(50, 2, optimizer="sgd", learning_rate=1.0,
                            initializer_range=0.0)

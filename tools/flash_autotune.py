@@ -35,6 +35,8 @@ def main(argv=None) -> int:
     ap.add_argument("--causal", action="store_true")
     ap.add_argument("--biased", action="store_true")
     ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--split", action="store_true",
+                    help="tune fwd and bwd block sizes independently")
     a = ap.parse_args(argv)
 
     from paddle_tpu.ops.pallas import autotune
@@ -49,13 +51,23 @@ def main(argv=None) -> int:
     for sq, sk, d, dt, causal, biased in configs:
         print(f"config sq={sq} sk={sk} d={d} {dt} "
               f"causal={causal} biased={biased}")
-        out = autotune.measure(sq, sk, d, dt, causal, biased,
-                               iters=a.iters, verbose=True)
-        if out is None:
-            print("  no viable candidate")
+        if a.split:
+            out = autotune.measure_split(sq, sk, d, dt, causal, biased,
+                                         iters=a.iters, verbose=True)
+            if out is None:
+                print("  no viable candidate")
+            else:
+                fwd, bwd = out
+                print(f"  -> fwd {fwd[0]}" +
+                      (f", bwd {bwd[0]}" if bwd else ""))
         else:
-            best, _ = out
-            print(f"  -> {best}")
+            out = autotune.measure(sq, sk, d, dt, causal, biased,
+                                   iters=a.iters, verbose=True)
+            if out is None:
+                print("  no viable candidate")
+            else:
+                best, _ = out
+                print(f"  -> {best}")
     return 0
 
 
