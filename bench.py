@@ -167,8 +167,21 @@ def bench_gpt2_345m(on_accel):
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
                                         size=(B, S)).astype(np.int32))
     iters = 10 if on_accel else 3
-    dt, _ = _timeit(lambda: step(ids, ids), 3, iters)
-    tps = B * S * iters / dt
+    if on_accel:
+        # K batches per device dispatch (TrainStep.multi_step): the
+        # reference's DeviceWorker trains its whole batch queue inside
+        # one C++ Executor call with no Python between steps
+        # (device_worker.cc TrainFiles); per-step host dispatch over the
+        # tunnel costs ~11 ms/step that the device loop amortizes away.
+        # Step math is unchanged (tests/test_jit.py multi-step parity).
+        K, reps = iters, 2
+        xs = paddle.to_tensor(rng.integers(
+            0, cfg.vocab_size, size=(K, B, S)).astype(np.int32))
+        dt, _ = _timeit(lambda: step.multi_step(xs, xs), 1, reps)
+        tps = K * B * S * reps / dt
+    else:
+        dt, _ = _timeit(lambda: step(ids, ids), 3, iters)
+        tps = B * S * iters / dt
     _emit("gpt2_345m_train_tokens_per_sec_per_chip_bf16", tps, "tokens/s",
           tps / V100_GPT2_345M_TOKENS_PER_SEC)
 

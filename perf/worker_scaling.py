@@ -98,8 +98,9 @@ def main():
     ] + [f"| {r['num_workers']} | {r['examples_per_sec']} |" for r in rows] + [
         "",
         f"One 1-vCPU host, 96px RandomResizedCrop pipeline.  A single "
-        f"worker process delivers **{eff1:.2f}×** the in-process rate — "
-        "the IPC + pickling tax on a dedicated core — and two processes "
+        f"worker process delivers **{eff1:.2f}×** the in-process rate "
+        "(net of the IPC + pickling tax and the decode/batch-assembly "
+        "overlap a worker buys) and two processes "
         f"time-slicing the same core aggregate to **{agg2:.2f}×** the "
         "one-worker rate (≈1.0 means the pool scheduling itself costs "
         "nothing; the core is the only bottleneck).  Folding the "

@@ -21,7 +21,7 @@ def _compare(results, baseline, threshold=0.1, thresholds=None, tmp=None):
 
     calls = iter(results)
     orig = op_bench.run_one
-    op_bench.run_one = lambda cfg, iters=10: next(calls)
+    op_bench.run_one = lambda cfg, **kw: next(calls)
     try:
         argv = ["--compare", baseline, "--threshold", str(threshold)]
         if thresholds:
@@ -38,10 +38,10 @@ def _compare(results, baseline, threshold=0.1, thresholds=None, tmp=None):
 
 
 def test_gate_catches_planted_130pct_regression(tmp_path):
-    base = [{"name": "matmul_1k", "ms": 10.0, "device": "tpu"},
-            {"name": "softmax_8kx1k", "ms": 5.0, "device": "tpu"}]
-    cur = [{"name": "matmul_1k", "ms": 13.0, "device": "tpu"},   # 1.3x
-           {"name": "softmax_8kx1k", "ms": 5.1, "device": "tpu"}]
+    base = [{"name": "matmul_1k", "ms": 10.0, "scan_len": 1000, "device": "tpu"},
+            {"name": "softmax_8kx1k", "ms": 5.0, "scan_len": 1000, "device": "tpu"}]
+    cur = [{"name": "matmul_1k", "ms": 13.0, "scan_len": 1000, "device": "tpu"},   # 1.3x
+           {"name": "softmax_8kx1k", "ms": 5.1, "scan_len": 1000, "device": "tpu"}]
     bp = tmp_path / "base.json"
     bp.write_text(json.dumps(base))
     # measured per-op thresholds (if the study has run) must be < 0.30 so
@@ -57,8 +57,8 @@ def test_gate_catches_planted_130pct_regression(tmp_path):
 
 
 def test_gate_passes_within_jitter(tmp_path):
-    base = [{"name": "matmul_1k", "ms": 10.0, "device": "tpu"}]
-    cur = [{"name": "matmul_1k", "ms": 10.8, "device": "tpu"}]  # +8%
+    base = [{"name": "matmul_1k", "ms": 10.0, "scan_len": 1000, "device": "tpu"}]
+    cur = [{"name": "matmul_1k", "ms": 10.8, "scan_len": 1000, "device": "tpu"}]  # +8%
     bp = tmp_path / "base.json"
     bp.write_text(json.dumps(base))
     rc = _compare(cur, str(bp), threshold=0.15, tmp=str(tmp_path))
@@ -66,8 +66,8 @@ def test_gate_passes_within_jitter(tmp_path):
 
 
 def test_gate_skips_cross_device_baselines(tmp_path):
-    base = [{"name": "matmul_1k", "ms": 0.1, "device": "tpu"}]
-    cur = [{"name": "matmul_1k", "ms": 50.0, "device": "cpu"}]
+    base = [{"name": "matmul_1k", "ms": 0.1, "scan_len": 1000, "device": "tpu"}]
+    cur = [{"name": "matmul_1k", "ms": 50.0, "scan_len": 1000, "device": "cpu"}]
     bp = tmp_path / "base.json"
     bp.write_text(json.dumps(base))
     rc = _compare(cur, str(bp), threshold=0.1, tmp=str(tmp_path))

@@ -83,22 +83,108 @@ def _sync(out):
     np.asarray(arr)
 
 
-def run_one(cfg, warmup=3, iters=10):
+_MANY_CACHE: dict = {}
+_SCAN_LEN_CACHE: dict = {}
+
+
+def run_one(cfg, iters=10, repeats=3):
+    """Tunnel-immune op timing via a two-length scan difference.
+
+    The op is chained ``L`` times through one jitted lax.scan (a real
+    data dependency links iterations), dispatched once.  A single
+    amortized timing still carries the dispatch+fetch RTT (~90 ms here,
+    swinging 1.5-2x between passes — it dominated every per-call
+    estimate this replaced); timing a short scan and a long scan and
+    dividing the delta by the iteration difference cancels the RTT
+    exactly.  The long length is calibrated per op to ~1 s of device
+    time and cached, as are the compiled scans; min-of-``repeats``
+    strips residual jitter.  Baseline and CI gate share this estimator.
+    Warmup needs no knob: each compiled scan gets one untimed call.
+
+    ``iters`` sets the short length (and the calibration probe);
+    regression detection quality depends on the long leg, so the
+    default is fine almost always."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import Tensor
+
     fn = _resolve(cfg["op"])
+    name = cfg.get("name", cfg["op"])
+    # cache on the full config, not the name: a custom --config suite may
+    # repeat an op with different args/kwargs under the same default name
+    ckey = json.dumps(cfg, sort_keys=True, default=str)
     rng = np.random.default_rng(0)
     args = [_make_arg(a, rng) for a in cfg.get("args", [])]
     kwargs = cfg.get("kwargs", {})
-    for _ in range(warmup):
-        out = fn(*args, **kwargs)
-    _sync(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args, **kwargs)
-    _sync(out)
-    dt = (time.perf_counter() - t0) / iters
-    import jax
-    return {"name": cfg.get("name", cfg["op"]), "op": cfg["op"],
-            "ms": round(dt * 1e3, 4), "device": jax.default_backend()}
+    arrs = [a._data if isinstance(a, Tensor) else a for a in args]
+    was_t = [isinstance(a, Tensor) for a in args]
+    # chain the carry through the first float operand: a `* 0` dependency
+    # is constant-folded and the op hoisted out of the scan (measured:
+    # embedding_bag "ran" in 8.8 us); a sub-ulp runtime value is not
+    ci = next((i for i, a in enumerate(arrs)
+               if jnp.issubdtype(a.dtype, jnp.floating)), 0)
+    chain_dt = arrs[ci].dtype
+
+    def core(*xs):
+        targs = [Tensor(x) if t else x for x, t in zip(xs, was_t)]
+        out = fn(*targs, **kwargs)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return out._data if isinstance(out, Tensor) else out
+
+    def many_of(length):
+        key = (ckey, length)
+        got = _MANY_CACHE.get(key)
+        if got is not None:
+            return got
+
+        @jax.jit
+        def many(*xs):
+            def body(c, _):
+                mod = list(xs)
+                mod[ci] = xs[ci] + c
+                out = core(*mod)
+                dep = out.mean().astype(chain_dt) * \
+                    jnp.asarray(1e-30, chain_dt)
+                return c + dep, None
+            c, _ = jax.lax.scan(body, jnp.zeros((), chain_dt), None,
+                                length=length)
+            return c
+
+        _MANY_CACHE[key] = many
+        return many
+
+    def timed(many, reps):
+        out = many(*arrs)                    # compile + device warm
+        np.asarray(jax.device_get(out))
+        best = None
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            out = many(*arrs)
+            np.asarray(jax.device_get(out))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    l_small = max(4, iters)
+    t_small = timed(many_of(l_small), repeats)
+    l_big = _SCAN_LEN_CACHE.get(ckey)
+    if l_big is None:
+        l_probe = l_small + 512
+        t_probe = timed(many_of(l_probe), 2)
+        per_iter = max((t_probe - t_small) / (l_probe - l_small), 1e-8)
+        # ~1 s of device time on the long leg: the tunnel's ±20 ms
+        # dispatch jitter then contributes <3% to the difference
+        l_big = l_small + int(min(max(1.0 / per_iter, 64), 400_000))
+        _SCAN_LEN_CACHE[ckey] = l_big
+    # a later call with a larger l_small than the cached calibration must
+    # not collapse the difference leg
+    l_big = max(l_big, l_small + 64)
+    t_big = timed(many_of(l_big), repeats)
+    dt = max(t_big - t_small, 0.0) / (l_big - l_small)
+    return {"name": name, "op": cfg["op"], "ms": round(dt * 1e3, 5),
+            "scan_len": l_big, "device": jax.default_backend()}
 
 
 def eager_vs_jit_bench(iters=30, batch=64):
@@ -388,6 +474,9 @@ def main(argv=None):
                          "see perf/variance_study.py); falls back to "
                          "--threshold for ops not listed")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="timing passes per op; the min is reported "
+                         "(tunnel-spike robustness)")
     a = ap.parse_args(argv)
 
     if a.eager:
@@ -418,7 +507,7 @@ def main(argv=None):
     results = []
     for cfg in suite:
         try:
-            r = run_one(cfg, iters=a.iters)
+            r = run_one(cfg, iters=a.iters, repeats=a.repeats)
         except Exception as e:               # noqa: BLE001
             r = {"name": cfg.get("name", cfg.get("op")), "error": repr(e)}
         results.append(r)
@@ -430,6 +519,13 @@ def main(argv=None):
     if a.compare:
         with open(a.compare) as f:
             base = {r["name"]: r for r in json.load(f) if "ms" in r}
+        stale = [n for n, r in base.items() if "scan_len" not in r]
+        if stale:
+            print(f"baseline {a.compare} predates the scan-difference "
+                  f"estimator (entries without scan_len: {stale}); "
+                  "re-record it with --save on this hardware — comparing "
+                  "across estimators would gate nothing", file=sys.stderr)
+            return 2
         per_op = {}
         if a.thresholds:
             with open(a.thresholds) as f:
