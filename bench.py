@@ -167,21 +167,13 @@ def bench_gpt2_345m(on_accel):
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
                                         size=(B, S)).astype(np.int32))
     iters = 10 if on_accel else 3
-    if on_accel:
-        # K batches per device dispatch (TrainStep.multi_step): the
-        # reference's DeviceWorker trains its whole batch queue inside
-        # one C++ Executor call with no Python between steps
-        # (device_worker.cc TrainFiles); per-step host dispatch over the
-        # tunnel costs ~11 ms/step that the device loop amortizes away.
-        # Step math is unchanged (tests/test_jit.py multi-step parity).
-        K, reps = iters, 2
-        xs = paddle.to_tensor(rng.integers(
-            0, cfg.vocab_size, size=(K, B, S)).astype(np.int32))
-        dt, _ = _timeit(lambda: step.multi_step(xs, xs), 1, reps)
-        tps = K * B * S * reps / dt
-    else:
-        dt, _ = _timeit(lambda: step(ids, ids), 3, iters)
-        tps = B * S * iters / dt
+    # NOT multi_step here: its lax.scan double-buffers the carry (a
+    # second live copy of 345M params + adam states), and at B=8
+    # no-remat the model already fills HBM — measured 4.6k tok/s of
+    # host spill vs 39k+ with per-step dispatch.  The device loop pays
+    # off for dispatch-bound models (see bench_lenet), not HBM-bound.
+    dt, _ = _timeit(lambda: step(ids, ids), 3, iters)
+    tps = B * S * iters / dt
     _emit("gpt2_345m_train_tokens_per_sec_per_chip_bf16", tps, "tokens/s",
           tps / V100_GPT2_345M_TOKENS_PER_SEC)
 

@@ -30,7 +30,7 @@ _lock = threading.Lock()
 _FORCE: dict = {}
 
 CANDIDATES = [(256, 256), (256, 512), (512, 256), (512, 512),
-              (1024, 512), (512, 1024)]
+              (1024, 512), (512, 1024), (1024, 1024)]
 
 
 def _load() -> dict:
