@@ -30,6 +30,7 @@ import jax
 import numpy as np
 
 from paddle_tpu.core import Tensor
+from paddle_tpu.framework import chaos
 
 __all__ = ["save_sharded", "load_sharded", "restore_like",
            "save_train_state", "load_train_state"]
@@ -72,9 +73,34 @@ def _shard_fname(leaf_idx: int, index) -> str:
     return f"leaf{leaf_idx}." + ("_".join(parts) or "scalar") + ".npy"
 
 
+def _atomic_save(dirpath: str, fname: str, arr: np.ndarray):
+    """Crash-safe shard write: the ``ckpt.save`` chaos point fires before
+    the bytes land (simulating a kill mid-save), and the tmp+rename commit
+    means a torn write can never leave a half-written ``.npy`` under the
+    final name — the two-slot TrainEpochRange protocol on top then
+    guarantees a loadable committed slot survives any single crash."""
+    chaos.fault_point("ckpt.save", meta={"file": fname})
+    final = os.path.join(dirpath, fname)
+    tmp = final + f".tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.save(f, arr)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save_sharded(state: Any, dirpath: str, step: Optional[int] = None):
     """Write ``state`` (nested dict/list of arrays) as a sharded checkpoint
-    directory.  Every process writes only its addressable replica-0 shards."""
+    directory.  Every process writes only its addressable replica-0 shards.
+    Each file commits via tmp+rename (see ``_atomic_save``) so a crash at
+    any instant leaves no torn file under a final name."""
     os.makedirs(dirpath, exist_ok=True)
     leaves: list = []
     skel = _leafify(state, leaves, "")
@@ -86,7 +112,7 @@ def save_sharded(state: Any, dirpath: str, step: Optional[int] = None):
             for s in shards:
                 index = s.index
                 fname = _shard_fname(i, index)
-                np.save(os.path.join(dirpath, fname), np.asarray(s.data))
+                _atomic_save(dirpath, fname, np.asarray(s.data))
                 rec_shards.append({
                     "file": fname,
                     "index": [[sl.start or 0,
@@ -99,7 +125,7 @@ def save_sharded(state: Any, dirpath: str, step: Optional[int] = None):
         else:
             a = np.asarray(arr)
             fname = f"leaf{i}.full.npy"
-            np.save(os.path.join(dirpath, fname), a)
+            _atomic_save(dirpath, fname, a)
             meta_leaves.append({"path": path, "shape": list(a.shape),
                                 "dtype": str(a.dtype),
                                 "shards": [{"file": fname,
@@ -108,8 +134,13 @@ def save_sharded(state: Any, dirpath: str, step: Optional[int] = None):
     pid = jax.process_index() if jax.process_count() > 1 else 0
     meta = {"skeleton": skel, "leaves": meta_leaves, "step": step}
     if pid == 0:
-        with open(os.path.join(dirpath, _META), "w") as f:
-            json.dump(meta, f)
+        # metadata is written LAST and atomically: its presence marks the
+        # shard set complete, so a kill mid-save leaves a directory that
+        # load_sharded refuses (no metadata) rather than silently-partial
+        chaos.fault_point("ckpt.save", meta={"file": _META})
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+        LocalFS().atomic_write(os.path.join(dirpath, _META),
+                               json.dumps(meta))
 
 
 def _window_reader(dirpath: str, rec: dict) -> Callable:

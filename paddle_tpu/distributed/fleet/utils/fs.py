@@ -84,6 +84,9 @@ class FS:
     def cat(self, fs_path) -> str:
         raise NotImplementedError
 
+    def atomic_write(self, fs_path, data):
+        raise NotImplementedError
+
 
 class LocalFS(FS):
     """Local-disk implementation (reference fs.py:115)."""
@@ -161,6 +164,31 @@ class LocalFS(FS):
     def cat(self, fs_path):
         with open(fs_path) as f:
             return f.read()
+
+    def atomic_write(self, fs_path, data):
+        """Crash-safe write: tmp file + fsync + os.replace, so a kill at
+        any instant leaves either the old file or the new one — never a
+        torn mix.  The ``fs.write`` chaos point sits in the torn-write
+        window (after the tmp write, before the rename) so the
+        fault-injection suite can prove exactly that property."""
+        from paddle_tpu.framework import chaos
+        mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+        tmp = f"{fs_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, mode) as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            chaos.fault_point("fs.write", meta={"path": fs_path})
+            os.replace(tmp, fs_path)           # atomic commit point
+        except BaseException:
+            # a simulated crash leaves the destination untouched; drop
+            # the orphan tmp so transient errors don't accumulate litter
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
 
 
 class HDFSClient(FS):
@@ -261,3 +289,44 @@ class HDFSClient(FS):
     def cat(self, fs_path):
         _, out = self._run("-cat", fs_path)
         return out
+
+    def atomic_write(self, fs_path, data):
+        """Crash-safe write over the ``hadoop fs`` shell.  The shell has
+        no atomic overwrite-rename, so this is commit-with-backup rather
+        than LocalFS's single rename: upload to tmp, move any existing
+        file aside, ``-mv`` the tmp into place, drop the backup.  A crash
+        at any instant leaves the old content recoverable — at
+        ``fs_path`` or ``fs_path.old`` — never lost, and never a torn
+        file under the final name."""
+        import tempfile
+
+        from paddle_tpu.framework import chaos
+        mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+        with tempfile.NamedTemporaryFile(mode, delete=False) as f:
+            f.write(data)
+            local = f.name
+        remote_tmp = f"{fs_path}.tmp.{os.getpid()}"
+        backup = f"{fs_path}.old"
+        try:
+            self._run("-put", "-f", local, remote_tmp)
+            chaos.fault_point("fs.write", meta={"path": fs_path})
+            had_old = self.is_exist(fs_path)
+            if had_old:
+                self.delete(backup)
+                self._run("-mv", fs_path, backup)
+            try:
+                self._run("-mv", remote_tmp, fs_path)
+            except ExecuteError:
+                if had_old:                     # put the old file back
+                    self._run("-mv", backup, fs_path)
+                raise
+            if had_old:
+                self.delete(backup)
+        except BaseException:
+            try:
+                self.delete(remote_tmp)         # no tmp litter on failure
+            except ExecuteError:
+                pass
+            raise
+        finally:
+            os.remove(local)

@@ -161,38 +161,80 @@ class AsyncCommunicator:
         self._stop = threading.Event()
         self._thread = None
         if mode == "async":
-            # the thread holds only a WEAK reference to the communicator:
-            # a live thread target with a strong ref would pin the (tens
-            # of GB) host table forever after the embedding is dropped —
-            # the worker exits on its own once the communicator is
-            # collected (or stop() is called)
+            # the thread holds only WEAK references to the communicator
+            # and its table: a live thread target with a strong ref would
+            # pin the (tens of GB) host table forever after the embedding
+            # is dropped — the worker exits on its own once the
+            # communicator is collected (or stop() is called).  The table
+            # weakref is separate so that when the communicator dies but
+            # the table is still alive elsewhere, queued pushes DRAIN
+            # into it instead of being dropped (see push()).
             import weakref
             self._thread = threading.Thread(
                 target=AsyncCommunicator._worker_loop,
-                args=(weakref.ref(self),), daemon=True)
+                args=(weakref.ref(self), weakref.ref(table)), daemon=True)
             self._thread.start()
 
     @staticmethod
-    def _worker_loop(comm_ref):
-        while True:
-            comm = comm_ref()
-            if comm is None or comm._stop.is_set():
+    def _drain_queue(q: "queue.Queue", table):
+        """Apply every still-queued push to ``table`` (no-op when the
+        table is gone too) — the communicator-collected exit path, so
+        queued gradients land instead of being silently dropped whenever
+        the table is independently alive."""
+        while table is not None:
+            try:
+                ids, grads = q.get_nowait()
+            except queue.Empty:
                 return
-            q = comm._q
-            del comm                 # don't pin the table across the wait
+            try:
+                table.push(ids, grads)
+            finally:
+                q.task_done()
+
+    @staticmethod
+    def _worker_loop(comm_ref, table_ref):
+        comm = comm_ref()
+        if comm is None:
+            return
+        # q/stop are plain attributes — holding them pins neither the
+        # communicator nor the table
+        q, stop = comm._q, comm._stop
+        del comm
+        while True:
+            if stop.is_set():
+                return
             try:
                 ids, grads = q.get(timeout=0.05)
             except queue.Empty:
+                if comm_ref() is None:
+                    AsyncCommunicator._drain_queue(q, table_ref())
+                    return
                 continue
             comm = comm_ref()
             if comm is None:
-                q.task_done()
+                table = table_ref()
+                try:
+                    if table is not None:
+                        table.push(ids, grads)
+                finally:
+                    q.task_done()
+                AsyncCommunicator._drain_queue(q, table)
                 return
             comm.table.push(ids, grads)
             q.task_done()
-            del comm
+            del comm                 # don't pin the table across the wait
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
+        """Queue (async), accumulate (geo) or apply (sync) a gradient push.
+
+        Flush-before-drop contract: async-mode pushes are applied by a
+        worker thread holding only weak references.  If the communicator
+        is garbage-collected with pushes still queued, the worker drains
+        them into the table only when the table is independently alive;
+        when communicator and table die together (the common
+        DistributedEmbedding case) queued pushes are dropped.  Call
+        ``flush()`` (or ``stop()``) before releasing the last reference
+        whenever every queued gradient must land."""
         if self.mode == "sync":
             self.table.push(ids, grads)
         elif self.mode == "async":

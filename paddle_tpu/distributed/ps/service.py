@@ -32,6 +32,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from paddle_tpu.distributed.ps import HostEmbeddingTable
+from paddle_tpu.framework import chaos
+from paddle_tpu.framework.flags import flag
 
 __all__ = ["PsServer", "PsClient", "RemoteEmbeddingTable",
            "HeartBeatMonitor", "serve"]
@@ -100,6 +102,17 @@ class HeartBeatMonitor:
         with self._lock:
             self._beats[worker] = time.monotonic()
             self._reported.discard(worker)
+
+    def mark_dead(self, worker: str):
+        """Force-report a peer dead NOW (no timeout wait) — the PS client
+        calls this when an endpoint exhausts its RPC retries, so transport
+        death surfaces through the same channel as heartbeat silence."""
+        with self._lock:
+            self._beats[worker] = time.monotonic() - (self.timeout + 1.0)
+            already = worker in self._reported
+            self._reported.add(worker)
+        if not already and self.on_dead is not None:
+            self.on_dead(worker)
 
     def workers(self) -> Dict[str, float]:
         now = time.monotonic()
@@ -262,16 +275,43 @@ class PsServer:
 # ---------------------------------------------------------------------------
 
 class _Conn:
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, timeout: Optional[float] = None):
+        self.endpoint = endpoint
         host, port = endpoint.rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)), timeout=30)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._addr = (host, int(port))
+        self.timeout = float(flag("ps_rpc_timeout")) if timeout is None \
+            else timeout
         self.lock = threading.Lock()
+        self.sock = self._connect()
+
+    def _connect(self):
+        sock = socket.create_connection(self._addr, timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
 
     def rpc(self, header: dict, bufs=()):
+        # injected drops/latency fire BEFORE the send (and before the
+        # lock), so a retried call cannot double-apply a non-idempotent
+        # push and an injected drop never desyncs a healthy socket
+        chaos.fault_point("ps.rpc", meta={"op": header.get("op"),
+                                          "endpoint": self.endpoint})
         with self.lock:
-            _send_msg(self.sock, header, bufs)
-            reply, rbufs = _recv_msg(self.sock)
+            if self.sock is None:
+                self.sock = self._connect()    # lazy redial after failure
+            try:
+                _send_msg(self.sock, header, bufs)
+                reply, rbufs = _recv_msg(self.sock)
+            except (ConnectionError, OSError):
+                # the stream may be mid-message: invalidate UNDER the
+                # lock so no concurrent caller (e.g. the heartbeat
+                # thread vs a pull fan-out) can ever read a stale
+                # partial reply as its own
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = None
+                raise
         if not reply.get("ok", False):
             raise RuntimeError(f"ps rpc {header.get('op')} failed: "
                                f"{reply.get('error')}")
@@ -286,21 +326,88 @@ class _Conn:
 
 class PsClient:
     """Routes rows to shards by ``id % n_servers`` and fans requests out in
-    parallel (brpc_ps_client.cc pull_sparse semantics)."""
+    parallel (brpc_ps_client.cc pull_sparse semantics).
+
+    Transport failures (dropped connection, timeout, injected ``ps.rpc``
+    chaos) are retried with exponential backoff — ``sleep(backoff_base *
+    2^attempt)`` between attempts, the socket redialed each time — up to
+    ``max_retries`` retries per RPC (FLAGS_ps_rpc_max_retries /
+    FLAGS_ps_rpc_backoff_base / FLAGS_ps_rpc_timeout).  An endpoint that
+    exhausts its retries is appended to ``dead_endpoints``, reported to
+    the optional ``monitor`` (HeartBeatMonitor.mark_dead) and to the
+    ``on_endpoint_dead`` callback, then the error propagates — the same
+    lost-peer channel heart_beat_monitor.cc feeds.  Application-level
+    errors (server replied ok=False) are NOT retried.
+
+    Retry idempotence: a retry re-sends only when the previous attempt
+    failed before a reply was read.  ``pull`` is idempotent anyway; a
+    ``push`` whose reply was lost AFTER the server applied it would
+    double-apply on retry — the in-tree injection fires before the send
+    precisely so the chaos suite proves the common (request-lost) case
+    exactly."""
 
     def __init__(self, endpoints: Sequence[str],
-                 worker_id: Optional[str] = None):
+                 worker_id: Optional[str] = None,
+                 monitor: Optional[HeartBeatMonitor] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_base: Optional[float] = None,
+                 timeout: Optional[float] = None):
         self.endpoints = list(endpoints)
-        self._conns = [_Conn(ep) for ep in self.endpoints]
+        self._conns = [_Conn(ep, timeout=timeout) for ep in self.endpoints]
         self._pool = ThreadPoolExecutor(max_workers=max(
             2, len(self.endpoints)))
         self.worker_id = worker_id or f"worker-{os.getpid()}"
+        self.monitor = monitor
+        self.max_retries = int(flag("ps_rpc_max_retries")) \
+            if max_retries is None else int(max_retries)
+        self.backoff_base = float(flag("ps_rpc_backoff_base")) \
+            if backoff_base is None else float(backoff_base)
+        self.dead_endpoints: List[str] = []
+        self._dead_lock = threading.Lock()
+        self.on_endpoint_dead = None       # callback(endpoint, exception)
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
 
     @property
     def n(self):
         return len(self._conns)
+
+    # -- retrying transport -------------------------------------------------
+    def _rpc(self, s: int, header: dict, bufs=(),
+             retries: Optional[int] = None):
+        conn, ep = self._conns[s], self.endpoints[s]
+        retries = self.max_retries if retries is None else retries
+        last: Optional[Exception] = None
+        for attempt in range(retries + 1):
+            try:
+                reply, rbufs = conn.rpc(header, bufs)
+                with self._dead_lock:              # recovered
+                    if ep in self.dead_endpoints:
+                        self.dead_endpoints.remove(ep)
+                if self.monitor is not None:
+                    self.monitor.beat(ep)
+                return reply, rbufs
+            except RuntimeError:
+                raise                      # server-side error: don't retry
+            except (ConnectionError, OSError) as e:
+                last = e
+                if attempt < retries:
+                    # conn.rpc invalidated the socket; the next attempt
+                    # redials lazily under the connection lock
+                    time.sleep(self.backoff_base * (2 ** attempt))
+        self._report_dead(ep, last)
+        raise ConnectionError(
+            f"ps endpoint {ep} dead after {retries + 1} attempts "
+            f"of {header.get('op')!r}: {last!r}")
+
+    def _report_dead(self, endpoint: str, exc: Optional[Exception]):
+        with self._dead_lock:
+            if endpoint not in self.dead_endpoints:
+                self.dead_endpoints.append(endpoint)
+        if self.monitor is not None:
+            self.monitor.mark_dead(endpoint)
+        if self.on_endpoint_dead is not None:
+            self.on_endpoint_dead(endpoint, exc)
 
     # -- sparse ops ---------------------------------------------------------
     def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
@@ -312,8 +419,8 @@ class PsClient:
             mask = owner == s
             if not mask.any():
                 return s, mask, None
-            _, rows = self._conns[s].rpc(
-                {"op": "pull", "table": table}, [flat[mask]])
+            _, rows = self._rpc(
+                s, {"op": "pull", "table": table}, [flat[mask]])
             return s, mask, rows[0]
 
         first_dim = None
@@ -340,15 +447,26 @@ class PsClient:
         def one(s):
             mask = owner == s
             if mask.any():
-                self._conns[s].rpc({"op": "push", "table": table,
-                                    "lr": lr}, [flat[mask], g[mask]])
+                self._rpc(s, {"op": "push", "table": table,
+                              "lr": lr}, [flat[mask], g[mask]])
 
         list(self._pool.map(one, range(self.n)))
 
     # -- liveness -----------------------------------------------------------
     def heartbeat(self):
-        for c in self._conns:
-            c.rpc({"op": "heartbeat", "worker": self.worker_id})
+        """Beat every endpoint, in parallel and WITHOUT retries: the next
+        interval is the retry, and blocking retries on one dead endpoint
+        would starve beats to the healthy servers — exactly the false
+        lost-worker report the heartbeat exists to prevent.  A failing
+        endpoint is skipped (and reported dead via _rpc's exhaustion
+        path); the next successful beat revives it."""
+        def one(s):
+            try:
+                self._rpc(s, {"op": "heartbeat",
+                              "worker": self.worker_id}, retries=0)
+            except (ConnectionError, OSError):
+                pass
+        list(self._pool.map(one, range(self.n)))
 
     def start_heartbeat(self, interval: float = 5.0):
         def loop():
@@ -363,7 +481,7 @@ class PsClient:
 
     # -- admin --------------------------------------------------------------
     def stat(self, server: int = 0):
-        reply, _ = self._conns[server].rpc({"op": "stat"})
+        reply, _ = self._rpc(server, {"op": "stat"})
         return reply
 
     def bye(self):

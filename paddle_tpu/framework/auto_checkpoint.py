@@ -73,11 +73,13 @@ class TrainEpochRange:
             return None
 
     def _write_status(self, epoch: int, slot: str):
-        tmp = self._status_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"name": self.name, "epoch": epoch, "slot": slot,
-                       "time": time.time()}, f)
-        os.replace(tmp, self._status_path())   # atomic flip = commit point
+        # atomic flip = commit point; routed through the chaos-instrumented
+        # crash-safe writer so kill-mid-commit is injectable (fs.write)
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+        LocalFS().atomic_write(
+            self._status_path(),
+            json.dumps({"name": self.name, "epoch": epoch, "slot": slot,
+                        "time": time.time()}))
 
     # -- save ---------------------------------------------------------------
     def save_checkpoint(self, epoch: int):

@@ -1,0 +1,279 @@
+"""Deterministic fault-injection ("chaos") registry.
+
+The reference treats failure handling as a first-class subsystem —
+heartbeat lost-worker monitoring (operators/distributed/
+heart_beat_monitor.cc), auto-checkpoint crash recovery
+(fluid/incubate/checkpoint/auto_checkpoint.py TrainEpochRange), per-op
+NaN/Inf watching (FLAGS_check_nan_inf) — but none of it is provable
+without a way to *cause* the faults on demand.  This module is that
+way: a seedable registry of named fault points threaded through the
+layers that must survive them.
+
+Fault points shipped in-tree (grep for ``fault_point(`` to audit):
+
+=================  ========================================================
+``ps.rpc``          client side of every PS RPC (ps/service.py _Conn.rpc)
+``fs.write``        crash-safe file writes (fleet/utils/fs.py atomic_write)
+``ckpt.save``       per-file checkpoint writes (distributed/checkpoint.py)
+``download.fetch``  each fetch attempt (utils/download.py)
+``train.step_grads`` per-step input poisoning (framework/resilient.py)
+=================  ========================================================
+
+Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
+the Nth call), ``every`` (trip every Nth call), ``p`` (seeded
+probability), bounded by ``n_times``.  A trip applies the point's
+``mode``: ``"error"`` raises :class:`InjectedFault`, ``"latency"``
+sleeps ``latency`` seconds then proceeds, ``"nan"`` NaN-poisons float
+arrays in the payload and returns them.
+
+Arming paths, in precedence order:
+
+* the :func:`inject` context manager (tests):
+    ``with chaos.inject("ps.rpc", mode="error", nth=3): ...``
+* env flags read once at first use (so a launcher can arm a whole
+  child-process tree): ``FLAGS_chaos_spec`` is a JSON object
+  ``{"<point>": {"mode": ..., "nth": ..., ...}}``, ``FLAGS_chaos_seed``
+  seeds the probability stream.
+
+When nothing is armed a fault point is one dict lookup — cheap enough
+to leave in production paths.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
+           "disarm", "stats", "reset", "arm_from_flags", "FAULT_POINTS",
+           "register_fault_point"]
+
+FAULT_POINTS = ("ps.rpc", "fs.write", "ckpt.save", "download.fetch",
+                "train.step_grads")
+_known_points = set(FAULT_POINTS)
+# points whose fault_point() call carries a payload (the only ones where
+# mode="nan" can transform anything)
+_payload_points = {"train.step_grads"}
+
+
+def register_fault_point(name: str, carries_payload: bool = False):
+    """Declare a custom fault point so arm()/FLAGS_chaos_spec accept it.
+    In-tree points are pre-registered; arming an UNDECLARED name raises —
+    a typo'd spec silently injecting nothing is exactly the
+    false-green-chaos-run this registry exists to prevent.  Pass
+    ``carries_payload=True`` when your fault_point() call site hands in
+    arrays, to unlock ``mode="nan"`` for it."""
+    _known_points.add(name)
+    if carries_payload:
+        _payload_points.add(name)
+    return name
+
+
+class InjectedFault(ConnectionError):
+    """Raised by an armed ``mode="error"`` fault point.
+
+    Subclasses ConnectionError so transport-layer retry paths (PS RPC)
+    treat an injected drop exactly like a real one; elsewhere it
+    propagates like the crash it simulates."""
+
+
+class FaultSpec:
+    """One armed fault point's schedule + mode."""
+
+    def __init__(self, mode: str = "error", nth: Optional[int] = None,
+                 every: Optional[int] = None, p: float = 0.0,
+                 latency: float = 0.0, n_times: Optional[int] = None,
+                 message: str = ""):
+        if mode not in ("error", "latency", "nan"):
+            raise ValueError(f"unknown chaos mode {mode!r}")
+        self.mode = mode
+        self.nth = nth
+        self.every = every
+        self.p = float(p)
+        self.latency = float(latency)
+        self.n_times = n_times
+        self.message = message
+        self.calls = 0
+        self.trips = 0
+
+    def should_trip(self, rng: np.random.Generator) -> bool:
+        self.calls += 1
+        if self.n_times is not None and self.trips >= self.n_times:
+            return False
+        hit = False
+        if self.nth is not None and self.calls == self.nth:
+            hit = True
+        if self.every is not None and self.calls % self.every == 0:
+            hit = True
+        if self.p > 0.0 and rng.random() < self.p:
+            hit = True
+        if hit:
+            self.trips += 1
+        return hit
+
+
+class ChaosRegistry:
+    def __init__(self, seed: int = 0):
+        self._specs: Dict[str, FaultSpec] = {}
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self.armed = False               # fast-path gate for fault_point
+
+    def arm(self, name: str, **spec) -> FaultSpec:
+        if name not in _known_points:
+            raise ValueError(
+                f"unknown fault point {name!r} — in-tree points: "
+                f"{sorted(_known_points)}; declare custom sites with "
+                "register_fault_point() first")
+        if spec.get("mode") == "nan" and name not in _payload_points:
+            raise ValueError(
+                f"fault point {name!r} carries no payload — mode='nan' "
+                "would inject nothing (false-green chaos); payload "
+                f"points: {sorted(_payload_points)}")
+        fs = FaultSpec(**spec)
+        with self._lock:
+            self._specs[name] = fs
+            self.armed = True
+        return fs
+
+    def disarm(self, name: Optional[str] = None):
+        with self._lock:
+            if name is None:
+                self._specs.clear()
+            else:
+                self._specs.pop(name, None)
+            self.armed = bool(self._specs)
+
+    def reseed(self, seed: int):
+        self._rng = np.random.default_rng(seed)
+
+    def fire(self, name: str, payload: Any = None, meta: dict = None):
+        spec = self._specs.get(name)
+        if spec is None:
+            return payload
+        with self._lock:
+            trip = spec.should_trip(self._rng)
+        if not trip:
+            return payload
+        if spec.mode == "latency":
+            time.sleep(spec.latency)
+            return payload
+        if spec.mode == "nan":
+            return _poison(payload)
+        raise InjectedFault(
+            f"chaos[{name}] injected fault (call {spec.calls}"
+            + (f", {meta}" if meta else "") + ")"
+            + (f": {spec.message}" if spec.message else ""))
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {n: {"calls": s.calls, "trips": s.trips}
+                    for n, s in self._specs.items()}
+
+
+def _poison(payload):
+    """NaN-poison every float array in ``payload`` (first element of each
+    array, enough for any finiteness sweep to trip); non-float leaves and
+    non-array values pass through untouched."""
+    if payload is None:
+        return None
+    if isinstance(payload, (list, tuple)):
+        return type(payload)(_poison(p) for p in payload)
+    data = getattr(payload, "_data", None)       # paddle Tensor
+    if data is not None:
+        poisoned = _poison_array(data)
+        if poisoned is data:
+            return payload
+        return type(payload)(poisoned)
+    return _poison_array(payload)
+
+
+def _poison_array(arr):
+    try:
+        a = np.asarray(arr)
+    except Exception:                            # noqa: BLE001
+        return arr
+    if not np.issubdtype(a.dtype, np.floating):
+        return arr
+    a = a.copy()
+    a.reshape(-1)[0] = np.nan
+    return a
+
+
+_registry = ChaosRegistry()
+_env_armed = False
+_explicit_seed = False
+
+
+def arm_from_flags(force: bool = False):
+    """Arm the registry from FLAGS_chaos_spec / FLAGS_chaos_seed (env or
+    set_flags).  Called lazily on the first fault_point hit so a launcher
+    can arm an entire child-process tree via the environment.  The env
+    seed is applied only when no explicit reset(seed)/reseed happened
+    first — lazy env arming must never clobber a seed the caller pinned
+    (unless ``force=True`` re-reads the flags deliberately)."""
+    global _env_armed
+    if _env_armed and not force:
+        return
+    _env_armed = True
+    from paddle_tpu.framework.flags import flag
+    if force or not _explicit_seed:
+        _registry.reseed(int(flag("chaos_seed")))
+    raw = flag("chaos_spec")
+    if not raw:
+        return
+    spec = json.loads(raw) if isinstance(raw, str) else dict(raw)
+    for name, kw in spec.items():
+        _registry.arm(name, **kw)
+
+
+def fault_point(name: str, payload: Any = None, meta: dict = None):
+    """Consult the chaos registry at a named site.  Returns the payload
+    (possibly NaN-poisoned), raises :class:`InjectedFault`, or sleeps,
+    per the armed schedule; a no-op returning ``payload`` when nothing
+    is armed for ``name``."""
+    if not _env_armed:
+        arm_from_flags()
+    if not _registry.armed:
+        return payload
+    return _registry.fire(name, payload, meta)
+
+
+def arm(name: str, **spec) -> FaultSpec:
+    if not _env_armed:
+        arm_from_flags()
+    return _registry.arm(name, **spec)
+
+
+def disarm(name: Optional[str] = None):
+    _registry.disarm(name)
+
+
+def reset(seed: int = 0):
+    """Disarm everything and reseed — each chaos test starts here."""
+    global _explicit_seed
+    _explicit_seed = True
+    _registry.disarm()
+    _registry.reseed(seed)
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    return _registry.stats()
+
+
+@contextlib.contextmanager
+def inject(name: str, **spec):
+    """Scope one armed fault point::
+
+        with chaos.inject("ps.rpc", mode="error", nth=2, n_times=1):
+            client.pull(...)     # the 2nd RPC raises InjectedFault
+    """
+    fs = arm(name, **spec)
+    try:
+        yield fs
+    finally:
+        disarm(name)

@@ -83,3 +83,26 @@ define_flag("eager_op_jit_cache", True,
             "read inside an op is frozen at first call.  Disable for impure "
             "custom ops.")
 define_flag("conv_workspace_size_limit", 512, "compat no-op")
+
+# fault-tolerance tier (framework/chaos.py + ps/service.py retries):
+define_flag("chaos_spec", "",
+            "JSON {fault_point: schedule} armed into framework.chaos at "
+            "first use — e.g. '{\"ps.rpc\": {\"mode\": \"error\", "
+            "\"every\": 3, \"n_times\": 2}}'.  Env form lets the "
+            "launcher arm a whole child-process tree; empty = chaos off")
+define_flag("chaos_seed", 0,
+            "seed for chaos probability schedules (deterministic suites "
+            "pin this; the CI chaos lane runs with a fixed seed)")
+define_flag("ps_rpc_timeout", 30.0,
+            "socket timeout (s) per PS RPC (brpc_ps_client's "
+            "rpc_timeout_ms role)")
+define_flag("ps_rpc_max_retries", 3,
+            "bounded retries per PS RPC before the endpoint is reported "
+            "dead to the heartbeat monitor")
+define_flag("ps_rpc_backoff_base", 0.05,
+            "exponential backoff base (s): sleep base*2^attempt between "
+            "PS RPC retries")
+define_flag("download_retries", 3,
+            "fetch attempts in utils.download before giving up")
+define_flag("download_backoff_base", 0.1,
+            "exponential backoff base (s) between download fetch retries")

@@ -1,0 +1,174 @@
+"""Step-granularity NaN rollback — the missing tier between detection
+and epoch recovery.
+
+The reference gives two failure tools at opposite granularities: the
+per-op NaN/Inf watcher (FLAGS_check_nan_inf, framework/details/
+nan_inf_utils.h) *detects* a blow-up, and auto-checkpoint
+(TrainEpochRange) *recovers* — but only at epoch boundaries, losing
+everything since the last save.  :class:`ResilientTrainStep` closes the
+gap: snapshot last-good training state on host every K steps, detect a
+non-finite loss (or non-finite params) after each step, and
+skip-and-restore instead of letting one bad batch corrupt the run —
+raising only after M consecutive bad steps, when the blow-up is clearly
+systematic rather than transient.
+
+Works over any step with the TrainStep surface (``model``, ``optimizer``,
+``_opt_states``, callable returning a loss Tensor): jit.TrainStep,
+ShardedTrainStep, PSTrainStep's dense tier.  Snapshots are host numpy
+copies, so donated device buffers are never pinned and restore survives
+``donate=True`` (where the pre-step device arrays are already consumed).
+
+AMP: with a fp16 :class:`~paddle_tpu.amp.GradScaler` passed as
+``scaler``, every detected bad step feeds the scaler's dynamic-scaling
+state machine (found_inf → update()), so injected NaN storms also drive
+the loss scale down exactly as update_loss_scaling_op would.
+
+The ``train.step_grads`` chaos point runs over the step inputs before
+dispatch: arming it with ``mode="nan"`` NaN-poisons the batch, the real
+forward/backward propagates the poison into loss and grads, and the
+rollback path is exercised end-to-end (tests/test_chaos.py proves a
+poisoned run still reaches the un-poisoned final loss).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import chaos
+
+__all__ = ["ResilientTrainStep"]
+
+
+class ResilientTrainStep:
+    """Rollback wrapper: snapshot every ``snapshot_every`` good steps,
+    restore-and-skip on a non-finite step, raise FloatingPointError after
+    ``max_consecutive_bad`` consecutive bad steps.
+
+    A rollback restores the most recent snapshot — with
+    ``snapshot_every=K`` up to K-1 good steps are re-lost; K=1 (default)
+    makes rollback exact at the cost of one host copy of
+    params+opt-state per step.  Raise K when step time is small relative
+    to state size.
+
+    ``check_state=True`` additionally sweeps the post-step parameters for
+    non-finite values, catching the finite-loss/NaN-grad case the loss
+    check alone misses (the grad-norm watch of the reference's
+    check_nan_inf at step granularity).
+
+    Return value: the step's loss Tensor.  On a skipped step it is the
+    detected NON-FINITE loss (a NaN scalar when the wrapped step raised
+    before returning one) — always float()-able, never None — and
+    ``last_step_skipped`` is True; filter on that flag before folding
+    losses into running statistics."""
+
+    def __init__(self, step, snapshot_every: int = 1,
+                 max_consecutive_bad: int = 3, scaler=None,
+                 check_state: bool = False):
+        if snapshot_every < 1:
+            raise ValueError("snapshot_every must be >= 1")
+        if max_consecutive_bad < 1:
+            raise ValueError("max_consecutive_bad must be >= 1")
+        self.step = step
+        self.snapshot_every = snapshot_every
+        self.max_consecutive_bad = max_consecutive_bad
+        self.scaler = scaler
+        self.check_state = check_state
+        self._snap: Optional[dict] = None
+        self._good_since_snap = 0
+        self.consecutive_bad = 0
+        self.skipped_steps = 0
+        self.rollbacks = 0
+        self.last_step_skipped = False
+
+    # -- snapshot / restore --------------------------------------------------
+    def snapshot(self):
+        """Host-copy the wrapped step's full training state (params,
+        buffers, optimizer slots, global step)."""
+        model, opt = self.step.model, self.step.optimizer
+        self._snap = {
+            "params": {n: np.asarray(p._data)
+                       for n, p in model.named_parameters()},
+            "buffers": {n: np.asarray(b._data)
+                        for n, b in model.named_buffers() if b is not None},
+            "opt_states": jax.tree_util.tree_map(
+                np.asarray, self.step._opt_states)
+            if self.step._opt_states is not None else None,
+            "global_step": int(getattr(opt, "_global_step", 0)),
+        }
+        self._good_since_snap = 0
+
+    def restore(self):
+        """Reinstall the last snapshot into the live model/optimizer."""
+        if self._snap is None:
+            raise RuntimeError("no snapshot to restore")
+        model, opt = self.step.model, self.step.optimizer
+        snap = self._snap
+        for n, p in model.named_parameters():
+            p._data = jnp.asarray(snap["params"][n])
+        for n, b in model.named_buffers():
+            if b is not None and n in snap["buffers"]:
+                b._data = jnp.asarray(snap["buffers"][n])
+        if snap["opt_states"] is not None:
+            self.step._opt_states = jax.tree_util.tree_map(
+                jnp.asarray, snap["opt_states"])
+        if hasattr(opt, "_global_step"):
+            opt._global_step = snap["global_step"]
+        self._good_since_snap = 0
+
+    # -- detection -----------------------------------------------------------
+    def _finite(self, loss) -> bool:
+        arr = loss._data if hasattr(loss, "_data") else loss
+        if not bool(np.all(np.isfinite(np.asarray(arr)))):
+            return False
+        if self.check_state:
+            for _, p in self.step.model.named_parameters():
+                d = p._data
+                if jnp.issubdtype(d.dtype, jnp.floating) and \
+                        not bool(jnp.all(jnp.isfinite(d))):
+                    return False
+        return True
+
+    # -- step ----------------------------------------------------------------
+    def __call__(self, *inputs):
+        if self._snap is None:
+            self.snapshot()
+        inputs = chaos.fault_point("train.step_grads", payload=inputs)
+        self.last_step_skipped = False
+        try:
+            loss = self.step(*inputs)
+            finite = self._finite(loss)
+        except FloatingPointError:
+            # FLAGS_check_nan_inf armed inside the wrapped step: same
+            # recovery path as our own detection.  Stand in a NaN scalar
+            # for the loss the step never returned, so the skipped-step
+            # return is always float()-able (see the docstring note).
+            from paddle_tpu.core import Tensor
+            loss = Tensor(jnp.asarray(jnp.nan, dtype=jnp.float32))
+            finite = False
+        if self.scaler is not None:
+            self.scaler._found_inf = not finite
+            self.scaler.update()
+        if finite:
+            self.consecutive_bad = 0
+            self._good_since_snap += 1
+            if self._good_since_snap >= self.snapshot_every:
+                self.snapshot()
+            return loss
+        self.consecutive_bad += 1
+        self.skipped_steps += 1
+        self.rollbacks += 1
+        self.last_step_skipped = True
+        self.restore()
+        if self.consecutive_bad >= self.max_consecutive_bad:
+            raise FloatingPointError(
+                f"ResilientTrainStep: {self.consecutive_bad} consecutive "
+                "non-finite steps — rollback cannot outrun a systematic "
+                "blow-up (check lr / data / loss scale)")
+        return loss
+
+    def flush(self):
+        if hasattr(self.step, "flush"):
+            self.step.flush()

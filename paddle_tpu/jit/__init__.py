@@ -33,11 +33,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import Parameter, Tensor, apply, no_grad
+from paddle_tpu.framework.resilient import ResilientTrainStep  # noqa: F401
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.tensor.random import default_generator
 
-__all__ = ["to_static", "TrainStep", "save", "load", "not_to_static",
-           "TranslatedLayer"]
+__all__ = ["to_static", "TrainStep", "ResilientTrainStep", "save", "load",
+           "not_to_static", "TranslatedLayer"]
 
 
 def _sig_of(args) -> tuple:

@@ -380,7 +380,17 @@ def _flash_fwd_folded(qt, kt, vt, bias, qseg, kseg, scale, causal, h):
 def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off,
                bias_ref=None, qs_ref=None, ks_ref=None):
     """Recompute the (bq, bk) probability tile from saved lse.  q/k stay in
-    input dtype (bf16 on chip); the product accumulates f32."""
+    input dtype (bf16 on chip); the product accumulates f32.
+
+    Non-finite-input behavior (changed from the earlier full-tile
+    ``isfinite(s)`` guard): only the fully-masked-row case (lse=-inf) is
+    zeroed below; a +inf/nan *score* with finite lse — corrupt q/k or a
+    user bias carrying +inf/nan — now nan-propagates into p and the
+    grads, where the old guard silently zeroed it.  Finite inputs are
+    unaffected (masking uses -inf, which exps to 0).  The propagated nan
+    is the intended signal: FLAGS_check_nan_inf (or ResilientTrainStep)
+    catches it at step granularity — if you are debugging nan grads that
+    trace here, inspect the inputs/bias, not this kernel."""
     s = _dot_nt(q, k) * scale
     s = _mask_tile(s, bias_ref, qs_ref, ks_ref)
     if causal:

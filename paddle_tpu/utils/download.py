@@ -1,15 +1,25 @@
 """paddle.utils.download (parity: python/paddle/utils/download.py —
 get_weights_path_from_url with a local cache).  This environment has
-zero egress, so the cache is the only source: a URL whose file is
-already cached resolves; anything else raises with a clear message
+zero egress, so the cache is normally the only source: a URL whose file
+is already cached resolves; anything else raises with a clear message
 instead of hanging on a socket.
+
+A caller that *does* have a transport can pass ``fetcher`` (a callable
+``url -> bytes``); fetches then run through bounded retries with
+exponential backoff (FLAGS_download_retries /
+FLAGS_download_backoff_base), each attempt passing the
+``download.fetch`` chaos point so flaky-mirror behavior is provable in
+the fault-injection suite.  The fetched file lands in the cache via a
+crash-safe tmp+rename write.
 """
 from __future__ import annotations
 
 import hashlib
 import os
+import time
+from typing import Callable, Optional
 
-__all__ = ["get_weights_path_from_url"]
+__all__ = ["get_weights_path_from_url", "fetch_with_retry"]
 
 WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hapi/weights")
 
@@ -20,16 +30,69 @@ def _map_path(url: str) -> str:
     return os.path.join(WEIGHTS_HOME, fname)
 
 
-def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+def fetch_with_retry(fetcher: Callable[[str], bytes], url: str, path: str,
+                     retries: Optional[int] = None,
+                     backoff_base: Optional[float] = None,
+                     md5sum: Optional[str] = None) -> str:
+    """Run ``fetcher(url)`` with bounded retries + exponential backoff
+    (``sleep(backoff_base * 2^attempt)`` between attempts) and commit the
+    bytes to ``path`` atomically.  Transport-shaped failures (OSError,
+    ConnectionError — which includes injected ``download.fetch`` chaos)
+    and md5 mismatches of the *fetched* bytes retry; anything else
+    propagates immediately.  The md5 check runs BEFORE the cache commit,
+    so a corrupt fetch can never poison the cache."""
+    from paddle_tpu.framework import chaos
+    from paddle_tpu.framework.flags import flag
+    retries = int(flag("download_retries")) if retries is None \
+        else int(retries)
+    backoff_base = float(flag("download_backoff_base")) \
+        if backoff_base is None else float(backoff_base)
+    last: Optional[Exception] = None
+    for attempt in range(max(1, retries)):
+        try:
+            chaos.fault_point("download.fetch", meta={"url": url,
+                                                      "attempt": attempt})
+            data = bytes(fetcher(url))
+            if md5sum and hashlib.md5(data).hexdigest() != md5sum:
+                raise ConnectionError(
+                    f"fetched bytes for {url} fail the md5 check "
+                    "(corrupt/truncated transfer)")
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+            LocalFS().atomic_write(path, data)
+            return path
+        except (ConnectionError, OSError) as e:
+            last = e
+            if attempt < retries - 1:
+                time.sleep(backoff_base * (2 ** attempt))
+    raise RuntimeError(
+        f"download of {url} failed after {retries} attempts: {last!r}")
+
+
+def _md5_of(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.md5(f.read()).hexdigest()
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None,
+                              fetcher: Optional[Callable[[str], bytes]]
+                              = None) -> str:
     path = _map_path(url)
+    if (fetcher is not None and md5sum and os.path.exists(path)
+            and _md5_of(path) != md5sum):
+        # stale/corrupt cache entry with a live transport: refetch rather
+        # than failing forever on the poisoned file
+        os.remove(path)
+    if not os.path.exists(path) and fetcher is not None:
+        # the fetch path verified md5 on the in-memory bytes before the
+        # cache commit — no need to re-read the file to check it again
+        return fetch_with_retry(fetcher, url, path, md5sum=md5sum)
     if os.path.exists(path):
-        if md5sum:
-            with open(path, "rb") as f:
-                if hashlib.md5(f.read()).hexdigest() != md5sum:
-                    raise IOError(
-                        f"cached file {path} fails its md5 check")
+        if md5sum and _md5_of(path) != md5sum:
+            raise IOError(f"cached file {path} fails its md5 check")
         return path
     raise RuntimeError(
         f"{url} is not in the local weights cache ({path}) and this "
         "environment has no network egress — place the file there "
-        "manually, or construct the model with pretrained=False")
+        "manually (or pass fetcher=), or construct the model with "
+        "pretrained=False")

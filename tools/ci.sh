@@ -12,9 +12,18 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== pytest =="
-python -m pytest tests/ -q
+# slow-marked tests (e.g. the SIGKILL-mid-save chaos test) run once, in
+# the chaos lane below — not here
+python -m pytest tests/ -q -m "not slow"
 
 if [ "${1:-}" = "quick" ]; then exit 0; fi
+
+echo "== chaos fault-injection lane (fixed seed, incl. slow) =="
+# re-runs the fault-injection suite with the registry seeded through the
+# ENV path (FLAGS_chaos_seed), proving the launcher-side arming channel
+# end-to-end and pinning determinism
+JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
+    python -m pytest tests/test_chaos.py -q
 
 echo "== API signature freeze =="
 JAX_PLATFORMS=cpu python tools/print_signatures.py --check

@@ -121,10 +121,15 @@ def run_one(cfg, iters=10, repeats=3):
     was_t = [isinstance(a, Tensor) for a in args]
     # chain the carry through the first float operand: a `* 0` dependency
     # is constant-folded and the op hoisted out of the scan (measured:
-    # embedding_bag "ran" in 8.8 us); a sub-ulp runtime value is not
+    # embedding_bag "ran" in 8.8 us); a sub-ulp runtime value is not.
+    # The carry itself stays float32 UNCONDITIONALLY: an int or fp16
+    # carry would turn the 1e-30 scale into a foldable constant zero
+    # (int truncation / fp16 underflow at trace time) and resurrect the
+    # hoisting for int-only/fp16 --config suites — the cast to the
+    # operand dtype happens only at the `xs[ci] + c` use site, where the
+    # carry is a runtime value XLA cannot fold
     ci = next((i for i, a in enumerate(arrs)
                if jnp.issubdtype(a.dtype, jnp.floating)), 0)
-    chain_dt = arrs[ci].dtype
 
     def core(*xs):
         targs = [Tensor(x) if t else x for x, t in zip(xs, was_t)]
@@ -143,12 +148,12 @@ def run_one(cfg, iters=10, repeats=3):
         def many(*xs):
             def body(c, _):
                 mod = list(xs)
-                mod[ci] = xs[ci] + c
+                mod[ci] = xs[ci] + c.astype(xs[ci].dtype)
                 out = core(*mod)
-                dep = out.mean().astype(chain_dt) * \
-                    jnp.asarray(1e-30, chain_dt)
+                dep = out.mean().astype(jnp.float32) * \
+                    jnp.asarray(1e-30, jnp.float32)
                 return c + dep, None
-            c, _ = jax.lax.scan(body, jnp.zeros((), chain_dt), None,
+            c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None,
                                 length=length)
             return c
 
@@ -182,7 +187,24 @@ def run_one(cfg, iters=10, repeats=3):
     # not collapse the difference leg
     l_big = max(l_big, l_small + 64)
     t_big = timed(many_of(l_big), repeats)
-    dt = max(t_big - t_small, 0.0) / (l_big - l_small)
+    dt = (t_big - t_small) / (l_big - l_small)
+    if dt <= 0.0:
+        # jitter swamped the difference leg (possible for very cheap ops
+        # whose calibrated long leg hit the scan cap): recalibrate once
+        # with a doubled difference before giving up
+        l_big = l_small + 2 * (l_big - l_small)
+        _SCAN_LEN_CACHE[ckey] = l_big
+        t_big = timed(many_of(l_big), repeats)
+        dt = (t_big - t_small) / (l_big - l_small)
+    if dt <= 0.0:
+        # a recorded 0.0 ms would poison any baseline it lands in (the
+        # compare gate divides by it) — refuse to report a measurement
+        return {"name": name, "op": cfg["op"],
+                "error": "non-positive scan-difference timing after "
+                         f"recalibration (t_small={t_small:.6f}s, "
+                         f"t_big={t_big:.6f}s, scan_len={l_big}); "
+                         "refusing to record 0.0 ms",
+                "device": jax.default_backend()}
     return {"name": name, "op": cfg["op"], "ms": round(dt * 1e3, 5),
             "scan_len": l_big, "device": jax.default_backend()}
 
@@ -539,6 +561,13 @@ def main(argv=None):
                     b["device"] != r["device"]:
                 print(f"SKIP {r['name']}: baseline device "
                       f"{b['device']!r} != current {r['device']!r}",
+                      file=sys.stderr)
+                continue
+            if b["ms"] <= 0:
+                # a zero/negative baseline (recorded by a pre-guard
+                # version) gates nothing and would ZeroDivisionError
+                print(f"SKIP {r['name']}: baseline ms {b['ms']!r} <= 0 — "
+                      "re-record the baseline with --save",
                       file=sys.stderr)
                 continue
             thr = float(per_op.get(r["name"], a.threshold))
