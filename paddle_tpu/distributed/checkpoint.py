@@ -79,7 +79,7 @@ def _atomic_save(dirpath: str, fname: str, arr: np.ndarray):
     means a torn write can never leave a half-written ``.npy`` under the
     final name — the two-slot TrainEpochRange protocol on top then
     guarantees a loadable committed slot survives any single crash."""
-    chaos.fault_point("ckpt.save", meta={"file": fname})
+    chaos.fault_point("ckpt.save", meta={"file": fname})  # pta: disable=PTA301 (TrainEpochRange two-slot protocol owns recovery)
     final = os.path.join(dirpath, fname)
     tmp = final + f".tmp.{os.getpid()}"
     try:
@@ -137,7 +137,7 @@ def save_sharded(state: Any, dirpath: str, step: Optional[int] = None):
         # metadata is written LAST and atomically: its presence marks the
         # shard set complete, so a kill mid-save leaves a directory that
         # load_sharded refuses (no metadata) rather than silently-partial
-        chaos.fault_point("ckpt.save", meta={"file": _META})
+        chaos.fault_point("ckpt.save", meta={"file": _META})  # pta: disable=PTA301 (load_sharded refuses a dir with no metadata)
         from paddle_tpu.distributed.fleet.utils.fs import LocalFS
         LocalFS().atomic_write(os.path.join(dirpath, _META),
                                json.dumps(meta))

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import Parameter, Tensor, apply1
+from paddle_tpu.jit import not_to_static
 from paddle_tpu.distributed.ps.device_table import (
     DeviceEmbeddingTrainStep, MeshShardedEmbedding, mesh_sharded_lookup)
 from paddle_tpu.nn.layer.layers import Layer
@@ -220,8 +221,12 @@ class AsyncCommunicator:
                     q.task_done()
                 AsyncCommunicator._drain_queue(q, table)
                 return
-            comm.table.push(ids, grads)
-            q.task_done()
+            try:
+                comm.table.push(ids, grads)
+            finally:
+                # a push that exhausts retries must still account the
+                # queue item, or flush()/stop() (q.join()) hang forever
+                q.task_done()
             del comm                 # don't pin the table across the wait
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
@@ -287,7 +292,12 @@ class DistributedEmbedding(Layer):
                                               k_steps=k_steps)
         self._embedding_dim = embedding_dim
 
+    @not_to_static
     def forward(self, x):
+        # host tier by contract: ids leave the device, rows come back
+        # from host RAM / the PS transport — never trace this forward
+        # (the @not_to_static marker is honored by dy2static AND the
+        # jit-safety linter, which would otherwise flag the numpy calls)
         ids = np.asarray(x.numpy() if isinstance(x, Tensor) else x,
                          np.int64)
         rows = self.table.pull(ids)                   # host gather

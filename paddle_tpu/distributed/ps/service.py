@@ -293,8 +293,9 @@ class _Conn:
         # injected drops/latency fire BEFORE the send (and before the
         # lock), so a retried call cannot double-apply a non-idempotent
         # push and an injected drop never desyncs a healthy socket
-        chaos.fault_point("ps.rpc", meta={"op": header.get("op"),
-                                          "endpoint": self.endpoint})
+        chaos.fault_point("ps.rpc",  # pta: disable=PTA301 (PsClient.call owns retry/backoff + mark_dead)
+                          meta={"op": header.get("op"),
+                                "endpoint": self.endpoint})
         with self.lock:
             if self.sock is None:
                 self.sock = self._connect()    # lazy redial after failure
@@ -318,6 +319,8 @@ class _Conn:
         return reply, rbufs
 
     def close(self):
+        if self.sock is None:          # invalidated by a failed rpc
+            return
         try:
             self.sock.close()
         except OSError:

@@ -8,6 +8,7 @@ pybind/global_value_getter_setter.cc:330) and serialization.
 from paddle_tpu.framework import flags  # noqa: F401
 from paddle_tpu.framework import monitor  # noqa: F401
 from paddle_tpu.framework import auto_checkpoint  # noqa: F401
+from paddle_tpu.framework import analysis  # noqa: F401
 from paddle_tpu.framework import chaos  # noqa: F401
 from paddle_tpu.framework import errors  # noqa: F401
 from paddle_tpu.framework.resilient import ResilientTrainStep  # noqa: F401

@@ -1,0 +1,29 @@
+"""paddle_tpu.framework.analysis — pass-based static program analyzer.
+
+The TPU-native analogue of the reference's inference analysis framework
+(paddle/fluid/inference/analysis + framework/ir graph passes): validate
+programs *before* execution so shape/dtype/donation/recompilation bugs
+surface as diagnostics with stable rule IDs instead of runtime
+surprises.  Two front ends share one diagnostic core:
+
+* :mod:`.jaxpr_passes` — IR passes over ``jax.make_jaxpr`` output
+  (PTA1xx): dtype upcasts, dead code, host callbacks, donation misuse,
+  baked constants, FLOP/byte cost ranking.
+* :mod:`.ast_passes` — jit-safety source lint (PTA2xx/PTA3xx), built on
+  the dy2static analysis machinery: traced-value control flow, side
+  effects under jit, tracer leaks, numpy-on-tracer, chaos fault-point
+  hygiene.
+
+CLI: ``python tools/prog_lint.py <module|path> [--format=json|text]``.
+Suppression: ``# pta: disable=PTA201`` inline (see diagnostics.py).
+"""
+from paddle_tpu.framework.analysis.ast_passes import (  # noqa: F401
+    lint_file, lint_source)
+from paddle_tpu.framework.analysis.diagnostics import (  # noqa: F401
+    Diagnostic, Report, RULES, Severity)
+from paddle_tpu.framework.analysis.jaxpr_passes import (  # noqa: F401
+    analyze_callable, analyze_jaxpr, analyze_model)
+
+__all__ = ["Diagnostic", "Report", "RULES", "Severity", "analyze_jaxpr",
+           "analyze_callable", "analyze_model", "lint_source",
+           "lint_file"]

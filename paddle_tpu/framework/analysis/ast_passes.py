@@ -1,0 +1,564 @@
+"""Jit-safety AST linter — the pre-trace half of the program analyzer.
+
+Where the jaxpr passes see what a trace *produced*, this front end sees
+what the source will *do to* a trace, before anything runs.  It extends
+the dy2static machinery (paddle_tpu/jit/dy2static.py): the same
+read/write collectors and outline-escape scanner that decide whether the
+AST rewriter can convert a statement here decide how severe a finding is
+— a tensor-dependent ``if`` that dy2static can outline is a warning
+(lax.cond will handle it under ``to_static``), one it cannot outline
+(return/break inside, attribute stores) is an error, because the trace
+will either crash on a tracer-bool or silently bake one branch.
+
+Taint model: inside a *jit-scope* function (decorated ``@to_static`` /
+``@jax.jit``, a Layer's ``forward``, or nested in one), every parameter
+is assumed traced.  Taint propagates through assignments and
+expressions; metadata access (``.shape``/``.dtype``/``.ndim``) and
+identity tests (``is None``) launder it — those are static facts under a
+trace.  This mirrors the reference's dy2static static analysis
+(dygraph_to_static/static_analysis.py NodeVarType inference), with
+"traced" standing in for its VariableWrapper type.
+
+Rules (stable IDs; see diagnostics.RULES):
+
+========  ==============================================================
+PTA201    Python ``if`` branching on a traced value
+PTA202    Python ``while``/``for`` bounded by a traced value
+PTA203    side effect / mutation under jit (attribute stores on self,
+          global/nonlocal writes, print)
+PTA204    tracer leak: a traced value stored where it outlives the
+          trace (self attributes, globals, closure containers)
+PTA205    ``numpy.*`` call on a traced array (concretizes or crashes)
+PTA301    chaos fault-point call with no retry/backoff guard in scope
+PTA302    chaos fault-point name not declared in the registry
+========  ==============================================================
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.framework.analysis.diagnostics import (
+    Diagnostic, Report, Severity, parse_suppressions, register_rule)
+# deliberate reuse of the dy2static analysis machinery — the linter and
+# the converter must agree on what is convertible, or the lint would
+# promise rescues the rewriter cannot deliver
+from paddle_tpu.jit.dy2static import _escapes, _NameCollector
+
+__all__ = ["lint_source", "lint_file"]
+
+register_rule("PTA201", "Python if on traced value", Severity.WARNING,
+              "ast")
+register_rule("PTA202", "Python loop bounded by traced value",
+              Severity.WARNING, "ast")
+register_rule("PTA203", "side effect under jit", Severity.WARNING, "ast")
+register_rule("PTA204", "tracer leak", Severity.WARNING, "ast")
+register_rule("PTA205", "numpy call on traced array", Severity.ERROR,
+              "ast")
+register_rule("PTA301", "unguarded chaos fault point", Severity.WARNING,
+              "chaos")
+register_rule("PTA302", "undeclared chaos fault point", Severity.ERROR,
+              "chaos")
+
+# attribute reads that yield static metadata, not a traced value
+_METADATA_ATTRS = {"shape", "ndim", "dtype", "name", "size",
+                   "stop_gradient", "place", "is_bias", "training"}
+# calls whose result is never traced regardless of arguments
+_UNTAINT_CALLS = {"isinstance", "len", "hasattr", "type", "callable",
+                  "id", "repr", "str", "getattr_static", "issubclass"}
+_JIT_DECORATORS = {"jit", "to_static", "pjit", "checkpoint", "remat",
+                   "grad", "value_and_grad", "vmap", "pmap", "scan"}
+
+
+def _last_name(node: ast.AST) -> Optional[str]:
+    """Trailing identifier of a dotted/called decorator expression."""
+    if isinstance(node, ast.Call):
+        return _last_name(node.func)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_layer_class(cls: ast.ClassDef) -> bool:
+    return any((_last_name(b) or "").endswith("Layer") or
+               (_last_name(b) or "").endswith("Module")
+               for b in cls.bases)
+
+
+def _known_fault_points() -> Set[str]:
+    try:
+        from paddle_tpu.framework.chaos import known_fault_points
+        return set(known_fault_points())
+    except Exception:                  # noqa: BLE001 — linter must not die
+        return set()
+
+
+class _Taint:
+    """Expression-level taint evaluator over a set of traced names."""
+
+    def __init__(self, tainted: Set[str]):
+        self.tainted = tainted
+
+    def __call__(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _METADATA_ATTRS:
+                return False
+            return self(node.value)
+        if isinstance(node, ast.Call):
+            fname = _last_name(node.func)
+            if fname in _UNTAINT_CALLS:
+                return False
+            if any(self(a) for a in node.args) or \
+                    any(self(k.value) for k in node.keywords):
+                return True
+            return self(node.func)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return self(node.left) or any(self(c)
+                                          for c in node.comparators)
+        if isinstance(node, ast.Subscript):
+            return self(node.value) or self(node.slice)
+        if isinstance(node, (ast.Constant, ast.Lambda)):
+            return False
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return False
+        return any(self(c) for c in ast.iter_child_nodes(node))
+
+
+class _FileLinter:
+    def __init__(self, source: str, filename: str):
+        self.source = source
+        self.filename = filename
+        self.sup = parse_suppressions(source)
+        self.report = Report()
+        self.np_aliases: Set[str] = set()
+        self.registered_points: Set[str] = set()
+        self.tuple_names: Set[str] = set()
+        self._last_value: Optional[ast.AST] = None
+
+    # -- emission ---------------------------------------------------------
+    def emit(self, rule: str, node: ast.AST, message: str,
+             severity: Severity, hint: Optional[str] = None):
+        line = getattr(node, "lineno", None)
+        if not self.sup.allows(rule, line):
+            return
+        self.report.add(Diagnostic(
+            rule, message, severity, file=self.filename, line=line,
+            col=getattr(node, "col_offset", None), hint=hint))
+
+    # -- driver -----------------------------------------------------------
+    def run(self) -> Report:
+        try:
+            tree = ast.parse(self.source, filename=self.filename)
+        except SyntaxError as e:
+            self.report.add(Diagnostic(
+                "PTA201", f"file does not parse: {e}", Severity.ERROR,
+                file=self.filename, line=e.lineno))
+            return self.report
+        self._collect_imports(tree)
+        self._lint_chaos(tree)
+        for fn, cls, inherited in self._jit_scope_functions(tree):
+            self._lint_jit_scope(fn, cls, inherited)
+        self.report.files_seen.append(self.filename)
+        return self.report
+
+    def _collect_imports(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        self.np_aliases.add(a.asname or "numpy")
+            elif isinstance(node, ast.Call) and \
+                    _last_name(node.func) == "register_fault_point":
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    self.registered_points.add(str(node.args[0].value))
+
+    # -- jit-scope discovery ----------------------------------------------
+    def _jit_scope_functions(self, tree):
+        """(fn, enclosing_class, inherited_taint) triples for every
+        function assumed to run under a trace."""
+        out = []
+
+        def is_jit(fn: ast.AST, cls) -> bool:
+            for d in fn.decorator_list:
+                # @not_to_static is the machine-readable eager-only
+                # contract (jit.not_to_static): dy2static skips the
+                # function, so the jit-scope rules must not apply
+                if (_last_name(d) or "") == "not_to_static":
+                    return False
+            for d in fn.decorator_list:
+                if (_last_name(d) or "") in _JIT_DECORATORS:
+                    return True
+            return cls is not None and fn.name == "forward"
+
+        def walk(node, cls, in_scope, outer_taint):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child if _is_layer_class(child) else None,
+                         False, set())
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    scoped = in_scope or is_jit(child, cls)
+                    if scoped:
+                        out.append((child, cls, set(outer_taint)))
+                    # nested defs inherit the enclosing traced locals
+                    walk(child, None, scoped,
+                         outer_taint | self._param_names(child)
+                         if scoped else set())
+                else:
+                    walk(child, cls, in_scope, outer_taint)
+
+        walk(tree, None, False, set())
+        # report each function once, outermost scope wins
+        seen, uniq = set(), []
+        for fn, cls, taint in out:
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                uniq.append((fn, cls, taint))
+        return uniq
+
+    @staticmethod
+    def _param_names(fn) -> Set[str]:
+        args = fn.args
+        names = {a.arg for a in
+                 args.posonlyargs + args.args + args.kwonlyargs}
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+        names.discard("self")
+        names.discard("cls")
+        return names
+
+    # -- the jit-scope lint -------------------------------------------------
+    def _lint_jit_scope(self, fn, cls, inherited: Set[str]):
+        tainted = self._param_names(fn) | inherited
+        # locals = params + every name the body writes (dy2static's
+        # collector, so both tools see the same binding set)
+        coll = _NameCollector()
+        for s in fn.body:
+            coll.visit(s)
+        local_names = set(coll.writes) | self._param_names(fn)
+        declared_nonlocal: Set[str] = set()
+        # *args/**kwargs are tuples/dicts of traced values: elements are
+        # traced, but bare truthiness (`if rest:`) is a static len check
+        self.tuple_names: Set[str] = set()
+        if fn.args.vararg:
+            self.tuple_names.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            self.tuple_names.add(fn.args.kwarg.arg)
+        # pass 1: propagate taint to fixpoint (two sweeps reach it for
+        # straight-line + single-loop dataflow), no reporting
+        for _ in range(2):
+            self._sweep(fn.body, tainted, declared_nonlocal,
+                        local_names, report=False)
+        self._sweep(fn.body, tainted, declared_nonlocal, local_names,
+                    report=True)
+
+    def _sweep(self, stmts: Sequence[ast.stmt], tainted: Set[str],
+               declared_nonlocal: Set[str], local_names: Set[str],
+               report: bool):
+        taint = _Taint(tainted)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue               # visited as its own jit scope
+            if isinstance(stmt, (ast.Global, ast.Nonlocal)):
+                declared_nonlocal.update(stmt.names)
+                if report:
+                    self.emit(
+                        "PTA203", stmt,
+                        f"`{type(stmt).__name__.lower()} "
+                        f"{', '.join(stmt.names)}` inside a jit-scope "
+                        "function — writes escape the trace and run "
+                        "once, at trace time", Severity.WARNING,
+                        hint="return the value instead of writing "
+                             "enclosing scope")
+                continue
+            if isinstance(stmt, ast.If):
+                if report:
+                    self._check_numpy_calls(stmt.test, taint)
+                if report and taint(stmt.test) and \
+                        not self._static_truthy(stmt.test):
+                    self._emit_branch("PTA201", stmt, "if")
+                self._sweep(stmt.body, tainted, declared_nonlocal,
+                            local_names, report)
+                self._sweep(stmt.orelse, tainted, declared_nonlocal,
+                            local_names, report)
+                continue
+            if isinstance(stmt, ast.While):
+                if report:
+                    self._check_numpy_calls(stmt.test, taint)
+                if report and taint(stmt.test) and \
+                        not self._static_truthy(stmt.test):
+                    self._emit_branch("PTA202", stmt, "while")
+                self._sweep(stmt.body, tainted, declared_nonlocal,
+                            local_names, report)
+                self._sweep(stmt.orelse, tainted, declared_nonlocal,
+                            local_names, report)
+                continue
+            if isinstance(stmt, ast.For):
+                if report:
+                    self._check_numpy_calls(stmt.iter, taint)
+                if report and taint(stmt.iter) and \
+                        not self._static_truthy(stmt.iter):
+                    self._emit_branch("PTA202", stmt, "for")
+                if taint(stmt.iter) and isinstance(stmt.target, ast.Name):
+                    tainted.add(stmt.target.id)
+                self._sweep(stmt.body, tainted, declared_nonlocal,
+                            local_names, report)
+                self._sweep(stmt.orelse, tainted, declared_nonlocal,
+                            local_names, report)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._sweep(stmt.body, tainted, declared_nonlocal,
+                            local_names, report)
+                continue
+            if isinstance(stmt, ast.Try):
+                for blk in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._sweep(blk, tainted, declared_nonlocal,
+                                local_names, report)
+                for h in stmt.handlers:
+                    self._sweep(h.body, tainted, declared_nonlocal,
+                                local_names, report)
+                continue
+            # straight-line statement: stores + expression checks
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                value = stmt.value
+                val_tainted = taint(value)
+                if isinstance(stmt, ast.AugAssign) and \
+                        isinstance(stmt.target, ast.Name):
+                    # x += clean keeps x traced if it already was
+                    val_tainted = val_tainted or \
+                        stmt.target.id in tainted
+                self._last_value = value
+                targets = (stmt.targets
+                           if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    self._check_store(t, val_tainted, tainted,
+                                      declared_nonlocal, local_names,
+                                      report)
+            if report:
+                self._check_numpy_calls(stmt, taint)
+                self._check_print(stmt, taint)
+
+    def _static_truthy(self, test: ast.AST) -> bool:
+        """True when a tainted test is nonetheless static under a trace:
+        bare truthiness of a *args/**kwargs container (or a slice of
+        one) is a length check, not a tensor-bool."""
+        return isinstance(test, ast.Name) and test.id in self.tuple_names
+
+    def _is_tuple_expr(self, value: Optional[ast.AST]) -> bool:
+        """Does ``value`` evaluate to a tuple even when its elements are
+        traced?  Tuple/list displays, and slices of names already known
+        to be tuples (``states = flat[4:]``)."""
+        if isinstance(value, (ast.Tuple, ast.List)):
+            return True
+        return (isinstance(value, ast.Subscript)
+                and isinstance(value.slice, ast.Slice)
+                and isinstance(value.value, ast.Name)
+                and value.value.id in self.tuple_names)
+
+    def _emit_branch(self, rule: str, stmt, kw: str):
+        body = list(stmt.body) + list(getattr(stmt, "orelse", []))
+        convertible = not _escapes(body)
+        what = ("a traced value decides a Python-level branch"
+                if rule == "PTA201" else
+                "a traced value bounds a Python-level loop")
+        if convertible:
+            self.emit(
+                rule, stmt,
+                f"`{kw}` on a traced value — {what}; dy2static can "
+                "outline this statement, but only under to_static "
+                "capture", Severity.WARNING,
+                hint="use paddle_tpu.static.nn.cond/while_loop "
+                     "explicitly, or confirm the callable is traced "
+                     "via jit.to_static (which rewrites it)")
+        else:
+            self.emit(
+                rule, stmt,
+                f"`{kw}` on a traced value with a body dy2static "
+                "cannot outline (return/break/attribute store inside) "
+                "— under a trace this crashes on tracer-bool or bakes "
+                "one branch", Severity.ERROR,
+                hint="rewrite with static.nn.cond / lax.select on "
+                     "values, or hoist the branch out of the traced "
+                     "function")
+
+    def _check_store(self, target, val_tainted: bool, tainted: Set[str],
+                     declared_nonlocal: Set[str], local_names: Set[str],
+                     report: bool):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            # unpacking: each element receives ONE value from the RHS,
+            # not the RHS itself — pair element-wise when the RHS is a
+            # matching display, otherwise the element value is unknown
+            # (an unpacked tensor must NOT inherit the tuple-ness of
+            # the container it came from)
+            rhs = self._last_value
+            elts = (rhs.elts if isinstance(rhs, (ast.Tuple, ast.List))
+                    and len(rhs.elts) == len(target.elts) else None)
+            for i, e in enumerate(target.elts):
+                self._last_value = elts[i] if elts else None
+                self._check_store(e, val_tainted, tainted,
+                                  declared_nonlocal, local_names, report)
+            self._last_value = rhs
+            return
+        if isinstance(target, ast.Name):
+            if val_tainted:
+                tainted.add(target.id)
+            else:
+                tainted.discard(target.id)
+            if self._is_tuple_expr(self._last_value):
+                self.tuple_names.add(target.id)
+            else:
+                self.tuple_names.discard(target.id)
+            if report and val_tainted and target.id in declared_nonlocal:
+                self.emit(
+                    "PTA204", target,
+                    f"traced value leaks through "
+                    f"`{target.id}` into an enclosing scope — it "
+                    "outlives the trace as a dead tracer",
+                    Severity.WARNING,
+                    hint="return it from the traced function instead")
+            return
+        if not report:
+            return
+        base = target
+        while isinstance(base, (ast.Attribute, ast.Subscript)):
+            base = base.value
+        base_name = base.id if isinstance(base, ast.Name) else None
+        on_self = base_name == "self"
+        nonlocal_store = base_name is not None and \
+            base_name not in local_names and not on_self
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            if val_tainted and (on_self or nonlocal_store):
+                where = "self" if on_self else f"`{base_name}`"
+                self.emit(
+                    "PTA204", target,
+                    f"traced value stored into {where} — the tracer "
+                    "leaks out of the compiled scope and later eager "
+                    "reads see a stale/invalid tracer",
+                    Severity.WARNING,
+                    hint="register_buffer for per-step state (buffers "
+                         "thread through capture), or return the value")
+            elif on_self:
+                self.emit(
+                    "PTA203", target,
+                    "attribute store on self inside a jit-scope "
+                    "function — the mutation happens at trace time "
+                    "only, NOT per call", Severity.WARNING,
+                    hint="mutate in __init__/eager code, or use a "
+                         "registered buffer")
+            elif nonlocal_store and val_tainted is False and \
+                    base_name is not None:
+                self.emit(
+                    "PTA203", target,
+                    f"store into non-local `{base_name}` inside a "
+                    "jit-scope function — runs once at trace time",
+                    Severity.WARNING,
+                    hint="keep trace-time code pure; do bookkeeping "
+                         "outside the traced callable")
+
+    def _check_numpy_calls(self, stmt, taint: _Taint):
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            root = func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            is_np = isinstance(root, ast.Name) and \
+                root.id in self.np_aliases and \
+                isinstance(func, ast.Attribute)
+            if is_np and (any(taint(a) for a in node.args) or
+                          any(taint(k.value) for k in node.keywords)):
+                self.emit(
+                    "PTA205", node,
+                    f"numpy call `{ast.unparse(func)}` on a traced "
+                    "array — under jit this either concretizes (host "
+                    "sync + constant-folds the tracer) or raises "
+                    "TracerArrayConversionError", Severity.ERROR,
+                    hint="use the jnp/paddle_tpu equivalent, or move "
+                         "the numpy code outside the traced function")
+
+    def _check_print(self, stmt, taint: _Taint):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                self.emit(
+                    "PTA203", node,
+                    "print() inside a jit-scope function — fires at "
+                    "trace time only (or not at all once cached)",
+                    Severity.WARNING,
+                    hint="use jax.debug.print for per-execution output "
+                         "(and see PTA103 for its cost)")
+
+    # -- chaos fault-point hygiene (PTA301/302) -----------------------------
+    def _lint_chaos(self, tree):
+        known = _known_fault_points()
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and
+                    _last_name(node.func) == "fault_point"):
+                continue
+            pt_name = None
+            if node.args and isinstance(node.args[0], ast.Constant):
+                pt_name = str(node.args[0].value)
+            if pt_name is not None and known and \
+                    pt_name not in known | self.registered_points:
+                self.emit(
+                    "PTA302", node,
+                    f"fault point {pt_name!r} is not declared in the "
+                    "chaos registry — arming it raises, and a typo'd "
+                    "spec would inject nothing (false-green chaos run)",
+                    Severity.ERROR,
+                    hint="use a registered point or call "
+                         "chaos.register_fault_point first; known: "
+                         + ", ".join(sorted(known)))
+            guarded = False
+            cur = node
+            while id(cur) in parents:
+                cur = parents[id(cur)]
+                if isinstance(cur, ast.Try):
+                    guarded = True
+                    break
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    break
+            if not guarded:
+                self.emit(
+                    "PTA301", node,
+                    f"chaos fault point {pt_name or '<dynamic>'!r} "
+                    "fired with no try/retry guard in the enclosing "
+                    "function — an armed run escalates the injected "
+                    "fault into a crash here", Severity.WARNING,
+                    hint="wrap in retry/backoff (PsClient pattern) or, "
+                         "if a caller owns recovery, note it with "
+                         "`# pta: disable=PTA301 (<who retries>)`")
+
+
+def lint_source(source: str, filename: str = "<string>",
+                disable: Sequence[str] = ()) -> Report:
+    """AST-lint one source string."""
+    return _FileLinter(source, filename).run().filter(disable=disable)
+
+
+def lint_file(path: str, disable: Sequence[str] = ()) -> Report:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, filename=path, disable=disable)

@@ -1,0 +1,242 @@
+"""Shared diagnostic/reporting core of the program analyzer.
+
+Both analyzer front ends — the jaxpr IR passes (jaxpr_passes.py) and the
+jit-safety AST linter (ast_passes.py) — emit :class:`Diagnostic` records
+into one :class:`Report`, mirroring how the reference funnels every
+inference analysis pass through a single Argument/AnalysisPass protocol
+(paddle/fluid/inference/analysis/analysis_pass.h + framework/ir pass
+registry).  One severity scale, one stable rule-ID space, one JSON/text
+renderer, one suppression mechanism — so a CI gate or an editor plugin
+sees a uniform stream no matter which front end found the issue.
+
+Rule IDs are stable and namespaced by front end:
+
+* ``PTA1xx`` — jaxpr IR passes (post-trace facts: dtypes, liveness,
+  callbacks, donation, baked constants, cost model),
+* ``PTA2xx`` — AST lint (pre-trace facts: control flow on traced values,
+  side effects, tracer leaks, numpy-on-tracer),
+* ``PTA3xx`` — cross-subsystem wiring (chaos fault-point hygiene).
+
+Suppression: a source comment ``# pta: disable=PTA201,PTA203`` on the
+offending line silences those rules there; ``# pta: disable`` silences
+every rule on the line; ``# pta: disable-file=PTA105`` anywhere in the
+first 10 lines silences a rule file-wide.  Jaxpr diagnostics carry no
+source line, so they are filtered by rule ID via the ``disable=``
+argument of the analyze entry points instead.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = ["Severity", "Diagnostic", "Report", "RuleInfo", "RULES",
+           "register_rule", "parse_suppressions", "JSON_SCHEMA_VERSION"]
+
+JSON_SCHEMA_VERSION = 1
+
+
+class Severity(enum.IntEnum):
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+    def __str__(self):  # "error", not "Severity.ERROR", in reports
+        return self.name.lower()
+
+    @classmethod
+    def parse(cls, s: "str | Severity") -> "Severity":
+        if isinstance(s, Severity):
+            return s
+        return cls[str(s).upper()]
+
+
+@dataclass(frozen=True)
+class RuleInfo:
+    """One registered rule: the analyzer's analogue of the reference's
+    REGISTER_PASS entries (framework/ir/pass.h)."""
+    id: str
+    title: str
+    severity: Severity
+    frontend: str                     # "jaxpr" | "ast" | "chaos"
+
+
+RULES: Dict[str, RuleInfo] = {}
+
+
+def register_rule(rule_id: str, title: str, severity: Severity,
+                  frontend: str) -> RuleInfo:
+    if rule_id in RULES:
+        raise ValueError(f"duplicate rule id {rule_id!r}")
+    info = RuleInfo(rule_id, title, severity, frontend)
+    RULES[rule_id] = info
+    return info
+
+
+@dataclass
+class Diagnostic:
+    rule: str
+    message: str
+    severity: Severity
+    file: Optional[str] = None
+    line: Optional[int] = None
+    col: Optional[int] = None
+    hint: Optional[str] = None        # fix-hint, actionable
+    frontend: str = ""                # filled from RULES when omitted
+
+    def __post_init__(self):
+        if not self.frontend and self.rule in RULES:
+            self.frontend = RULES[self.rule].frontend
+
+    @property
+    def location(self) -> str:
+        if self.file is None:
+            return "<program>"
+        loc = self.file
+        if self.line is not None:
+            loc += f":{self.line}"
+            if self.col is not None:
+                loc += f":{self.col}"
+        return loc
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": str(self.severity),
+                "message": self.message, "file": self.file,
+                "line": self.line, "col": self.col, "hint": self.hint,
+                "frontend": self.frontend}
+
+    def render(self) -> str:
+        s = f"{self.location}: {self.severity} {self.rule}: {self.message}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+class Report:
+    """Ordered diagnostic collection with severity accounting.
+
+    ``exit_code()`` implements the CI contract: nonzero iff any
+    ERROR-severity finding survived suppression (``strict=True`` also
+    promotes warnings), the role of the reference's
+    paddle_build.sh stage exit codes.
+    """
+
+    def __init__(self, diagnostics: Optional[List[Diagnostic]] = None):
+        self.diagnostics: List[Diagnostic] = list(diagnostics or [])
+        self.files_seen: List[str] = []
+
+    def add(self, diag: Diagnostic):
+        self.diagnostics.append(diag)
+
+    def extend(self, other: "Report | Iterable[Diagnostic]"):
+        if isinstance(other, Report):
+            self.diagnostics.extend(other.diagnostics)
+            self.files_seen.extend(
+                f for f in other.files_seen if f not in self.files_seen)
+        else:
+            self.diagnostics.extend(other)
+
+    def filter(self, min_severity: "str | Severity" = Severity.INFO,
+               disable: Sequence[str] = ()) -> "Report":
+        min_severity = Severity.parse(min_severity)
+        out = Report([d for d in self.diagnostics
+                      if d.severity >= min_severity
+                      and d.rule not in set(disable)])
+        out.files_seen = list(self.files_seen)
+        return out
+
+    def by_severity(self, severity: Severity) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return self.by_severity(Severity.ERROR)
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return self.by_severity(Severity.WARNING)
+
+    def counts(self) -> Dict[str, int]:
+        c = {"error": 0, "warning": 0, "info": 0}
+        for d in self.diagnostics:
+            c[str(d.severity)] += 1
+        return c
+
+    def exit_code(self, strict: bool = False) -> int:
+        if self.errors:
+            return 1
+        if strict and self.warnings:
+            return 1
+        return 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": JSON_SCHEMA_VERSION,
+            "findings": [d.to_dict() for d in sorted(
+                self.diagnostics,
+                key=lambda d: (-int(d.severity), d.file or "",
+                               d.line or 0, d.rule))],
+            "summary": {**self.counts(),
+                        "files": len(self.files_seen)},
+        }, indent=1)
+
+    def to_text(self) -> str:
+        lines = [d.render() for d in sorted(
+            self.diagnostics,
+            key=lambda d: (d.file or "", d.line or 0, d.rule))]
+        c = self.counts()
+        lines.append(f"{c['error']} error(s), {c['warning']} warning(s), "
+                     f"{c['info']} info over "
+                     f"{len(self.files_seen)} file(s)")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# inline pragma suppression
+# ---------------------------------------------------------------------------
+
+_PRAGMA = re.compile(
+    r"#\s*pta:\s*(disable-file|disable)\s*(?:=\s*([A-Z0-9, ]+))?")
+
+
+@dataclass
+class Suppressions:
+    """Parsed ``# pta:`` pragmas of one source file."""
+    by_line: Dict[int, Optional[set]] = field(default_factory=dict)
+    file_wide: Optional[set] = None   # None = nothing; set() = everything
+    file_wide_all: bool = False
+
+    def allows(self, rule: str, line: Optional[int]) -> bool:
+        """True when a diagnostic for ``rule`` at ``line`` survives."""
+        if self.file_wide_all:
+            return False
+        if self.file_wide is not None and rule in self.file_wide:
+            return False
+        if line in self.by_line:
+            rules = self.by_line[line]
+            if rules is None or rule in rules:
+                return False
+        return True
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    sup = Suppressions()
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA.search(text)
+        if not m:
+            continue
+        kind, ids = m.group(1), m.group(2)
+        rules = ({r.strip() for r in ids.split(",") if r.strip()}
+                 if ids else None)
+        if kind == "disable-file":
+            if i > 10:
+                continue              # file pragmas live in the header
+            if rules is None:
+                sup.file_wide_all = True
+            else:
+                sup.file_wide = (sup.file_wide or set()) | rules
+        else:
+            sup.by_line[i] = rules
+    return sup

@@ -50,7 +50,8 @@ import numpy as np
 
 __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
            "disarm", "stats", "reset", "arm_from_flags", "FAULT_POINTS",
-           "register_fault_point"]
+           "register_fault_point", "known_fault_points",
+           "payload_fault_points"]
 
 FAULT_POINTS = ("ps.rpc", "fs.write", "ckpt.save", "download.fetch",
                 "train.step_grads")
@@ -71,6 +72,23 @@ def register_fault_point(name: str, carries_payload: bool = False):
     if carries_payload:
         _payload_points.add(name)
     return name
+
+
+def known_fault_points() -> frozenset:
+    """Every declared fault point name — in-tree plus anything added via
+    :func:`register_fault_point`.  Consumer API for the static analyzer
+    (framework.analysis rules PTA301/PTA302): the linter validates
+    ``fault_point("...")`` call sites against this registry and flags
+    sites with no retry/backoff guard, so a chaos-armed point can never
+    be a name the registry would reject nor a call path that escalates
+    an injected fault straight into a crash."""
+    return frozenset(_known_points)
+
+
+def payload_fault_points() -> frozenset:
+    """Declared points whose call sites carry a payload (the only ones
+    where ``mode="nan"`` transforms anything) — see known_fault_points."""
+    return frozenset(_payload_points)
 
 
 class InjectedFault(ConnectionError):

@@ -135,7 +135,7 @@ class ResilientTrainStep:
     def __call__(self, *inputs):
         if self._snap is None:
             self.snapshot()
-        inputs = chaos.fault_point("train.step_grads", payload=inputs)
+        inputs = chaos.fault_point("train.step_grads", payload=inputs)  # pta: disable=PTA301 (ResilientTrainStep IS the recovery wrapper)
         self.last_step_skipped = False
         try:
             loss = self.step(*inputs)

@@ -90,6 +90,23 @@ class StaticFunction:
     def concrete_program(self):
         return None
 
+    def analyze(self, *example_inputs, **analyze_kwargs):
+        """Static analysis of this capture (framework.analysis jaxpr
+        passes): abstract-trace the forward on aval stand-ins of
+        ``example_inputs`` and return the diagnostic Report — dtype
+        upcasts, dead params, host callbacks, baked constants, cost
+        ranking — without spending a device step."""
+        from paddle_tpu.framework.analysis import (analyze_callable,
+                                                   analyze_model)
+        if self._layer is not None:
+            return analyze_model(self._layer, *example_inputs,
+                                 name=type(self._layer).__name__,
+                                 **analyze_kwargs)
+        return analyze_callable(self._function, *example_inputs,
+                                tensors=True,
+                                name=self._function.__name__,
+                                **analyze_kwargs)
+
     def _build(self, sig, n_params, n_buffers, param_names, buffer_names,
                static_args, static_kwargs, out_meta):
         layer = self._layer
@@ -529,6 +546,43 @@ class TrainStep:
         if self.optimizer._lr_scheduler is not None:
             pass  # user steps the scheduler explicitly, paddle-style
         return Tensor(loss)
+
+    def analyze(self, *example_inputs, **analyze_kwargs):
+        """Static analysis of the fused step (framework.analysis jaxpr
+        passes) on aval stand-ins — no device step is executed.  The
+        step body is traced UNJITTED so dead-code liveness sees real
+        equations, and the donation pass is fed the exact buffers
+        ``donate_argnums`` hands XLA (params, opt states, buffers), so
+        PTA104 audits the same aliasing contract the compiled step
+        runs under."""
+        import jax.tree_util as jtu
+
+        from paddle_tpu.framework.analysis import analyze_jaxpr
+        _, _, params, buffers, arrs, key, lr = \
+            self._prepare_dispatch(example_inputs)
+        one_step = self._build_one_step()
+
+        def step(params, opt_states, buffers, key, lr, *inputs):
+            return one_step(params, opt_states, buffers, key, lr,
+                            list(inputs))
+
+        aval = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+            a.shape, a.dtype)
+        tree_avals = [jtu.tree_map(aval, t)
+                      for t in (params, self._opt_states, buffers)]
+        labels, n_donated = [], 0
+        for prefix, tree in zip(("params", "opt", "buffers"), tree_avals):
+            flat, _ = jtu.tree_flatten_with_path(tree)
+            labels += [prefix + jtu.keystr(path) for path, _ in flat]
+        n_donated = len(labels) if self.donate else 0
+        labels += ["rng_key", "lr"] + [f"input[{i}]"
+                                       for i in range(len(arrs))]
+        closed = jax.make_jaxpr(step)(
+            *tree_avals, aval(key), jax.ShapeDtypeStruct((), jnp.float32),
+            *[aval(x) for x in arrs])
+        return analyze_jaxpr(
+            closed, name="TrainStep", invar_labels=labels,
+            donate_argnums=tuple(range(n_donated)), **analyze_kwargs)
 
     def compiled_text(self) -> str:
         """Backend-optimized HLO of the most recent step signature (perf

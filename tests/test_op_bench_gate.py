@@ -72,3 +72,16 @@ def test_gate_skips_cross_device_baselines(tmp_path):
     bp.write_text(json.dumps(base))
     rc = _compare(cur, str(bp), threshold=0.1, tmp=str(tmp_path))
     assert rc == 0, "cross-device comparison must be skipped, not failed"
+
+
+def test_gate_fails_when_current_run_cannot_measure(tmp_path):
+    """A refused/errored measurement for a baselined op must fail the
+    gate with the op named — not silently drop it (rc 2, same contract
+    as the key-drift validation)."""
+    base = [{"name": "matmul_1k", "ms": 10.0, "scan_len": 1000,
+             "device": "tpu"}]
+    cur = [{"name": "matmul_1k", "error": "zero-ms refuse-to-record"}]
+    bp = tmp_path / "base.json"
+    bp.write_text(json.dumps(base))
+    rc = _compare(cur, str(bp), threshold=0.15, tmp=str(tmp_path))
+    assert rc == 2, "gate passed while measuring nothing"

@@ -1,0 +1,525 @@
+"""Program analyzer test suite (framework.analysis).
+
+Per-rule positive/negative fixtures across both front ends, the JSON
+schema contract the CI lane consumes, and the seed-corpus regression:
+paddle_tpu.vision.models + nn/layer/transformer.py must lint clean
+after the fixes this subsystem surfaced (plus the chaos fault-point
+sites, which carry audited `pta: disable=PTA301` pragmas)."""
+import json
+import os
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.analysis import (
+    RULES, Severity, analyze_callable, analyze_model, lint_file,
+    lint_source)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def rules_of(report):
+    return [d.rule for d in report.diagnostics]
+
+
+def lint(src):
+    return lint_source(textwrap.dedent(src), "fixture.py")
+
+
+# ---------------------------------------------------------------------------
+# AST front end: one positive and one negative fixture per rule
+# ---------------------------------------------------------------------------
+
+
+class TestAstRules:
+    def test_pta201_if_on_traced_positive(self):
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    if x.sum() > 0:
+                        x = x * 2
+                    return x
+            """)
+        assert "PTA201" in rules_of(r)
+        assert r.diagnostics[0].severity == Severity.WARNING
+
+    def test_pta201_unconvertible_body_is_error(self):
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    if x.sum() > 0:
+                        return x * 2
+                    return x
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA201"]
+        assert d and d[0].severity == Severity.ERROR
+
+    def test_pta201_negative_static_tests(self):
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x, cache=None, *rest):
+                    if cache is None:          # identity: static
+                        x = x + 1
+                    if x.shape[0] > 1:         # metadata: static
+                        x = x + 1
+                    if isinstance(cache, tuple):
+                        x = x + 1
+                    if rest:                   # vararg len: static
+                        x = x + rest[0]
+                    if self.training:
+                        x = x + 1
+                    return x
+            """)
+        assert "PTA201" not in rules_of(r)
+
+    def test_pta202_loop_positive_and_negative(self):
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    while x > 0:
+                        x = x - 1
+                    for v in x:
+                        x = x + v
+                    return x
+            """)
+        assert rules_of(r).count("PTA202") == 2
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x, *flat):
+                    states = flat[2:]          # tuple slice of vararg
+                    for t in range(x.shape[0]):
+                        x = x * 1
+                    if states:                 # len check, static
+                        x = x + states[0]
+                    return x
+            """)
+        assert "PTA202" not in rules_of(r)
+
+    def test_pta203_side_effects(self):
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    self.calls = 1
+                    print(x)
+                    return x
+            """)
+        assert rules_of(r).count("PTA203") == 2
+        # __init__ is eager: mutation there is fine
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def __init__(self):
+                    self.calls = 0
+            """)
+        assert "PTA203" not in rules_of(r)
+
+    def test_pta204_tracer_leak(self):
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    self.cache = x * 2        # traced value into self
+                    return x
+            """)
+        assert "PTA204" in rules_of(r)
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    y = x * 2                 # plain local: fine
+                    return y
+            """)
+        assert "PTA204" not in rules_of(r)
+
+    def test_pta205_numpy_on_traced(self):
+        r = lint("""
+            import numpy as np
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    return np.abs(x)
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA205"]
+        assert d and d[0].severity == Severity.ERROR
+        r = lint("""
+            import numpy as np
+            import paddle_tpu.nn as nn
+            W = np.zeros((3, 3))              # module level: eager
+            class M(nn.Layer):
+                def forward(self, x):
+                    k = np.pi                 # no traced argument
+                    return x * k
+            """)
+        assert "PTA205" not in rules_of(r)
+
+    def test_not_to_static_opt_out(self):
+        r = lint("""
+            import numpy as np
+            import paddle_tpu.nn as nn
+            from paddle_tpu.jit import not_to_static
+            class M(nn.Layer):
+                @not_to_static
+                def forward(self, x):
+                    return np.asarray(x)      # host tier by contract
+            """)
+        assert rules_of(r) == []
+
+    def test_jit_decorated_function_is_scoped(self):
+        r = lint("""
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    x = x + 1
+                return x
+            """)
+        assert "PTA201" in rules_of(r)
+
+    def test_pta301_chaos_guard(self):
+        r = lint("""
+            from paddle_tpu.framework.chaos import fault_point
+            def send(x):
+                fault_point("ps.rpc")
+                return x
+            """)
+        assert "PTA301" in rules_of(r)
+        r = lint("""
+            from paddle_tpu.framework.chaos import fault_point
+            def send(x):
+                for _ in range(3):
+                    try:
+                        fault_point("ps.rpc")
+                        return x
+                    except ConnectionError:
+                        pass
+            """)
+        assert "PTA301" not in rules_of(r)
+
+    def test_pta302_undeclared_point(self):
+        r = lint("""
+            from paddle_tpu.framework.chaos import fault_point
+            def send(x):
+                try:
+                    fault_point("ps.rcp")     # transposed typo
+                except ConnectionError:
+                    pass
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA302"]
+        assert d and d[0].severity == Severity.ERROR
+        # registering in-file declares the point
+        r = lint("""
+            from paddle_tpu.framework.chaos import (fault_point,
+                                                    register_fault_point)
+            register_fault_point("custom.hook")
+            def send(x):
+                try:
+                    fault_point("custom.hook")
+                except ConnectionError:
+                    pass
+            """)
+        assert "PTA302" not in rules_of(r)
+
+    def test_unpacked_tensor_is_not_a_static_tuple(self):
+        # regression: `x, y = (t1, t2)` must not mark x/y as tuples —
+        # branching on the unpacked tensor is still a traced branch
+        r = lint("""
+            import jax
+            @jax.jit
+            def f(t1, t2):
+                x, y = (t1, t2)
+                if x > 0:
+                    y = y + 1
+                return y
+            """)
+        assert "PTA201" in rules_of(r)
+        # but unpacking actual tuple displays keeps tuple-ness per slot
+        r = lint("""
+            import jax
+            @jax.jit
+            def f(t1, *rest):
+                a, b = rest[:1], rest[1:]
+                if b:                     # slice of vararg: len check
+                    t1 = t1 + b[0]
+                return t1
+            """)
+        assert "PTA201" not in rules_of(r)
+
+    def test_while_else_block_is_linted(self):
+        r = lint("""
+            import numpy as np
+            import jax
+            @jax.jit
+            def f(x):
+                n = 3
+                while n > 0:
+                    n = n - 1
+                else:
+                    x = np.sum(x)
+                return x
+            """)
+        assert "PTA205" in rules_of(r)
+
+    def test_inline_pragma_suppression(self):
+        r = lint("""
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    self.n = 1  # pta: disable=PTA203
+                    return x
+            """)
+        assert "PTA203" not in rules_of(r)
+        r = lint_source(
+            "# pta: disable-file=PTA203\n"
+            "import paddle_tpu.nn as nn\n"
+            "class M(nn.Layer):\n"
+            "    def forward(self, x):\n"
+            "        self.n = 1\n"
+            "        return x\n", "fixture.py")
+        assert "PTA203" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr front end
+# ---------------------------------------------------------------------------
+
+
+class TestJaxprRules:
+    def test_pta101_mixed_width_promotion(self):
+        # x64 is on package-wide; a f64 @ f32 dot promotes silently
+        def f(x, y):
+            return jax.lax.dot(x, y, preferred_element_type=jnp.float64)
+        r = analyze_callable(
+            f, jnp.ones((4, 4), jnp.float64), jnp.ones((4, 4),
+                                                       jnp.float32))
+        assert "PTA101" in rules_of(r)
+
+    def test_pta101_f64_const_is_error(self):
+        c = jnp.ones((8,), jnp.float64)
+
+        def f(x):
+            return x + c
+        r = analyze_callable(f, jnp.ones((8,), jnp.float32))
+        d = [d for d in r.diagnostics if d.rule == "PTA101"]
+        assert d and any(x.severity == Severity.ERROR for x in d)
+
+    def test_pta101_negative_all_f32(self):
+        def f(x, y):
+            return x @ y
+        r = analyze_callable(f, jnp.ones((4, 4), jnp.float32),
+                             jnp.ones((4, 4), jnp.float32))
+        assert "PTA101" not in rules_of(r)
+
+    def test_pta102_dead_eqn_and_unused_input(self):
+        def f(x, y):
+            dead = jnp.sin(x)                 # noqa: F841
+            return x * 2
+        r = analyze_callable(f, jnp.ones((4,), jnp.float32),
+                             jnp.ones((4,), jnp.float32))
+        msgs = [d.message for d in r.diagnostics if d.rule == "PTA102"]
+        assert any("dead equation" in m for m in msgs)
+        assert any("never reaches any output" in m for m in msgs)
+
+    def test_pta102_negative(self):
+        def f(x, y):
+            return x * 2 + y
+        r = analyze_callable(f, jnp.ones((4,), jnp.float32),
+                             jnp.ones((4,), jnp.float32))
+        assert "PTA102" not in rules_of(r)
+
+    def test_pta103_host_callback(self):
+        def f(x):
+            jax.debug.print("x={x}", x=x[0])
+            return x * 2
+        r = analyze_callable(f, jnp.ones((4,), jnp.float32))
+        assert "PTA103" in rules_of(r)
+
+        def g(x):
+            return x * 2
+        r = analyze_callable(g, jnp.ones((4,), jnp.float32))
+        assert "PTA103" not in rules_of(r)
+
+    def test_pta104_donation_mismatch(self):
+        def f(x, y):
+            return y * 2.0
+        r = analyze_callable(f, jnp.ones((4,), jnp.float32),
+                             jnp.ones((8,), jnp.float32),
+                             donate_argnums=(0,))
+        d = [d for d in r.diagnostics if d.rule == "PTA104"]
+        assert d and "matches no output" in d[0].message
+        # donating the buffer the output actually aliases is clean
+        r = analyze_callable(f, jnp.ones((4,), jnp.float32),
+                             jnp.ones((8,), jnp.float32),
+                             donate_argnums=(1,))
+        assert not any("matches no output" in d.message
+                       for d in r.diagnostics)
+
+    def test_pta105_large_const_and_baked_key(self):
+        big = jnp.ones((128, 128), jnp.float32)   # 16k elems
+        key = jax.random.PRNGKey(0)
+
+        def f(x):
+            return x @ big + jax.random.uniform(key, (128,))
+        r = analyze_callable(f, jnp.ones((4, 128), jnp.float32))
+        msgs = [d.message for d in r.diagnostics if d.rule == "PTA105"]
+        assert any("large constant" in m for m in msgs)
+        assert any("rng key" in m for m in msgs)
+
+    def test_pta105_negative_params_as_inputs(self):
+        def f(x, w):
+            return x @ w
+        r = analyze_callable(f, jnp.ones((4, 128), jnp.float32),
+                             jnp.ones((128, 128), jnp.float32))
+        assert "PTA105" not in rules_of(r)
+
+    def test_pta106_cost_report_matmul_flops(self):
+        def f(x, y):
+            return x @ y
+        r = analyze_callable(f, jnp.ones((8, 32), jnp.float32),
+                             jnp.ones((32, 16), jnp.float32))
+        top = [d for d in r.diagnostics if d.rule == "PTA106"]
+        assert top, "cost report missing"
+        # 2*M*N*K = 2*8*16*32 = 8192 for the dot_general
+        assert any("8,192" in d.message and "dot_general" in d.message
+                   for d in top)
+        assert all(d.severity == Severity.INFO for d in top)
+        # negative: cost reporting is opt-out for quiet CI json
+        r = analyze_callable(f, jnp.ones((8, 32), jnp.float32),
+                             jnp.ones((32, 16), jnp.float32),
+                             with_cost=False)
+        assert "PTA106" not in rules_of(r)
+
+    def test_rule_registry_covers_both_frontends(self):
+        jaxpr_rules = [r for r in RULES.values() if r.frontend == "jaxpr"]
+        ast_rules = [r for r in RULES.values()
+                     if r.frontend in ("ast", "chaos")]
+        assert len(jaxpr_rules) >= 4
+        assert len(ast_rules) >= 4
+        assert len(RULES) >= 8
+
+
+# ---------------------------------------------------------------------------
+# model-level entry points
+# ---------------------------------------------------------------------------
+
+
+class TestModelAnalysis:
+    def test_analyze_model_lenet_clean(self):
+        from paddle_tpu.vision.models import LeNet
+        model = LeNet(num_classes=10)
+        model.eval()
+        x = jax.ShapeDtypeStruct((1, 1, 28, 28), jnp.float32)
+        r = analyze_model(model, x, with_cost=False)
+        assert r.errors == [] and r.warnings == [], r.to_text()
+
+    def test_analyze_model_names_dead_param(self):
+        import paddle_tpu.nn as nn
+
+        class TwoHeads(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.used = nn.Linear(4, 4)
+                self.unused = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.used(x)
+
+        r = analyze_model(TwoHeads(),
+                          jax.ShapeDtypeStruct((2, 4), jnp.float32),
+                          with_cost=False)
+        dead = [d for d in r.diagnostics if d.rule == "PTA102"]
+        assert any("unused" in d.message for d in dead), r.to_text()
+
+    def test_trainstep_analyze_donation_aware(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import jit
+
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+
+        def loss_fn(model, xb, yb):
+            return ((model(xb) - yb) ** 2).mean()
+
+        step = jit.TrainStep(net, loss_fn, opt)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.ones((2, 4), np.float32))
+        r = step.analyze(x, y, with_cost=False)
+        assert r.errors == [], r.to_text()
+        # params/opt states are donated AND returned updated: no PTA104
+        assert not any(d.rule == "PTA104" and "matches no output"
+                       in d.message for d in r.diagnostics), r.to_text()
+
+
+# ---------------------------------------------------------------------------
+# JSON schema + CLI + seed-corpus regression
+# ---------------------------------------------------------------------------
+
+
+class TestReporting:
+    def test_json_schema(self):
+        r = lint("""
+            import numpy as np
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    return np.abs(x)
+            """)
+        doc = json.loads(r.to_json())
+        assert doc["version"] == 1
+        assert set(doc) == {"version", "findings", "summary"}
+        assert doc["summary"]["error"] == 1
+        for f in doc["findings"]:
+            assert set(f) == {"rule", "severity", "message", "file",
+                              "line", "col", "hint", "frontend"}
+            assert f["severity"] in ("error", "warning", "info")
+            assert f["rule"] in RULES
+        # severity ordering: errors first
+        sevs = [f["severity"] for f in doc["findings"]]
+        assert sevs == sorted(
+            sevs, key=lambda s: {"error": 0, "warning": 1,
+                                 "info": 2}[s])
+
+    def test_cli_exit_codes(self, tmp_path):
+        from tools import prog_lint
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import numpy as np
+            import paddle_tpu.nn as nn
+            class M(nn.Layer):
+                def forward(self, x):
+                    return np.abs(x)
+            """))
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        assert prog_lint.main([str(bad), "--format=json"]) == 1
+        assert prog_lint.main([str(ok)]) == 0
+        # --min-severity only filters OUTPUT; errors still gate
+        assert prog_lint.main([str(bad), "--min-severity=error"]) == 1
+
+    def test_seed_corpus_lints_clean(self):
+        corpus = [
+            os.path.join(REPO, "paddle_tpu", "vision", "models"),
+            os.path.join(REPO, "paddle_tpu", "nn", "layer",
+                         "transformer.py"),
+            os.path.join(REPO, "paddle_tpu", "framework"),
+            os.path.join(REPO, "paddle_tpu", "distributed"),
+        ]
+        from tools.prog_lint import resolve_target
+        bad = []
+        for target in corpus:
+            for path in resolve_target(target):
+                r = lint_file(path)
+                bad += r.errors + r.warnings
+        assert bad == [], "\n".join(d.render() for d in bad)

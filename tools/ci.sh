@@ -25,6 +25,15 @@ echo "== chaos fault-injection lane (fixed seed, incl. slow) =="
 JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
     python -m pytest tests/test_chaos.py -q
 
+echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
+# whole-package AST lint plus the model-zoo jaxpr passes on the two
+# cheap-to-trace entries; exits nonzero on any error-severity finding
+# (warnings are reported but do not gate — promote with --strict once
+# the corpus has been warning-clean for a while)
+JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
+    --zoo lenet --zoo transformer_encoder \
+    --format=json --min-severity warning
+
 echo "== API signature freeze =="
 JAX_PLATFORMS=cpu python tools/print_signatures.py --check
 

@@ -548,10 +548,45 @@ def main(argv=None):
                   "re-record it with --save on this hardware — comparing "
                   "across estimators would gate nothing", file=sys.stderr)
             return 2
+        # key validation up front: a baseline/thresholds file whose keys
+        # drift from the registered suite must fail with a NAMED diff,
+        # not silently skip ops out of the gate (a gate that compares
+        # nothing is a false green).  Threshold keys may name any
+        # registered op (builtin or current suite) so one measured
+        # thresholds file serves subset runs; baseline must cover every
+        # op this run gates.
+        suite_names = {c.get("name", c.get("op")) for c in suite}
+        known = suite_names | {c["name"] for c in BUILTIN_SUITE}
+        missing_base = sorted(suite_names - set(base))
+        if missing_base:
+            print(f"baseline {a.compare} has no entry for suite op(s): "
+                  f"{missing_base} (baseline keys: {sorted(base)}) — "
+                  "the gate would silently skip them; re-record with "
+                  "--save or trim the suite", file=sys.stderr)
+            return 2
         per_op = {}
         if a.thresholds:
             with open(a.thresholds) as f:
                 per_op = json.load(f)
+            unknown_thr = sorted(set(per_op) - known)
+            if unknown_thr:
+                print(f"thresholds {a.thresholds} names unregistered "
+                      f"op(s): {unknown_thr} (registered: "
+                      f"{sorted(known)}) — a typo'd key silently falls "
+                      "back to --threshold; fix the key or remove it",
+                      file=sys.stderr)
+                return 2
+        # a current run that refused/failed to measure an op the
+        # baseline covers is the same false green the key validation
+        # above guards against: the op leaves the gate with no signal
+        ungated = sorted(r.get("name") for r in results
+                         if "ms" not in r and r.get("name") in base)
+        if ungated:
+            print(f"current run produced no timing for baselined "
+                  f"op(s): {ungated} — the gate cannot compare them "
+                  "(see the per-op error records above); fix the "
+                  "measurement or trim the suite", file=sys.stderr)
+            return 2
         failed = []
         for r in results:
             b = base.get(r.get("name"))

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Program lint CLI — drive the static analyzer over modules, files, or
+model-zoo entries.
+
+Reference roles: tools/check_file_diff_approvals.sh + the inference
+analysis passes' IR validation, folded into one linter the CI gate and
+developers share.
+
+Usage:
+    python tools/prog_lint.py paddle_tpu.vision.models --format=json
+    python tools/prog_lint.py paddle_tpu/nn/layer/transformer.py
+    python tools/prog_lint.py paddle_tpu               # whole package
+    python tools/prog_lint.py --zoo resnet18           # jaxpr passes
+    python tools/prog_lint.py --zoo all paddle_tpu.vision.models
+
+Targets are dotted module names or filesystem paths; packages recurse.
+``--zoo`` additionally traces a vision/transformer model (tiny config,
+abstract trace — no FLOPs spent) and runs the jaxpr IR passes on it.
+Exit status: 1 if any error-severity finding survives suppression
+(``--strict`` also fails on warnings), 2 on bad invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the model-zoo jaxpr corpus: tiny configs so abstract tracing is fast
+ZOO = {
+    "lenet": lambda: _zoo_model("paddle_tpu.vision.models", "LeNet",
+                                dict(num_classes=10), (1, 1, 28, 28)),
+    "resnet18": lambda: _zoo_model("paddle_tpu.vision.models", "resnet18",
+                                   dict(num_classes=10), (1, 3, 32, 32)),
+    "mobilenet_v1": lambda: _zoo_model(
+        "paddle_tpu.vision.models", "mobilenet_v1",
+        dict(num_classes=10, scale=0.25), (1, 3, 32, 32)),
+    "mobilenet_v2": lambda: _zoo_model(
+        "paddle_tpu.vision.models", "mobilenet_v2",
+        dict(num_classes=10, scale=0.25), (1, 3, 32, 32)),
+    "vgg11": lambda: _zoo_model("paddle_tpu.vision.models", "vgg11",
+                                dict(num_classes=10), (1, 3, 224, 224)),
+    "transformer_encoder": lambda: _zoo_transformer(),
+}
+
+
+def _zoo_model(module, ctor, kwargs, input_shape):
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+    mod = importlib.import_module(module)
+    model = getattr(mod, ctor)(**kwargs)
+    model.eval()
+    x = jax.ShapeDtypeStruct(input_shape, jnp.float32)
+    return model, (x,)
+
+
+def _zoo_transformer():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn.layer.transformer import (TransformerEncoder,
+                                                 TransformerEncoderLayer)
+    layer = TransformerEncoderLayer(d_model=64, nhead=4,
+                                    dim_feedforward=128, dropout=0.0)
+    model = TransformerEncoder(layer, num_layers=2)
+    model.eval()
+    x = jax.ShapeDtypeStruct((2, 16, 64), jnp.float32)
+    return model, (x,)
+
+
+def resolve_target(target: str):
+    """A dotted module name or path -> list of .py files to lint."""
+    if os.path.exists(target):
+        if os.path.isdir(target):
+            return sorted(
+                os.path.join(dp, f)
+                for dp, _, fs in os.walk(target) for f in fs
+                if f.endswith(".py"))
+        return [target]
+    try:
+        spec = importlib.util.find_spec(target)
+    except (ImportError, ModuleNotFoundError):
+        spec = None
+    if spec is None or spec.origin is None:
+        raise SystemExit(f"prog_lint: cannot resolve target {target!r} "
+                         "(not a path, not an importable module)")
+    origin = spec.origin
+    if os.path.basename(origin) == "__init__.py":
+        return resolve_target(os.path.dirname(origin))
+    return [origin]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="prog_lint.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("targets", nargs="*",
+                    help="dotted module names or file/dir paths")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--zoo", action="append", default=[],
+                    metavar="ENTRY",
+                    help="run the jaxpr IR passes on a model-zoo entry "
+                         f"({', '.join(sorted(ZOO))}, or 'all')")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated rule IDs to drop (jaxpr rules "
+                         "have no source line for inline pragmas)")
+    ap.add_argument("--min-severity", default="info",
+                    choices=("info", "warning", "error"),
+                    help="report floor (exit status always keys off "
+                         "errors; --strict adds warnings)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too")
+    ap.add_argument("--no-cost", action="store_true",
+                    help="skip the PTA106 cost report (quieter json)")
+    a = ap.parse_args(argv)
+    if not a.targets and not a.zoo:
+        ap.error("nothing to lint: pass a target module/path or --zoo")
+    disable = [r.strip() for r in a.disable.split(",") if r.strip()]
+
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for target in a.targets:
+        for path in resolve_target(target):
+            rel = os.path.relpath(path, REPO) \
+                if path.startswith(REPO) else path
+            sub = lint_file(path, disable=disable)
+            sub.files_seen = [rel]
+            for d in sub.diagnostics:
+                d.file = rel
+            report.extend(sub)
+
+    zoo = a.zoo
+    if "all" in zoo:
+        zoo = sorted(ZOO)
+    for entry in zoo:
+        if entry not in ZOO:
+            raise SystemExit(f"prog_lint: unknown zoo entry {entry!r} "
+                             f"(have: {', '.join(sorted(ZOO))})")
+        from paddle_tpu.framework.analysis import analyze_model
+        model, inputs = ZOO[entry]()
+        report.extend(analyze_model(
+            model, *inputs, name=f"zoo:{entry}", disable=disable,
+            with_cost=not a.no_cost))
+
+    shown = report.filter(min_severity=a.min_severity, disable=disable)
+    if a.format == "json":
+        print(shown.to_json())
+    else:
+        print(shown.to_text())
+    # exit status is computed over the FULL report (floor only hides
+    # output) so --min-severity=info can never mask a failing error
+    return report.filter(disable=disable).exit_code(strict=a.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
