@@ -17,6 +17,7 @@ from paddle_tpu.distributed.tp_layers import (  # noqa: F401
     mark_sharding)
 from paddle_tpu.distributed.spawn import spawn  # noqa: F401
 from paddle_tpu.distributed import checkpoint  # noqa: F401
+from paddle_tpu.distributed import elastic  # noqa: F401
 from paddle_tpu.distributed.dataset import (  # noqa: F401
     DatasetFactory, InMemoryDataset, QueueDataset, train_from_dataset)
 

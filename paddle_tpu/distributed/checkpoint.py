@@ -33,7 +33,7 @@ from paddle_tpu.core import Tensor
 from paddle_tpu.framework import chaos
 
 __all__ = ["save_sharded", "load_sharded", "restore_like",
-           "save_train_state", "load_train_state"]
+           "save_train_state", "load_train_state", "checkpoint_meta"]
 
 _META = "metadata.json"
 
@@ -96,11 +96,15 @@ def _atomic_save(dirpath: str, fname: str, arr: np.ndarray):
         raise
 
 
-def save_sharded(state: Any, dirpath: str, step: Optional[int] = None):
+def save_sharded(state: Any, dirpath: str, step: Optional[int] = None,
+                 extra_meta: Optional[Dict[str, Any]] = None):
     """Write ``state`` (nested dict/list of arrays) as a sharded checkpoint
     directory.  Every process writes only its addressable replica-0 shards.
     Each file commits via tmp+rename (see ``_atomic_save``) so a crash at
-    any instant leaves no torn file under a final name."""
+    any instant leaves no torn file under a final name.  ``extra_meta``
+    (JSON-able) lands in metadata.json — the elastic tier records the
+    save-time ``world_size`` there so a re-formed job knows what layout
+    it is restoring across."""
     os.makedirs(dirpath, exist_ok=True)
     leaves: list = []
     skel = _leafify(state, leaves, "")
@@ -133,6 +137,11 @@ def save_sharded(state: Any, dirpath: str, step: Optional[int] = None):
                                                       a.shape]}]})
     pid = jax.process_index() if jax.process_count() > 1 else 0
     meta = {"skeleton": skel, "leaves": meta_leaves, "step": step}
+    if extra_meta:
+        for k in ("skeleton", "leaves", "step"):
+            if k in extra_meta:
+                raise ValueError(f"extra_meta may not shadow {k!r}")
+        meta.update(extra_meta)
     if pid == 0:
         # metadata is written LAST and atomically: its presence marks the
         # shard set complete, so a kill mid-save leaves a directory that
@@ -141,6 +150,18 @@ def save_sharded(state: Any, dirpath: str, step: Optional[int] = None):
         from paddle_tpu.distributed.fleet.utils.fs import LocalFS
         LocalFS().atomic_write(os.path.join(dirpath, _META),
                                json.dumps(meta))
+
+
+def checkpoint_meta(dirpath: str) -> Dict[str, Any]:
+    """The checkpoint's non-tensor metadata (step, world_size, anything
+    saved via ``extra_meta``) without touching a single shard file — what
+    the elastic re-form reads to decide where to resume the data stream
+    when loading into a *different* world size."""
+    with open(os.path.join(dirpath, _META)) as f:
+        meta = json.load(f)
+    meta.pop("skeleton", None)
+    meta.pop("leaves", None)
+    return meta
 
 
 def _window_reader(dirpath: str, rec: dict) -> Callable:
@@ -275,10 +296,14 @@ def restore_like(template: Any, dirpath: str):
 # TrainStep-level convenience
 # ---------------------------------------------------------------------------
 
-def save_train_state(step, dirpath: str, global_step: Optional[int] = None):
+def save_train_state(step, dirpath: str, global_step: Optional[int] = None,
+                     world_size: Optional[int] = None):
     """Persist a (Sharded)TrainStep's full training state: params, buffers,
     optimizer slots.  Counterpart of the reference's save_persistables +
-    optimizer state save (framework/io.py save path)."""
+    optimizer state save (framework/io.py save path).  ``world_size``
+    (data-parallel width at save time) is recorded in the metadata so an
+    elastic job restoring at a *different* width — shrink-to-survive —
+    can tell, via :func:`checkpoint_meta`, that it is crossing layouts."""
     model = step.model
     state = {
         "params": {n: p._data for n, p in model.named_parameters()},
@@ -289,7 +314,9 @@ def save_train_state(step, dirpath: str, global_step: Optional[int] = None):
         "global_step": np.int64(global_step if global_step is not None
                                 else step.optimizer._global_step),
     }
-    save_sharded(state, dirpath, step=global_step)
+    save_sharded(state, dirpath, step=global_step,
+                 extra_meta=({"world_size": int(world_size)}
+                             if world_size is not None else None))
 
 
 def load_train_state(step, dirpath: str):

@@ -77,7 +77,14 @@ class PaddleCloudRoleMaker(RoleMakerBase):
     def __init__(self, is_collective: bool = False, **kwargs):
         super().__init__()
         self._is_collective = is_collective
-        if is_collective:
+        self._elastic_epoch: Optional[int] = None
+        self._elastic_worker_id: Optional[str] = None
+        self._read_env()
+
+    def _read_env(self):
+        """One env snapshot (the construction-time read; ``refresh``
+        re-runs it mid-job)."""
+        if self._is_collective:
             self._current_id = int(os.getenv(
                 "PADDLE_TRAINER_ID", str(jax.process_index())))
             eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
@@ -96,7 +103,48 @@ class PaddleCloudRoleMaker(RoleMakerBase):
             seps = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
             self._server_endpoints = seps.split(",") if seps else []
 
+    def refresh(self, store=None, worker_id: Optional[str] = None):
+        """Rebuild role/world from the *current* source instead of the
+        construction-time snapshot — the re-form half of elastic
+        training (paddle_tpu.distributed.elastic.reform calls this on
+        every membership-epoch bump).
+
+        ``store=None`` re-reads the PADDLE_* env (a relaunched elastic
+        job exports a fresh block).  With a rendezvous ``store``, the
+        live member list IS the world: rank = this worker's position in
+        the sorted member ids, endpoints from the members' registration
+        metadata, and ``worker_num`` follows the list (the stale
+        PADDLE_TRAINERS_NUM env no longer overrides).  Raises
+        :class:`paddle_tpu.distributed.elastic.Evicted` when this worker
+        is not a member — it must re-register (rejoin) first."""
+        if store is None:
+            self._read_env()
+            return self
+        wid = worker_id or self._elastic_worker_id \
+            or os.getenv("PADDLE_ELASTIC_WORKER_ID")
+        if wid is None:
+            raise ValueError("refresh(store=...) needs worker_id (or "
+                             "PADDLE_ELASTIC_WORKER_ID) to find this "
+                             "worker's rank in the membership")
+        epoch, members, endpoints = store.membership()
+        if wid not in members:
+            from paddle_tpu.distributed.elastic import Evicted
+            raise Evicted(
+                f"worker {wid!r} is not in membership epoch {epoch} "
+                f"({members}) — its lease expired; re-register to rejoin")
+        self._elastic_worker_id = wid
+        self._elastic_epoch = epoch
+        self._current_id = members.index(wid)
+        self._worker_endpoints = [e if e is not None else w
+                                  for w, e in zip(members, endpoints)]
+        self._role = Role.WORKER
+        return self
+
     def worker_num(self) -> int:
+        if self._elastic_epoch is not None:
+            # refreshed from a rendezvous store: the live member list is
+            # authoritative; the launcher's env block is a stale snapshot
+            return RoleMakerBase.worker_num(self)
         n = os.getenv("PADDLE_TRAINERS_NUM")
         if n:
             return int(n)
@@ -112,7 +160,22 @@ class UserDefinedRoleMaker(PaddleCloudRoleMaker):
                  server_endpoints: Optional[List[str]] = None, **kwargs):
         RoleMakerBase.__init__(self)
         self._is_collective = is_collective
+        self._elastic_epoch: Optional[int] = None
+        self._elastic_worker_id: Optional[str] = None
         self._current_id = current_id
         self._role = role
         self._worker_endpoints = worker_endpoints or []
         self._server_endpoints = server_endpoints or []
+
+    def _read_env(self):
+        """Explicit roles have no env to re-read: ``refresh()`` without a
+        store keeps the user-supplied world."""
+
+    def worker_num(self) -> int:
+        # the explicitly passed endpoint list wins — PADDLE_TRAINERS_NUM
+        # (a launcher artifact) must not silently override user config.
+        # With no explicit list there is nothing to win: keep the
+        # inherited env fallback (PS launches export only the count)
+        if self._worker_endpoints:
+            return RoleMakerBase.worker_num(self)
+        return PaddleCloudRoleMaker.worker_num(self)

@@ -49,6 +49,27 @@ def _parse():
                    help="restart a failed child up to N times before "
                         "failing the job (elastic/failure-recovery role "
                         "of the reference's elastic manager)")
+    p.add_argument("--restart_backoff", type=float, default=0.5,
+                   help="base restart delay (s): a crashed child waits "
+                        "backoff*2^restarts (capped at 10s) before its "
+                        "next incarnation, so a crash-looping child "
+                        "cannot burn the whole retry budget in ~1s")
+    p.add_argument("--healthy_interval", type=float, default=30.0,
+                   help="seconds of continuous child life after which "
+                        "its restart budget resets to 0")
+    p.add_argument("--elastic_store", type=str, default="",
+                   help="directory for the elastic rendezvous FileStore; "
+                        "when set, children are supervised by the "
+                        "ElasticAgent (crash + hang + lease watchdogs, "
+                        "shrink-to-survive) instead of plain "
+                        "watch_local_trainers polling")
+    p.add_argument("--lease_ttl", type=float, default=10.0,
+                   help="elastic: lease seconds before a silent worker "
+                        "is expired (membership epoch bump)")
+    p.add_argument("--hang_deadline", type=float, default=60.0,
+                   help="elastic: kill a child whose progress beat is "
+                        "older than this (hung/straggler detection; only "
+                        "applies once the child has beaten at least once)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -99,21 +120,37 @@ class _Child:
     def alive(self):
         return self.proc.poll() is None
 
-    def terminate(self):
+    def terminate(self, grace: float = 5.0):
         if self.alive():
             self.proc.terminate()
             try:
-                self.proc.wait(timeout=5)
+                self.proc.wait(timeout=grace)
             except Exception:          # noqa: BLE001
                 self.proc.kill()
+                try:
+                    # reap: without this wait the SIGKILLed child stays
+                    # a zombie for the launcher's whole lifetime
+                    self.proc.wait(timeout=5)
+                except Exception:      # noqa: BLE001
+                    pass
         if self.log_file and not self.log_file.closed:
             self.log_file.close()
 
 
-def _supervise(children: List[_Child], elastic_retries: int = 0) -> int:
+def _supervise(children: List[_Child], elastic_retries: int = 0,
+               restart_backoff: float = 0.5, backoff_cap: float = 10.0,
+               healthy_interval: float = 30.0,
+               poll_interval: float = 0.2) -> int:
     """watch_local_trainers (launch_utils.py:522): poll; a non-zero exit
     restarts the child while elastic retries remain, else kills the job;
-    success when every child exits 0."""
+    success when every child exits 0.
+
+    Restarts are paced: a crashed child waits ``restart_backoff *
+    2^restarts`` (capped) before its next incarnation — an instantly
+    dying child can no longer burn the whole retry budget in about a
+    second — and a child that then stays alive for ``healthy_interval``
+    earns its budget back (a crash tomorrow should not be charged for a
+    crash last week)."""
 
     def _sig(_s, _f):
         for c in children:
@@ -122,20 +159,39 @@ def _supervise(children: List[_Child], elastic_retries: int = 0) -> int:
 
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
+    pending: Dict[str, float] = {}        # name -> restart-at monotonic
+    alive_since: Dict[str, float] = {}
     try:
         while True:
+            now = time.monotonic()
             alive = False
             for c in children:
+                if c.name in pending:
+                    if now >= pending[c.name]:
+                        del pending[c.name]
+                        c.restart()
+                        alive_since[c.name] = time.monotonic()
+                    alive = True          # job still in flight
+                    continue
                 rc = c.proc.poll()
                 if rc is None:
                     alive = True
+                    if (now - alive_since.setdefault(c.name, now)
+                            >= healthy_interval and c.restarts):
+                        print(f"launch: {c.name} healthy for "
+                              f"{healthy_interval:g}s — restart budget "
+                              "reset", file=sys.stderr)
+                        c.restarts = 0
                 elif rc != 0:
                     if c.restarts < elastic_retries:
+                        delay = min(restart_backoff * (2 ** c.restarts),
+                                    backoff_cap)
                         print(f"launch: {c.name} exited with {rc}; "
                               f"elastic restart "
-                              f"{c.restarts + 1}/{elastic_retries}",
-                              file=sys.stderr)
-                        c.restart()
+                              f"{c.restarts + 1}/{elastic_retries} "
+                              f"in {delay:.2f}s", file=sys.stderr)
+                        pending[c.name] = now + delay  # restart() bumps
+                                                       # c.restarts
                         alive = True
                         continue
                     print(f"launch: {c.name} exited with {rc}"
@@ -147,11 +203,65 @@ def _supervise(children: List[_Child], elastic_retries: int = 0) -> int:
                     return rc
             if not alive:
                 return 0
-            time.sleep(0.2)
+            time.sleep(poll_interval)
     finally:
         for c in children:
             if c.log_file and not c.log_file.closed:
                 c.log_file.close()
+
+
+def _run_supervisor(args, children: List[_Child],
+                    members: Optional[List[_Child]] = None,
+                    endpoints: Optional[Dict[str, str]] = None) -> int:
+    """Route to the elastic agent (crash + hang + lease watchdogs) when a
+    rendezvous store is configured, else classic watch_local_trainers.
+    ``members`` is the subset that joins the rendezvous MEMBERSHIP (the
+    trainers); PS servers are supervised but never appear in the world a
+    refreshed role maker ranks against.  ``endpoints`` maps member name
+    to its host:port so a refreshed role maker hands out real trainer
+    endpoints, not bare child names."""
+    if not args.elastic_store:
+        return _supervise(children, args.elastic_retries,
+                          restart_backoff=args.restart_backoff,
+                          healthy_interval=args.healthy_interval)
+    from paddle_tpu.distributed.elastic import (ElasticAgent, FileStore,
+                                                ProcHandle)
+    store = FileStore(os.path.join(args.elastic_store, "rendezvous.json"),
+                      ttl=args.lease_ttl)
+    members = children if members is None else members
+    for c in members:
+        store.register(c.name, endpoint=(endpoints or {}).get(c.name))
+    agent = ElasticAgent(store, [ProcHandle(c) for c in children],
+                         hang_deadline=args.hang_deadline,
+                         elastic_retries=args.elastic_retries,
+                         restart_backoff=args.restart_backoff,
+                         healthy_interval=args.healthy_interval,
+                         log=lambda m: print(m, file=sys.stderr),
+                         member_names=[c.name for c in members],
+                         endpoints=endpoints)
+
+    def _sig(_s, _f):
+        for c in children:
+            c.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    return agent.run()
+
+
+def _elastic_env(args, name: str) -> Dict[str, str]:
+    """Extra env for children of an elastic launch: where the store is
+    and who they are, so an elastic-aware trainer can beat progress /
+    renew its own lease / refresh its role maker on epoch bumps."""
+    if not args.elastic_store:
+        return {}
+    return {
+        "PADDLE_ELASTIC_STORE": os.path.join(args.elastic_store,
+                                             "rendezvous.json"),
+        "PADDLE_ELASTIC_WORKER_ID": name,
+        "PADDLE_ELASTIC_LEASE_TTL": str(args.lease_ttl),
+    }
 
 
 def _launch_collective(args, ips) -> int:
@@ -168,11 +278,14 @@ def _launch_collective(args, ips) -> int:
         "PADDLE_CURRENT_ENDPOINT": endpoints[rank] if rank < len(endpoints)
         else endpoints[0],
     }
+    name = f"trainer-{rank}"
+    env.update(_elastic_env(args, name))
     os.makedirs(args.log_dir, exist_ok=True)
     cmd = [sys.executable, args.training_script] + args.training_script_args
-    child = _Child(f"trainer-{rank}", cmd, env,
+    child = _Child(name, cmd, env,
                    os.path.join(args.log_dir, f"workerlog.{rank}"))
-    return _supervise([child], args.elastic_retries)
+    return _run_supervisor(args, [child],
+                           endpoints={name: env["PADDLE_CURRENT_ENDPOINT"]})
 
 
 def _launch_ps(args) -> int:
@@ -201,10 +314,14 @@ def _launch_ps(args) -> int:
         env = dict(common, TRAINING_ROLE="TRAINER",
                    PADDLE_TRAINER_ID=str(i),
                    PADDLE_CURRENT_ENDPOINT=worker_eps[i])
+        env.update(_elastic_env(args, f"trainer-{i}"))
         children.append(_Child(
             f"trainer-{i}", cmd, env,
             os.path.join(args.log_dir, f"workerlog.{i}")))
-    return _supervise(children, args.elastic_retries)
+    return _run_supervisor(
+        args, children,
+        members=[c for c in children if c.name.startswith("trainer-")],
+        endpoints={f"trainer-{i}": worker_eps[i] for i in range(n_w)})
 
 
 def main():

@@ -87,7 +87,13 @@ def _recv_msg(sock: socket.socket):
 class HeartBeatMonitor:
     """Tracks last-beat time per worker; a worker silent for longer than
     ``timeout`` is reported dead (heart_beat_monitor.cc:56 LostWorkerMonitor
-    loop, with the thread made optional)."""
+    loop, with the thread made optional).
+
+    Death is not permanent: a beat from a reported-dead worker *revives*
+    it — and counts a **flap** (dead→alive transition, surfaced via
+    ``flap_count``/``on_revive``) so the elastic agent can tell a flaky
+    worker (restartable, but burn its retry budget) from a gone one
+    (expire its lease, shrink the job)."""
 
     def __init__(self, timeout: float = 30.0):
         self.timeout = timeout
@@ -96,12 +102,26 @@ class HeartBeatMonitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.on_dead = None            # callback(worker_id)
+        self.on_revive = None          # callback(worker_id, flap_count)
         self._reported: set = set()
+        self._flaps: Dict[str, int] = {}
 
     def beat(self, worker: str):
         with self._lock:
+            was_dead = worker in self._reported
             self._beats[worker] = time.monotonic()
             self._reported.discard(worker)
+            if was_dead:
+                self._flaps[worker] = self._flaps.get(worker, 0) + 1
+                flaps = self._flaps[worker]
+        if was_dead and self.on_revive is not None:
+            self.on_revive(worker, flaps)
+
+    def flap_count(self, worker: str) -> int:
+        """dead→alive transitions seen for this worker (0 = never died
+        or never came back)."""
+        with self._lock:
+            return self._flaps.get(worker, 0)
 
     def mark_dead(self, worker: str):
         """Force-report a peer dead NOW (no timeout wait) — the PS client
@@ -184,6 +204,7 @@ class PsServer:
         self.tables = tables
         self.monitor = HeartBeatMonitor(heartbeat_timeout)
         self.n_workers = n_workers
+        self.epoch = 0                 # membership-epoch fence (elastic)
         self._bye_count = 0
         self._lock = threading.Lock()
         self._tcp = _TcpServer((host, port), _Handler)
@@ -192,8 +213,46 @@ class PsServer:
         self._thread: Optional[threading.Thread] = None
 
     # -- request dispatch ---------------------------------------------------
+    _FENCED_OPS = ("push", "load_state")
+
     def _dispatch(self, header: dict, bufs):
         op = header.get("op")
+        # membership-epoch fencing (elastic re-form): a worker still
+        # running under a pre-bump epoch must not mutate tables the
+        # survivors have re-formed — its pushes are rejected hard (the
+        # client surfaces this as a non-retried RuntimeError).  Once a
+        # fence is installed (epoch > 0) an UNSTAMPED mutation is equally
+        # stale — every live worker of a fenced job adopted an epoch at
+        # its last re-form; epochless clients stay compatible only while
+        # the job has never fenced.  Reads stay open: a stale pull is
+        # harmless and the worker needs its error path, not a hang.
+        we = header.get("epoch")
+        if op in self._FENCED_OPS and self.epoch > 0 and \
+                (we is None or we < self.epoch):
+            return {"ok": False,
+                    "error": f"stale membership epoch {we} < {self.epoch}"
+                             " — the job re-formed without this worker; "
+                             "rejoin and refresh before pushing"}, []
+        if op == "set_epoch":
+            with self._lock:
+                e = int(header["epoch"])
+                if header.get("n_workers") is not None and e >= self.epoch:
+                    # the re-form carries the new world size: the bye
+                    # quorum must follow a shrink, or the server waits
+                    # forever for byes from workers that no longer
+                    # exist.  Gated on the epoch so a slower survivor's
+                    # STALE re-form cannot overwrite a newer quorum.
+                    self.n_workers = int(header["n_workers"])
+                if e > self.epoch:
+                    # a NEW generation discards byes banked under the
+                    # previous one — only its own survivors' byes may
+                    # tip the quorum.  Strictly greater: the second
+                    # survivor installing the SAME epoch must not wipe
+                    # byes its peers already banked under it.
+                    self._bye_count = 0
+                self.epoch = max(self.epoch, e)
+            return {"ok": True, "epoch": self.epoch,
+                    "n_workers": self.n_workers}, []
         if op == "pull":
             t = self.tables[header["table"]]
             return {"ok": True}, [t.pull(bufs[0].astype(np.int64))]
@@ -231,16 +290,26 @@ class PsServer:
                                    "dim": getattr(t, "embedding_dim", 0)}
                                for n, t in self.tables.items()},
                     "workers": self.monitor.workers(),
-                    "dead": self.monitor.dead_workers()}, []
+                    "dead": self.monitor.dead_workers(),
+                    "flaps": {w: self.monitor.flap_count(w)
+                              for w in self.monitor.workers()},
+                    "epoch": self.epoch}, []
         if op == "bye":
+            # a fenced job counts only CURRENT-epoch byes toward the
+            # shutdown quorum: an evicted stale worker's graceful exit
+            # must not tip a shrunk quorum and kill the servers under
+            # the survivors still training.  (Reply ok either way — the
+            # stale worker is leaving, which is exactly what we want.)
+            stale = self.epoch > 0 and (we is None or we < self.epoch)
             done = False
             with self._lock:
-                self._bye_count += 1
+                if not stale:
+                    self._bye_count += 1
                 if self.n_workers and self._bye_count >= self.n_workers:
                     done = True
             if done:
                 threading.Thread(target=self.shutdown, daemon=True).start()
-            return {"ok": True, "remaining":
+            return {"ok": True, "stale": stale, "remaining":
                     (self.n_workers - self._bye_count)
                     if self.n_workers else -1}, []
         if op == "shutdown":
@@ -282,7 +351,14 @@ class _Conn:
         self.timeout = float(flag("ps_rpc_timeout")) if timeout is None \
             else timeout
         self.lock = threading.Lock()
-        self.sock = self._connect()
+        # first dial is best-effort: a client may legitimately be built
+        # over a server set containing dead peers (elastic re-shard
+        # probing survivors) — rpc() redials lazily and its retry path
+        # owns the failure
+        try:
+            self.sock = self._connect()
+        except OSError:
+            self.sock = None
 
     def _connect(self):
         sock = socket.create_connection(self._addr, timeout=self.timeout)
@@ -360,6 +436,7 @@ class PsClient:
         self._pool = ThreadPoolExecutor(max_workers=max(
             2, len(self.endpoints)))
         self.worker_id = worker_id or f"worker-{os.getpid()}"
+        self.epoch: Optional[int] = None   # membership epoch (elastic)
         self.monitor = monitor
         self.max_retries = int(flag("ps_rpc_max_retries")) \
             if max_retries is None else int(max_retries)
@@ -379,6 +456,8 @@ class PsClient:
     def _rpc(self, s: int, header: dict, bufs=(),
              retries: Optional[int] = None):
         conn, ep = self._conns[s], self.endpoints[s]
+        if self.epoch is not None:
+            header.setdefault("epoch", self.epoch)
         retries = self.max_retries if retries is None else retries
         last: Optional[Exception] = None
         for attempt in range(retries + 1):
@@ -487,13 +566,35 @@ class PsClient:
         reply, _ = self._rpc(server, {"op": "stat"})
         return reply
 
+    def set_epoch(self, epoch: int, fence_servers: bool = False,
+                  n_workers: Optional[int] = None):
+        """Adopt a membership epoch: every subsequent RPC is stamped with
+        it.  ``fence_servers=True`` additionally installs the epoch on
+        every server (elastic re-form), after which any client still
+        stamping an older epoch — or none at all — gets its pushes
+        rejected: the stale pre-epoch worker cannot corrupt the
+        re-formed tables.  ``n_workers`` re-sizes the servers' bye
+        quorum to the re-formed world."""
+        self.epoch = int(epoch)
+        if fence_servers:
+            for s in range(self.n):
+                self._rpc(s, {"op": "set_epoch", "epoch": self.epoch,
+                              "n_workers": n_workers})
+
     def bye(self):
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2.0)
         for c in self._conns:
             try:
-                c.rpc({"op": "bye", "worker": self.worker_id})
+                # bye goes over the raw conn (no retries wanted on the
+                # way out) so the epoch stamp _rpc would add must be
+                # spelled out — a fenced server only counts current-epoch
+                # byes toward its shutdown quorum
+                header = {"op": "bye", "worker": self.worker_id}
+                if self.epoch is not None:
+                    header["epoch"] = self.epoch
+                c.rpc(header)
             except (RuntimeError, OSError, ConnectionError):
                 pass
             c.close()

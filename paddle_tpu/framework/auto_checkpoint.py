@@ -24,9 +24,24 @@ from typing import Iterator, Optional
 
 from paddle_tpu.distributed import checkpoint as dckpt
 
-__all__ = ["TrainEpochRange", "train_epoch_range"]
+__all__ = ["TrainEpochRange", "train_epoch_range", "latest_checkpoint"]
 
 _STATUS = "acp_status.json"
+
+
+def latest_checkpoint(checkpoint_dir: str):
+    """The latest *committed* slot under a TrainEpochRange checkpoint
+    directory: ``(slot_dir, epoch)``, or None when nothing committed yet.
+    The status record is the two-slot protocol's commit point, so this
+    never returns a mid-save (torn) slot — it is what the elastic
+    re-form path (paddle_tpu.distributed.elastic.reform) restores from
+    when the job shrinks or grows."""
+    try:
+        with open(os.path.join(checkpoint_dir, _STATUS)) as f:
+            s = json.load(f)
+        return os.path.join(checkpoint_dir, s["slot"]), int(s["epoch"])
+    except (OSError, ValueError, KeyError):
+        return None
 
 
 class TrainEpochRange:
@@ -45,11 +60,13 @@ class TrainEpochRange:
 
     def __init__(self, max_epoch_num: int, name: str, train_step=None,
                  checkpoint_dir: Optional[str] = None,
-                 save_checkpoint_inter: float = 0.0):
+                 save_checkpoint_inter: float = 0.0,
+                 world_size: Optional[int] = None):
         self.max_epoch_num = max_epoch_num
         self.name = name
         self.train_step = train_step
         self.save_checkpoint_inter = save_checkpoint_inter
+        self.world_size = world_size
         self.checkpoint_dir = checkpoint_dir or os.environ.get(
             "PADDLE_CHECKPOINT_DIR", os.path.join(".acp", name))
         self._last_save = 0.0
@@ -94,7 +111,8 @@ class TrainEpochRange:
         slot_dir = os.path.join(self.checkpoint_dir, slot)
         if os.path.isdir(slot_dir):
             shutil.rmtree(slot_dir)
-        dckpt.save_train_state(self.train_step, slot_dir, global_step=epoch)
+        dckpt.save_train_state(self.train_step, slot_dir, global_step=epoch,
+                               world_size=self.world_size)
         self._write_status(epoch, slot)
         self._last_save = time.monotonic()
 

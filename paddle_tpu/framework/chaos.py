@@ -11,13 +11,24 @@ layers that must survive them.
 
 Fault points shipped in-tree (grep for ``fault_point(`` to audit):
 
-=================  ========================================================
-``ps.rpc``          client side of every PS RPC (ps/service.py _Conn.rpc)
-``fs.write``        crash-safe file writes (fleet/utils/fs.py atomic_write)
-``ckpt.save``       per-file checkpoint writes (distributed/checkpoint.py)
-``download.fetch``  each fetch attempt (utils/download.py)
-``train.step_grads`` per-step input poisoning (framework/resilient.py)
-=================  ========================================================
+=====================  ====================================================
+``ps.rpc``              client side of every PS RPC (ps/service.py
+                        _Conn.rpc)
+``fs.write``            crash-safe file writes (fleet/utils/fs.py
+                        atomic_write)
+``ckpt.save``           per-file checkpoint writes (distributed/
+                        checkpoint.py)
+``download.fetch``      each fetch attempt (utils/download.py)
+``train.step_grads``    per-step input poisoning (framework/resilient.py)
+``elastic.lease``       every lease renewal (distributed/elastic.py
+                        RendezvousStore.renew) — ``mode="error"`` is a
+                        lost renewal: the lease runs out, a peer's sweep
+                        expires it, the membership epoch bumps
+``elastic.worker_hang`` per-step worker liveness beat (elastic.py
+                        ElasticWorkerContext.step_done) —
+                        ``mode="latency"`` is a straggler/hung worker the
+                        agent's hang deadline must catch
+=====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
 the Nth call), ``every`` (trip every Nth call), ``p`` (seeded
@@ -54,7 +65,7 @@ __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
            "payload_fault_points"]
 
 FAULT_POINTS = ("ps.rpc", "fs.write", "ckpt.save", "download.fetch",
-                "train.step_grads")
+                "train.step_grads", "elastic.lease", "elastic.worker_hang")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

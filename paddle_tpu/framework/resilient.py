@@ -82,6 +82,8 @@ class ResilientTrainStep:
         self.skipped_steps = 0
         self.rollbacks = 0
         self.last_step_skipped = False
+        self.membership_epoch: Optional[int] = None
+        self.membership_events = 0
 
     # -- snapshot / restore --------------------------------------------------
     def snapshot(self):
@@ -117,6 +119,17 @@ class ResilientTrainStep:
         if hasattr(opt, "_global_step"):
             opt._global_step = snap["global_step"]
         self._good_since_snap = 0
+
+    def membership_changed(self, epoch: Optional[int] = None):
+        """Surface a membership-epoch bump (elastic shrink/grow) to the
+        rollback tier: snapshot the CURRENT last-good state immediately,
+        *before* the re-form path refreshes roles and re-shards layouts —
+        so whatever the re-form restores or the next rollback needs is
+        never newer than the membership it was computed under.  Called by
+        :func:`paddle_tpu.distributed.elastic.reform`."""
+        self.membership_epoch = epoch
+        self.membership_events += 1
+        self.snapshot()
 
     # -- detection -----------------------------------------------------------
     def _finite(self, loss) -> bool:

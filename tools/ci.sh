@@ -25,13 +25,22 @@ echo "== chaos fault-injection lane (fixed seed, incl. slow) =="
 JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
     python -m pytest tests/test_chaos.py -q
 
+echo "== elastic membership/re-form lane (fixed seed, incl. slow) =="
+# the job-level recovery tier: lease-expiry shrink to loss parity,
+# hang-watchdog kill+replace, SIGKILL-a-worker-mid-epoch multi-process
+# re-form — deterministic (fake clock + fixed chaos seed)
+JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
+    python -m pytest tests/test_elastic.py -q
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
-# whole-package AST lint plus the model-zoo jaxpr passes on the two
-# cheap-to-trace entries; exits nonzero on any error-severity finding
-# (warnings are reported but do not gate — promote with --strict once
-# the corpus has been warning-clean for a while)
+# whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
+# to-trace entries — elastic_step traces the resilient train step and
+# lints the chaos-threaded elastic sources, so PTA301/302 cover the
+# elastic.lease / elastic.worker_hang fault points; exits nonzero on any
+# error-severity finding (warnings are reported but do not gate —
+# promote with --strict once the corpus has been warning-clean a while)
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
-    --zoo lenet --zoo transformer_encoder \
+    --zoo lenet --zoo transformer_encoder --zoo elastic_step \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="

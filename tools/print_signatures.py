@@ -51,6 +51,7 @@ MODULES = [
     "paddle_tpu.metric",
     "paddle_tpu.distribution",
     "paddle_tpu.distributed",
+    "paddle_tpu.distributed.elastic",
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.vision",
     "paddle_tpu.vision.models",

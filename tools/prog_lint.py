@@ -44,6 +44,9 @@ ZOO = {
     "vgg11": lambda: _zoo_model("paddle_tpu.vision.models", "vgg11",
                                 dict(num_classes=10), (1, 3, 224, 224)),
     "transformer_encoder": lambda: _zoo_transformer(),
+    # returns a finished Report (step trace + chaos-source lint), not a
+    # (model, inputs) pair — see the Report branch in main()
+    "elastic_step": lambda: _zoo_elastic_step(),
 }
 
 
@@ -71,6 +74,52 @@ def _zoo_transformer():
     model.eval()
     x = jax.ShapeDtypeStruct((2, 16, 64), jnp.float32)
     return model, (x,)
+
+
+def _zoo_elastic_step():
+    """The elastic train step, both front ends: the jaxpr IR passes trace
+    the fused TrainStep a ResilientTrainStep drives (abstract, no FLOPs),
+    and the AST lint covers the chaos-threaded elastic/resilient sources
+    — so PTA301/302 validate the ``elastic.lease`` /
+    ``elastic.worker_hang`` / ``train.step_grads`` fault-point sites the
+    elastic loop fires every step."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer
+    from paddle_tpu.framework.analysis import lint_file
+    from paddle_tpu.framework.resilient import ResilientTrainStep
+    from paddle_tpu.jit import TrainStep
+
+    class _MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(6, 12)
+            self.fc2 = nn.Linear(12, 3)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    def loss_fn(m, x, y):
+        return paddle.nn.functional.cross_entropy(m(x), y).mean()
+
+    paddle.seed(0)
+    model = _MLP()
+    opt = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                             parameters=model.parameters())
+    resilient = ResilientTrainStep(
+        TrainStep(model, loss_fn, opt, donate=False))
+    report = resilient.step.analyze(
+        np.zeros((4, 6), np.float32), np.zeros((4,), np.int64))
+    for rel in (os.path.join("paddle_tpu", "distributed", "elastic.py"),
+                os.path.join("paddle_tpu", "framework", "resilient.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
 
 
 def resolve_target(target: str):
@@ -141,8 +190,15 @@ def main(argv=None) -> int:
         if entry not in ZOO:
             raise SystemExit(f"prog_lint: unknown zoo entry {entry!r} "
                              f"(have: {', '.join(sorted(ZOO))})")
+        from paddle_tpu.framework.analysis import Report as _Report
         from paddle_tpu.framework.analysis import analyze_model
-        model, inputs = ZOO[entry]()
+        out = ZOO[entry]()
+        if isinstance(out, _Report):     # pre-built report (elastic_step)
+            if a.no_cost:                # honor --no-cost like the
+                out = out.filter(disable=["PTA106"])  # analyze_model path
+            report.extend(out)
+            continue
+        model, inputs = out
         report.extend(analyze_model(
             model, *inputs, name=f"zoo:{entry}", disable=disable,
             with_cost=not a.no_cost))
