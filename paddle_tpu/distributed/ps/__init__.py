@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from typing import Dict, Optional
 
 import jax
@@ -432,19 +433,219 @@ class PSTrainStep:
     together ~8× fewer bytes than naive per-slot f32 rows on skewed id
     distributions.  Unique counts are bucketed (next power of two) so
     the XLA signature cache stays small.
+
+    **Pull/compute overlap** — announce the NEXT batch's ids with
+    :meth:`prefetch` and the blocking pull disappears behind the chip::
+
+        step.prefetch(ids[0])
+        for n in range(N):
+            if n + 1 < N:
+                step.prefetch(ids[n + 1])
+            loss = step(ids[n], x[n], y[n])
+        step.flush()
+
+    Each step then runs: consume the prefetched rows (already pulled
+    while the PREVIOUS step's device computation ran), dispatch the
+    fused XLA step, and — right after dispatch, while the chip is busy
+    — issue the announced next batch's fan-out on a background
+    executor, coalescing the previous step's deferred gradient push
+    into the same per-shard RPC (``push_pull``: one round-trip per
+    shard per step, the DownpourWorker amortization).  Ordering /
+    staleness guarantee: the rows pulled for step N+1 reflect every
+    push up to step N-1 — one step more staleness than the async
+    communicator path, none at all vs. the geo path.  A membership
+    re-form (``elastic.reform``) between issue and consume is detected
+    by the epoch stamp: the stale prefetched rows are discarded and
+    re-pulled under the new epoch, a coalesced push that the fence
+    rejected stays dropped (the re-form restored past it), and any
+    other prefetch failure replays the push through the synchronous
+    path — the server's ``(worker, seq)`` dedup absorbs the replay if
+    the original actually landed.  The ``ps.pipeline`` chaos point
+    fires at the head of every background task so the chaos suite can
+    prove all of this on demand.  ``prefetch_depth``
+    (FLAGS_ps_prefetch_depth) bounds the in-flight prefetches; 0
+    disables the pipeline (prefetch() becomes a no-op), 1 is the
+    classic double buffer.
     """
 
     def __init__(self, model: Layer, loss_fn, optimizer,
                  embedding: "DistributedEmbedding", donate: bool = True,
-                 transfer_dtype="bfloat16"):
+                 transfer_dtype="bfloat16",
+                 prefetch_depth: Optional[int] = None):
+        from paddle_tpu.framework.flags import flag
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.embedding = embedding
         self.donate = donate
         self.transfer_dtype = str(transfer_dtype)
+        self.prefetch_depth = int(flag("ps_prefetch_depth")) \
+            if prefetch_depth is None else int(prefetch_depth)
         self._opt_states = None
         self._cache: Dict[tuple, object] = {}
+        # -- prefetch pipeline state (single training thread drives it;
+        # only the executor tasks run concurrently, and they touch only
+        # thread-safe table/client objects + local arrays)
+        self._announced: "deque" = deque()   # ids awaiting issue
+        self._inflight: "deque" = deque()    # issued background tasks
+        # deferred (uniq_ids, grads) pushes awaiting coalesce — a QUEUE,
+        # not a slot: when a step has nothing left to issue (the last
+        # step of an epoch, a fault-degraded stretch) the previous
+        # step's deferred push is still here when this step stashes its
+        # own, and a single slot would silently drop a gradient
+        self._pending_push: list = []
+        self._prefetch_pool = None           # lazy ThreadPoolExecutor
+
+    # -- prefetch pipeline --------------------------------------------------
+    @staticmethod
+    def _unique_prep(ids_np):
+        """Unique ids + inverse map + power-of-two padded id vector (the
+        signature-cache bucketing) — the host-side prep every pull
+        needs; runs on the background executor when pipelined."""
+        import numpy as _np
+        uniq, inv = _np.unique(ids_np.reshape(-1), return_inverse=True)
+        cap = max(256, 1 << int(_np.ceil(_np.log2(len(uniq)))))
+        uniq_p = _np.zeros((cap,), _np.int64)
+        uniq_p[:len(uniq)] = uniq
+        return uniq, inv, uniq_p
+
+    def prefetch(self, ids):
+        """Announce the ids of an upcoming batch.  The actual shard
+        fan-out is issued right after the *current* step's device
+        dispatch (see class docstring), so the pull hides behind the
+        chip.  No-op when the pipeline is disabled
+        (``prefetch_depth=0``)."""
+        if self.prefetch_depth <= 0:
+            return
+        import numpy as _np
+        self._announced.append(_np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids, _np.int64))
+
+    def _prefetch_task(self, table, ids_np, push):
+        """Background fan-out: unique the announced ids and run the
+        coalesced push+pull round-trip (plain pull when no push is
+        pending or the table has no coalesced op)."""
+        from paddle_tpu.framework import chaos
+        chaos.fault_point("ps.pipeline",  # pta: disable=PTA301 (PSTrainStep._consume_prefetch owns fallback: sync re-pull + push replay)
+                          meta={"n_ids": int(ids_np.size),
+                                "coalesced_push": push is not None})
+        uniq, inv, uniq_p = self._unique_prep(ids_np)
+        if push is not None and hasattr(table, "push_pull"):
+            rows = table.push_pull(push[0], push[1], uniq_p, seq=push[2])
+        else:
+            if push is not None:
+                self._replay_push(push)
+            rows = table.pull(uniq_p)
+        return uniq, inv, uniq_p, rows
+
+    def _take_pending_push(self):
+        """Drain the deferred-push queue into one ``(ids, grads, seq)``
+        payload.  Usually 0 or 1 entries; multiple (fault-degraded
+        stretches) concatenate — the table's duplicate-id merge
+        accumulates them exactly like separate pushes under sgd, and
+        within one batch-merge granularity under adagrad.  The dedup
+        ``seq`` is allocated HERE, once per payload, so a replay after
+        a failed/ambiguous first attempt re-sends the SAME stamp and
+        the server's dedup can actually absorb it."""
+        import numpy as _np
+        if not self._pending_push:
+            return None
+        if len(self._pending_push) == 1:
+            ids_p, g_p = self._pending_push[0]
+        else:
+            ids_p = _np.concatenate([p[0] for p in self._pending_push])
+            g_p = _np.concatenate([p[1] for p in self._pending_push])
+        self._pending_push.clear()
+        client = getattr(self.embedding.table, "client", None)
+        seq = client._next_seq() if client is not None else None
+        return (ids_p, g_p, seq)
+
+    def _replay_push(self, push):
+        """Re-send a coalesced push whose first attempt failed or whose
+        outcome is unknown, reusing its original seq stamp so the
+        server drops the copy if the first attempt actually landed."""
+        table = self.embedding.table
+        client = getattr(table, "client", None)
+        if client is not None and push[2] is not None:
+            table.push(push[0], push[1], seq=push[2])
+        else:
+            table.push(push[0], push[1])
+
+    def _issue_prefetch(self):
+        """Issue announced fan-outs (up to ``prefetch_depth`` in
+        flight) onto the background executor, coalescing the previous
+        step's deferred gradient push into the first one."""
+        while (self.prefetch_depth > 0 and self._announced
+               and len(self._inflight) < self.prefetch_depth):
+            ids_np = self._announced.popleft()
+            push = self._take_pending_push()
+            table = self.embedding.table
+            client = getattr(table, "client", None)
+            if self._prefetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._prefetch_pool = ThreadPoolExecutor(
+                    max_workers=max(1, self.prefetch_depth),
+                    thread_name_prefix="ps-prefetch")
+            self._inflight.append({
+                "key": ids_np, "push": push,
+                "epoch": getattr(client, "epoch", None),
+                "future": self._prefetch_pool.submit(
+                    self._prefetch_task, table, ids_np, push)})
+
+    def _settle_inflight(self, inf):
+        """Resolve one in-flight prefetch, owning the error policy for
+        its coalesced push.  Returns the task result, or ``None`` when
+        the task failed (after replaying the push where the contract
+        requires it):
+
+        * elastic-fence rejection (``stale membership epoch``) — the
+          push stays DROPPED: the re-form restored past it;
+        * any other server-side error — replay the push synchronously
+          (a genuine table fault then re-raises from the replay
+          instead of vanishing);
+        * transport failure (injected ``ps.pipeline``/``ps.rpc`` fault,
+          retries exhausted) — replay; the server's (worker, seq)
+          stamp reservation absorbs the replay if the original landed.
+
+        Replays go through :meth:`_replay_push`, which re-sends the
+        payload's ORIGINAL seq — a fresh stamp would defeat the dedup
+        exactly when it matters (push half applied, pull half failed).
+        """
+        try:
+            return inf["future"].result()
+        except RuntimeError as e:
+            if inf["push"] is not None and \
+                    "stale membership epoch" not in str(e):
+                self._replay_push(inf["push"])
+            return None
+        except (ConnectionError, OSError):
+            if inf["push"] is not None:
+                self._replay_push(inf["push"])
+            return None
+
+    def _consume_prefetch(self, ids_np):
+        """Take the head in-flight prefetch for this batch; ``None``
+        means "pull synchronously" (nothing prefetched, the prefetch
+        failed, or a membership re-form made its rows stale)."""
+        import numpy as _np
+        if not self._inflight:
+            # the head announcement may be THIS batch's own (the
+            # warm-up call before the first step): drop it, or the
+            # issue stage would re-pull a batch already pulled here
+            if self._announced and _np.array_equal(self._announced[0],
+                                                   ids_np):
+                self._announced.popleft()
+            return None
+        inf = self._inflight.popleft()
+        client = getattr(self.embedding.table, "client", None)
+        got = self._settle_inflight(inf)
+        if got is None:
+            return None
+        if not _np.array_equal(inf["key"], ids_np):
+            return None            # stream reordered: rows are another batch's
+        if client is not None and inf["epoch"] != client.epoch:
+            return None            # re-formed mid-flight: rows are stale
+        return got
 
     def _make_step(self, ids_shape):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
@@ -477,13 +678,24 @@ class PSTrainStep:
         import ml_dtypes
         ids_np = _np.asarray(
             ids.numpy() if isinstance(ids, Tensor) else ids, _np.int64)
-        uniq, inv = _np.unique(ids_np.reshape(-1), return_inverse=True)
-        # bucket the unique count so signatures (and compiles) stay few;
-        # padded rows are never gathered by inv → their grads are zero
-        cap = max(256, 1 << int(_np.ceil(_np.log2(len(uniq)))))
-        uniq_p = _np.zeros((cap,), _np.int64)
-        uniq_p[:len(uniq)] = uniq
-        rows_u = self.embedding.table.pull(uniq_p)        # host gather
+        got = self._consume_prefetch(ids_np)
+        pipelined = got is not None
+        if got is None:
+            # synchronous path (no/failed prefetch): still coalesce a
+            # deferred push into the pull's round-trip when the table
+            # supports it, so the degraded pipeline keeps one RPC/step
+            uniq, inv, uniq_p = self._unique_prep(ids_np)
+            push = self._take_pending_push()
+            table = self.embedding.table
+            if push is not None and hasattr(table, "push_pull"):
+                rows_u = table.push_pull(push[0], push[1], uniq_p,
+                                         seq=push[2])
+            else:
+                if push is not None:
+                    self._replay_push(push)
+                rows_u = table.pull(uniq_p)               # host gather
+        else:
+            uniq, inv, uniq_p, rows_u = got
         if self.transfer_dtype in ("bfloat16", "bf16"):
             rows_u = rows_u.astype(ml_dtypes.bfloat16)
 
@@ -506,15 +718,42 @@ class PSTrainStep:
         new_params, self._opt_states, new_buffers, loss, drows_u = fn(
             params, self._opt_states, buffers, key, lr,
             jnp.asarray(rows_u), jnp.asarray(inv.astype(_np.int32)), *arrs)
+        # the chip is busy from here until the grad fetch below: issue
+        # the announced next batch's shard fan-out NOW so its pull (and
+        # the previous step's coalesced push) hides behind the device
+        # computation
+        self._issue_prefetch()
         for n, p in model.named_parameters():
             p._data = new_params[n]
         for n, b in model.named_buffers():
             if b is not None and n in new_buffers:
                 b._data = new_buffers[n]
-        # async host-side sparse update; overlaps the next device step
         grads_host = _np.asarray(drows_u)[:len(uniq)].astype(_np.float32)
-        self.embedding.communicator.push(uniq, grads_host)
+        if self.prefetch_depth > 0 and (pipelined or self._inflight
+                                        or self._announced):
+            # pipeline active: defer — the next issue (or the next
+            # synchronous pull, or flush) coalesces this push into one
+            # round-trip with a pull
+            self._pending_push.append((uniq, grads_host))
+        else:
+            # async host-side sparse update; overlaps the next device step
+            self.embedding.communicator.push(uniq, grads_host)
         return Tensor(loss)
 
     def flush(self):
+        # drain the pipeline first: an in-flight prefetch may carry a
+        # coalesced push that has to land, and the deferred push of the
+        # last step is still pending
+        self._announced.clear()
+        while self._inflight:
+            self._settle_inflight(self._inflight.popleft())
+        while self._pending_push:
+            ids_p, g_p = self._pending_push.pop(0)
+            self.embedding.table.push(ids_p, g_p)
+        if self._prefetch_pool is not None:
+            # don't leak a 'ps-prefetch' thread per PSTrainStep instance
+            # (test suites and per-epoch rebuilds construct many); the
+            # pool is re-created lazily if prefetch() is used again
+            self._prefetch_pool.shutdown(wait=True)
+            self._prefetch_pool = None
         self.embedding.flush()

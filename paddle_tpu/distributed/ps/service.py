@@ -32,11 +32,13 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from paddle_tpu.distributed.ps import HostEmbeddingTable
-from paddle_tpu.framework import chaos
+from paddle_tpu.distributed.ps.device_table import (
+    WIRE_DTYPES, dequantize_rows, normalize_wire, quantize_rows)
+from paddle_tpu.framework import chaos, monitor
 from paddle_tpu.framework.flags import flag
 
 __all__ = ["PsServer", "PsClient", "RemoteEmbeddingTable",
-           "HeartBeatMonitor", "serve"]
+           "HeartBeatMonitor", "TransportStats", "serve"]
 
 
 # ---------------------------------------------------------------------------
@@ -55,7 +57,9 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
 
 
 def _send_msg(sock: socket.socket, header: dict,
-              bufs: Sequence[np.ndarray] = ()):
+              bufs: Sequence[np.ndarray] = ()) -> int:
+    """Frame + send; returns the bytes put on the wire (transport
+    accounting)."""
     meta = dict(header)
     meta["__bufs__"] = [{"shape": list(b.shape), "dtype": str(b.dtype)}
                         for b in bufs]
@@ -65,19 +69,93 @@ def _send_msg(sock: socket.socket, header: dict,
         data = np.ascontiguousarray(b).tobytes()
         out.append(struct.pack("<Q", len(data)))
         out.append(data)
-    sock.sendall(b"".join(out))
+    msg = b"".join(out)
+    sock.sendall(msg)
+    return len(msg)
 
 
 def _recv_msg(sock: socket.socket):
+    """Returns ``(header, bufs, wire_bytes)``."""
     (hlen,) = struct.unpack("<I", _recvall(sock, 4))
     header = json.loads(_recvall(sock, hlen))
+    nbytes = 4 + hlen
     bufs = []
     for spec in header.pop("__bufs__", []):
         (blen,) = struct.unpack("<Q", _recvall(sock, 8))
         raw = _recvall(sock, blen)
+        nbytes += 8 + blen
         bufs.append(np.frombuffer(raw, dtype=np.dtype(spec["dtype"]))
                     .reshape(spec["shape"]).copy())
-    return header, bufs
+    return header, bufs, nbytes
+
+
+class TransportStats:
+    """Measured transport counters for one PS peer (client or server):
+    RPC count, wire bytes each way, and a per-op latency histogram —
+    wired into the process-wide monitor registry (``ps_<role>_*`` stats
+    and histograms) so the observability layer sees every peer, while
+    each instance keeps its own numbers so e.g. bench.py can report the
+    *measured* wire MB/step of one client rather than the analytic
+    formula."""
+
+    # distinct op keys are capped: the op string arrives off the wire
+    # unvalidated, and a junk-sending peer must not grow per-op dicts
+    # and process-global histograms without bound on a long-lived shard
+    MAX_OPS = 32
+
+    def __init__(self, role: str = "client"):
+        self.role = role
+        self._lock = threading.Lock()
+        self.rpcs = 0
+        self.errors = 0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
+        self._per_op: Dict[str, Dict[str, int]] = {}
+        self._lat: Dict[str, monitor.Histogram] = {}
+
+    def record(self, op: str, sent: int, recv: int, seconds: float,
+               error: bool = False):
+        op = op or "?"
+        ms = seconds * 1e3
+        with self._lock:
+            # cap enforced under the lock; the last slot is reserved
+            # for the 'other' bucket so the bound holds exactly
+            if op != "other" and op not in self._per_op and \
+                    len(self._per_op) >= self.MAX_OPS - 1:
+                op = "other"
+            self.rpcs += 1
+            self.errors += int(error)
+            self.bytes_sent += sent
+            self.bytes_recv += recv
+            o = self._per_op.setdefault(
+                op, {"rpcs": 0, "errors": 0, "bytes_sent": 0,
+                     "bytes_recv": 0})
+            o["rpcs"] += 1
+            o["errors"] += int(error)
+            o["bytes_sent"] += sent
+            o["bytes_recv"] += recv
+            h = self._lat.get(op)
+            if h is None:
+                h = self._lat[op] = monitor.Histogram(
+                    f"ps_{self.role}_rpc_ms_{op}")
+        h.record(ms)
+        monitor.stat_add(f"ps_{self.role}_rpcs")
+        monitor.stat_add(f"ps_{self.role}_bytes_sent", sent)
+        monitor.stat_add(f"ps_{self.role}_bytes_recv", recv)
+        if error:
+            monitor.stat_add(f"ps_{self.role}_rpc_errors")
+        monitor.observe(f"ps_{self.role}_rpc_ms_{op}", ms)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"role": self.role, "rpcs": self.rpcs,
+                    "errors": self.errors,
+                    "bytes_sent": self.bytes_sent,
+                    "bytes_recv": self.bytes_recv,
+                    "per_op": {k: dict(v)
+                               for k, v in self._per_op.items()},
+                    "latency_ms": {k: h.summary()
+                                   for k, h in self._lat.items()}}
 
 
 # ---------------------------------------------------------------------------
@@ -173,17 +251,22 @@ class _Handler(socketserver.BaseRequestHandler):
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         while True:
             try:
-                header, bufs = _recv_msg(sock)
+                header, bufs, n_in = _recv_msg(sock)
             except (ConnectionError, OSError):
                 return
+            t0 = time.perf_counter()
+            ok = True
             try:
                 reply, rbufs = srv._dispatch(header, bufs)
+                ok = reply.get("ok", False)
             except Exception as e:                # noqa: BLE001
-                reply, rbufs = {"ok": False, "error": repr(e)}, []
+                reply, rbufs, ok = {"ok": False, "error": repr(e)}, [], False
             try:
-                _send_msg(sock, reply, rbufs)
+                n_out = _send_msg(sock, reply, rbufs)
             except OSError:
                 return
+            srv.transport.record(header.get("op"), n_out, n_in,
+                                 time.perf_counter() - t0, error=not ok)
             if header.get("op") in ("bye", "shutdown"):
                 return
 
@@ -197,6 +280,10 @@ class PsServer:
     """One PS shard: serves pull/push/heartbeat/state for its tables
     (brpc_ps_server.cc handler table, minus the brpc dependency)."""
 
+    # remembered (worker, seq) stamps per worker — enough to absorb any
+    # realistic retry window while bounding memory for long jobs
+    PUSH_SEQ_WINDOW = 4096
+
     def __init__(self, tables: Dict[str, HostEmbeddingTable],
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_timeout: float = 30.0,
@@ -207,13 +294,76 @@ class PsServer:
         self.epoch = 0                 # membership-epoch fence (elastic)
         self._bye_count = 0
         self._lock = threading.Lock()
+        self.transport = TransportStats(role="server")
+        # push dedup: worker -> insertion-ordered {seq: True} window
+        self._push_seen: Dict[str, "dict"] = {}
+        self._seen_lock = threading.Lock()
         self._tcp = _TcpServer((host, port), _Handler)
         self._tcp.ps = self                        # type: ignore
         self.host, self.port = self._tcp.server_address
         self._thread: Optional[threading.Thread] = None
 
     # -- request dispatch ---------------------------------------------------
-    _FENCED_OPS = ("push", "load_state")
+    _FENCED_OPS = ("push", "push_pull", "load_state")
+
+    # remembered worker identities are bounded too: elastic churn mints
+    # a fresh worker id per restart, and a shard must not grow a dedup
+    # window per dead worker forever
+    PUSH_SEQ_WORKERS = 256
+
+    def _reserve_push(self, header: dict) -> bool:
+        """Atomically claim this (worker, seq) stamp — the retried-push
+        double-apply guard.  Returns False when the stamp is already
+        claimed: either the push was applied, or another handler thread
+        is applying it RIGHT NOW (a retry racing a slow apply must not
+        land a second copy).  A FAILED apply rolls its claim back via
+        :meth:`_unreserve_push` so a later retry still lands.
+        Unstamped pushes (old clients) always pass."""
+        worker, seq = header.get("worker"), header.get("seq")
+        if worker is None or seq is None:
+            return True
+        with self._seen_lock:
+            # re-insert → LRU order, so the worker-count cap below
+            # evicts the longest-quiet identity, not an active one
+            seen = self._push_seen.pop(worker, None)
+            if seen is None:
+                seen = {}
+            self._push_seen[worker] = seen
+            if seq in seen:
+                return False
+            seen[seq] = True
+            while len(seen) > self.PUSH_SEQ_WINDOW:
+                seen.pop(next(iter(seen)))
+            while len(self._push_seen) > self.PUSH_SEQ_WORKERS:
+                self._push_seen.pop(next(iter(self._push_seen)))
+        return True
+
+    def _unreserve_push(self, header: dict):
+        worker, seq = header.get("worker"), header.get("seq")
+        with self._seen_lock:
+            self._push_seen.get(worker, {}).pop(seq, None)
+
+    def _is_dup_push(self, header: dict) -> bool:
+        """Peek: stamp already claimed? (Test/introspection surface —
+        the apply path uses the atomic reserve/unreserve pair.)"""
+        worker, seq = header.get("worker"), header.get("seq")
+        with self._seen_lock:
+            return seq is not None and \
+                seq in self._push_seen.get(worker, ())
+
+    def _apply_push(self, header: dict, ids: np.ndarray, grad_bufs):
+        """Dedup-guarded push: decode the (possibly quantized) gradient
+        rows and apply them, unless the stamp was already claimed."""
+        if not self._reserve_push(header):
+            return True
+        try:
+            t = self.tables[header["table"]]
+            grads = dequantize_rows(grad_bufs, header.get("wire", "f32"))
+            t.push(ids.astype(np.int64), grads, lr=header.get("lr"))
+        except Exception:
+            self._unreserve_push(header)   # failed apply frees the stamp
+            raise
+        return False
 
     def _dispatch(self, header: dict, bufs):
         op = header.get("op")
@@ -253,14 +403,44 @@ class PsServer:
                 self.epoch = max(self.epoch, e)
             return {"ok": True, "epoch": self.epoch,
                     "n_workers": self.n_workers}, []
+        if op == "hello":
+            # wire-dtype handshake: echo the negotiated encoding.  An
+            # OLD server never reaches here (unknown op -> error), which
+            # the client reads as "f32 only" — old/new peers always
+            # interoperate at exact-parity f32.
+            try:
+                wire = normalize_wire(header.get("wire", "f32"))
+            except ValueError:
+                wire = "f32"
+            return {"ok": True, "wire": wire,
+                    "wire_dtypes": list(WIRE_DTYPES)}, []
         if op == "pull":
             t = self.tables[header["table"]]
-            return {"ok": True}, [t.pull(bufs[0].astype(np.int64))]
+            rows = t.pull(bufs[0].astype(np.int64))
+            # reply-driven negotiation: encode in the dtype the request
+            # asked for and DECLARE it in the reply header; a client
+            # talking to an old server sees no "wire" key and decodes
+            # f32 — no separate handshake needed on the pull side
+            wire = normalize_wire(header.get("wire", "f32"))
+            return {"ok": True, "wire": wire}, quantize_rows(rows, wire)
         if op == "push":
+            dup = self._apply_push(header, bufs[0], bufs[1:])
+            return {"ok": True, "dup": dup}, []
+        if op == "push_pull":
+            # one round-trip for the pipeline's coalesced cycle: apply
+            # the previous step's gradient rows (dedup-guarded — a
+            # retry must not double-apply), then serve the next step's
+            # pull.  The pull half is idempotent, so a retried
+            # push_pull whose push was deduped still returns rows.
+            n_push = int(header.get("n_push_bufs", 0))
+            dup = False
+            if n_push:
+                dup = self._apply_push(header, bufs[0], bufs[1:1 + n_push])
             t = self.tables[header["table"]]
-            t.push(bufs[0].astype(np.int64), bufs[1].astype(np.float32),
-                   lr=header.get("lr"))
-            return {"ok": True}, []
+            rows = t.pull(bufs[1 + n_push].astype(np.int64))
+            wire = normalize_wire(header.get("wire", "f32"))
+            return {"ok": True, "wire": wire,
+                    "dup": dup}, quantize_rows(rows, wire)
         if op == "graph":
             # GNN tier: delegate to GraphTable.dispatch (graph_brpc_server
             # sample_neighbors / node_feat / degree ops)
@@ -293,6 +473,8 @@ class PsServer:
                     "dead": self.monitor.dead_workers(),
                     "flaps": {w: self.monitor.flap_count(w)
                               for w in self.monitor.workers()},
+                    "wire_dtypes": list(WIRE_DTYPES),
+                    "transport": self.transport.snapshot(),
                     "epoch": self.epoch}, []
         if op == "bye":
             # a fenced job counts only CURRENT-epoch byes toward the
@@ -344,12 +526,14 @@ class PsServer:
 # ---------------------------------------------------------------------------
 
 class _Conn:
-    def __init__(self, endpoint: str, timeout: Optional[float] = None):
+    def __init__(self, endpoint: str, timeout: Optional[float] = None,
+                 stats: Optional[TransportStats] = None):
         self.endpoint = endpoint
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self.timeout = float(flag("ps_rpc_timeout")) if timeout is None \
             else timeout
+        self.stats = stats
         self.lock = threading.Lock()
         # first dial is best-effort: a client may legitimately be built
         # over a server set containing dead peers (elastic re-shard
@@ -368,27 +552,41 @@ class _Conn:
     def rpc(self, header: dict, bufs=()):
         # injected drops/latency fire BEFORE the send (and before the
         # lock), so a retried call cannot double-apply a non-idempotent
-        # push and an injected drop never desyncs a healthy socket
-        chaos.fault_point("ps.rpc",  # pta: disable=PTA301 (PsClient.call owns retry/backoff + mark_dead)
-                          meta={"op": header.get("op"),
-                                "endpoint": self.endpoint})
-        with self.lock:
-            if self.sock is None:
-                self.sock = self._connect()    # lazy redial after failure
-            try:
-                _send_msg(self.sock, header, bufs)
-                reply, rbufs = _recv_msg(self.sock)
-            except (ConnectionError, OSError):
-                # the stream may be mid-message: invalidate UNDER the
-                # lock so no concurrent caller (e.g. the heartbeat
-                # thread vs a pull fan-out) can ever read a stale
-                # partial reply as its own
+        # push and an injected drop never desyncs a healthy socket.
+        # The timing window opens here too: an injected latency is a
+        # slow network, and the histograms should say so.
+        t0 = time.perf_counter()
+        sent = rcvd = 0
+        try:
+            chaos.fault_point("ps.rpc",  # pta: disable=PTA301 (PsClient.call owns retry/backoff + mark_dead)
+                              meta={"op": header.get("op"),
+                                    "endpoint": self.endpoint})
+            with self.lock:
+                if self.sock is None:
+                    self.sock = self._connect()  # lazy redial after failure
                 try:
-                    self.sock.close()
-                except OSError:
-                    pass
-                self.sock = None
-                raise
+                    sent = _send_msg(self.sock, header, bufs)
+                    reply, rbufs, rcvd = _recv_msg(self.sock)
+                except (ConnectionError, OSError):
+                    # the stream may be mid-message: invalidate UNDER the
+                    # lock so no concurrent caller (e.g. the heartbeat
+                    # thread vs a pull fan-out) can ever read a stale
+                    # partial reply as its own
+                    try:
+                        self.sock.close()
+                    except OSError:
+                        pass
+                    self.sock = None
+                    raise
+        except (ConnectionError, OSError):
+            if self.stats is not None:
+                self.stats.record(header.get("op"), sent, rcvd,
+                                  time.perf_counter() - t0, error=True)
+            raise
+        if self.stats is not None:
+            self.stats.record(header.get("op"), sent, rcvd,
+                              time.perf_counter() - t0,
+                              error=not reply.get("ok", False))
         if not reply.get("ok", False):
             raise RuntimeError(f"ps rpc {header.get('op')} failed: "
                                f"{reply.get('error')}")
@@ -420,19 +618,34 @@ class PsClient:
 
     Retry idempotence: a retry re-sends only when the previous attempt
     failed before a reply was read.  ``pull`` is idempotent anyway; a
-    ``push`` whose reply was lost AFTER the server applied it would
-    double-apply on retry — the in-tree injection fires before the send
-    precisely so the chaos suite proves the common (request-lost) case
-    exactly."""
+    ``push`` whose reply was lost after the server started (or
+    finished) applying it is caught by the server's ``(worker, seq)``
+    stamp reservation — every push (and the push half of
+    ``push_pull``) carries a monotonically increasing sequence number,
+    the retry re-sends the SAME stamp, and the server atomically
+    claims a stamp before applying (so a retry racing a still-running
+    apply is also rejected); only a FAILED apply rolls the claim back
+    so that retry can land.
+
+    Wire dtype: pull replies and push gradient rows travel in
+    ``wire_dtype`` (FLAGS_ps_wire_dtype; 'bf16' default, 'int8' adds a
+    per-row scale, 'f32' is the exact-parity fallback).  Pulls are
+    reply-driven (the server declares the encoding it used), pushes
+    quantize only after a ``hello`` handshake confirmed the server
+    understands the dtype — so an old f32-only peer on either side
+    degrades the link to f32 instead of corrupting it."""
 
     def __init__(self, endpoints: Sequence[str],
                  worker_id: Optional[str] = None,
                  monitor: Optional[HeartBeatMonitor] = None,
                  max_retries: Optional[int] = None,
                  backoff_base: Optional[float] = None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 wire_dtype: Optional[str] = None):
+        self.transport = TransportStats(role="client")
         self.endpoints = list(endpoints)
-        self._conns = [_Conn(ep, timeout=timeout) for ep in self.endpoints]
+        self._conns = [_Conn(ep, timeout=timeout, stats=self.transport)
+                       for ep in self.endpoints]
         self._pool = ThreadPoolExecutor(max_workers=max(
             2, len(self.endpoints)))
         self.worker_id = worker_id or f"worker-{os.getpid()}"
@@ -442,6 +655,18 @@ class PsClient:
             if max_retries is None else int(max_retries)
         self.backoff_base = float(flag("ps_rpc_backoff_base")) \
             if backoff_base is None else float(backoff_base)
+        self.wire_dtype = normalize_wire(
+            flag("ps_wire_dtype") if wire_dtype is None else wire_dtype)
+        self._push_wires: Dict[int, str] = {}  # negotiated, per server
+        self._dims: Dict[str, int] = {}        # table dim cache
+        # dedup stamps are scoped to this client INCARNATION, not the
+        # worker id: a re-built client (elastic re-form, restart under
+        # the same rank/pid) restarts _seq at 0, and colliding with the
+        # previous incarnation's window on a surviving server would
+        # silently drop its first pushes as duplicates
+        self._push_ident = f"{self.worker_id}~{os.urandom(4).hex()}"
+        self._seq = 0
+        self._seq_lock = threading.Lock()
         self.dead_endpoints: List[str] = []
         self._dead_lock = threading.Lock()
         self.on_endpoint_dead = None       # callback(endpoint, exception)
@@ -491,7 +716,49 @@ class PsClient:
         if self.on_endpoint_dead is not None:
             self.on_endpoint_dead(endpoint, exc)
 
+    # -- wire dtype negotiation / push stamping -----------------------------
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def _push_wire(self, s: int) -> str:
+        """Negotiated dtype for rows this client SENDS to server ``s``
+        (push gradients).  Resolved once per server via the ``hello``
+        handshake; an old server that doesn't know the op pins the link
+        to f32.  (Pulls need no handshake — the reply header declares
+        its own encoding.)"""
+        w = self._push_wires.get(s)
+        if w is None:
+            if self.wire_dtype == "f32":
+                w = "f32"
+            else:
+                try:
+                    reply, _ = self._rpc(
+                        s, {"op": "hello", "wire": self.wire_dtype})
+                    w = reply.get("wire", "f32") \
+                        if self.wire_dtype in reply.get("wire_dtypes", ()) \
+                        else "f32"
+                except RuntimeError:       # old server: unknown op
+                    w = "f32"
+            self._push_wires[s] = w
+        return w
+
+    def _decode_pull(self, table: str, reply: dict, rbufs) -> np.ndarray:
+        rows = dequantize_rows(rbufs, reply.get("wire", "f32"))
+        self._dims[table] = rows.shape[-1]
+        return rows
+
     # -- sparse ops ---------------------------------------------------------
+    def table_dim(self, table: str) -> int:
+        """Row dim of ``table``, cached after the first pull/stat — the
+        empty-batch pull path must not burn a whole stat() RPC per call
+        just to re-learn a constant."""
+        dim = self._dims.get(table)
+        if dim is None:
+            dim = self._dims[table] = self.stat()["tables"][table]["dim"]
+        return dim
+
     def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, np.int64)
         flat = ids.reshape(-1)
@@ -501,9 +768,10 @@ class PsClient:
             mask = owner == s
             if not mask.any():
                 return s, mask, None
-            _, rows = self._rpc(
-                s, {"op": "pull", "table": table}, [flat[mask]])
-            return s, mask, rows[0]
+            reply, rows = self._rpc(
+                s, {"op": "pull", "table": table,
+                    "wire": self.wire_dtype}, [flat[mask]])
+            return s, mask, self._decode_pull(table, reply, rows)
 
         first_dim = None
         parts = list(self._pool.map(one, range(self.n)))
@@ -511,8 +779,8 @@ class PsClient:
             if rows is not None:
                 first_dim = rows.shape[1]
                 break
-        if first_dim is None:      # empty batch: ask a server for the dim
-            first_dim = self.stat()["tables"][table]["dim"]
+        if first_dim is None:      # empty batch: cached table dim
+            first_dim = self.table_dim(table)
         out = np.empty((flat.size, first_dim), np.float32)
         for _, mask, rows in parts:
             if rows is not None:
@@ -520,19 +788,82 @@ class PsClient:
         return out.reshape(ids.shape + (first_dim,))
 
     def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
-             lr: Optional[float] = None):
+             lr: Optional[float] = None, seq: Optional[int] = None):
+        """``seq`` reuses a previously allocated stamp — the REPLAY path
+        of a coalesced push whose first attempt may or may not have
+        landed; the server's dedup then absorbs the copy that did.  A
+        fresh stamp is minted when None (the normal case)."""
         ids = np.asarray(ids, np.int64)
         flat = ids.reshape(-1)
         g = np.asarray(grads, np.float32).reshape(flat.size, -1)
         owner = flat % self.n
+        seq = self._next_seq() if seq is None else seq
 
         def one(s):
             mask = owner == s
             if mask.any():
-                self._rpc(s, {"op": "push", "table": table,
-                              "lr": lr}, [flat[mask], g[mask]])
+                wire = self._push_wire(s)
+                self._rpc(s, {"op": "push", "table": table, "lr": lr,
+                              "wire": wire, "worker": self._push_ident,
+                              "seq": seq},
+                          [flat[mask]] + quantize_rows(g[mask], wire))
 
         list(self._pool.map(one, range(self.n)))
+
+    def push_pull(self, table: str, push_ids: Optional[np.ndarray],
+                  push_grads: Optional[np.ndarray],
+                  pull_ids: np.ndarray,
+                  lr: Optional[float] = None,
+                  seq: Optional[int] = None) -> np.ndarray:
+        """Coalesced cycle: apply one batch's gradient rows AND fetch the
+        next batch's rows in a single round-trip per shard (the
+        DownpourWorker amortization — push(N) rides pull(N+1)'s RPC).
+        ``push_ids``/``push_grads`` may be None for a pull-only call;
+        ``seq`` as in :meth:`push`.  Returns the rows for ``pull_ids``."""
+        pull_ids = np.asarray(pull_ids, np.int64)
+        pflat = pull_ids.reshape(-1)
+        powner = pflat % self.n
+        if push_ids is None or len(np.asarray(push_ids)) == 0:
+            return self.pull(table, pull_ids)
+        gids = np.asarray(push_ids, np.int64).reshape(-1)
+        g = np.asarray(push_grads, np.float32).reshape(gids.size, -1)
+        gowner = gids % self.n
+        seq = self._next_seq() if seq is None else seq
+
+        def one(s):
+            pmask = powner == s
+            gmask = gowner == s
+            if not pmask.any() and not gmask.any():
+                return s, pmask, None
+            if not pmask.any():            # push-only shard
+                wire = self._push_wire(s)
+                self._rpc(s, {"op": "push", "table": table, "lr": lr,
+                              "wire": wire, "worker": self._push_ident,
+                              "seq": seq},
+                          [gids[gmask]] + quantize_rows(g[gmask], wire))
+                return s, pmask, None
+            wire = self._push_wire(s)
+            payload = quantize_rows(g[gmask], wire) if gmask.any() else []
+            reply, rows = self._rpc(
+                s, {"op": "push_pull", "table": table, "lr": lr,
+                    "wire": wire, "worker": self._push_ident, "seq": seq,
+                    "n_push_bufs": len(payload)},
+                [gids[gmask]] + payload + [pflat[pmask]])
+            return s, pmask, self._decode_pull(table, reply, rows)
+
+        first_dim = None
+        parts = list(self._pool.map(one, range(self.n)))
+        for _, _, rows in parts:
+            if rows is not None:
+                first_dim = rows.shape[1]
+                break
+        if first_dim is None:
+            first_dim = self.table_dim(table)
+        out = np.empty((pflat.size, first_dim), np.float32)
+        for _, mask, rows in parts:
+            if rows is not None:
+                out[mask] = rows
+        return out.reshape(pull_ids.shape + (first_dim,))
 
     # -- liveness -----------------------------------------------------------
     def heartbeat(self):
@@ -563,8 +894,21 @@ class PsClient:
 
     # -- admin --------------------------------------------------------------
     def stat(self, server: int = 0):
+        """Server stat reply (tables, workers, epoch, and — from a
+        current-generation server — its measured transport counters),
+        augmented with this client's own ``client_transport`` snapshot
+        so one call surfaces both ends of the link."""
         reply, _ = self._rpc(server, {"op": "stat"})
+        for name, t in reply.get("tables", {}).items():
+            if t.get("dim"):
+                self._dims[name] = t["dim"]
+        reply["client_transport"] = self.transport.snapshot()
         return reply
+
+    def transport_stats(self) -> dict:
+        """Measured client-side transport counters: RPC count, wire
+        bytes each way, per-op split, latency histograms."""
+        return self.transport.snapshot()
 
     def set_epoch(self, epoch: int, fence_servers: bool = False,
                   n_workers: Optional[int] = None):
@@ -621,8 +965,17 @@ class RemoteEmbeddingTable:
         return self.client.pull(self.table, ids)
 
     def push(self, ids: np.ndarray, grads: np.ndarray,
-             lr: Optional[float] = None):
-        self.client.push(self.table, ids, grads, lr=lr)
+             lr: Optional[float] = None, seq: Optional[int] = None):
+        self.client.push(self.table, ids, grads, lr=lr, seq=seq)
+
+    def push_pull(self, push_ids, push_grads, pull_ids,
+                  lr: Optional[float] = None,
+                  seq: Optional[int] = None) -> np.ndarray:
+        """Coalesced push+pull in one RPC round-trip per shard — the
+        hook PSTrainStep's prefetch pipeline rides (duck-typed: tables
+        without it get a separate push then pull)."""
+        return self.client.push_pull(self.table, push_ids, push_grads,
+                                     pull_ids, lr=lr, seq=seq)
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,13 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
 =====================  ====================================================
 ``ps.rpc``              client side of every PS RPC (ps/service.py
                         _Conn.rpc)
+``ps.pipeline``         each background prefetch task of PSTrainStep's
+                        pull/compute overlap pipeline (ps/__init__.py
+                        _issue_prefetch) — ``mode="error"`` is a failed
+                        prefetch (the step must fall back to a
+                        synchronous pull and replay the coalesced
+                        push), ``mode="latency"`` a slow one the
+                        consume path must simply wait out
 ``fs.write``            crash-safe file writes (fleet/utils/fs.py
                         atomic_write)
 ``ckpt.save``           per-file checkpoint writes (distributed/
@@ -64,8 +71,9 @@ __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
            "register_fault_point", "known_fault_points",
            "payload_fault_points"]
 
-FAULT_POINTS = ("ps.rpc", "fs.write", "ckpt.save", "download.fetch",
-                "train.step_grads", "elastic.lease", "elastic.worker_hang")
+FAULT_POINTS = ("ps.rpc", "ps.pipeline", "fs.write", "ckpt.save",
+                "download.fetch", "train.step_grads", "elastic.lease",
+                "elastic.worker_hang")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

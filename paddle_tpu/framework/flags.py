@@ -106,3 +106,21 @@ define_flag("download_retries", 3,
             "fetch attempts in utils.download before giving up")
 define_flag("download_backoff_base", 0.1,
             "exponential backoff base (s) between download fetch retries")
+
+# PS transport tier (ps/service.py wire format + PSTrainStep pipeline):
+define_flag("ps_wire_dtype", "bf16",
+            "wire encoding for PS pull rows / push grads: 'bf16' "
+            "(default, half the f32 bytes, ~3 significant digits), "
+            "'int8' (quarter the bytes, per-row scale), or 'f32' "
+            "(exact-parity fallback).  Negotiated per peer: pulls "
+            "decode whatever the reply header declares, pushes "
+            "quantize only after a hello handshake confirms the "
+            "server understands the dtype — old/new peers always "
+            "interoperate at f32")
+define_flag("ps_prefetch_depth", 1,
+            "max in-flight prefetched pulls in PSTrainStep's pipeline "
+            "(PSTrainStep.prefetch): 0 disables the pipeline, 1 is the "
+            "classic double buffer — the next batch's shard fan-out "
+            "rides a background executor while the chip runs the "
+            "current step, coalesced with the previous step's push "
+            "into one RPC round-trip per shard")

@@ -70,6 +70,92 @@ class StatRegistry:
                 s.reset()
 
 
+class Histogram:
+    """Fixed-bucket latency/size histogram (the role of brpc's bvar
+    LatencyRecorder, reduced to what the PS transport counters need):
+    exponential bucket bounds, exact count/sum/max, and interpolated
+    percentiles good enough for p50/p95/p99 dashboards.  Thread-safe."""
+
+    # ~exponential bounds; unit-agnostic (the PS transport records ms)
+    BOUNDS = (0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
+              200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0)
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._counts = [0] * (len(self.BOUNDS) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, value: Number):
+        v = float(value)
+        i = 0
+        for b in self.BOUNDS:
+            if v <= b:
+                break
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum += v
+            if v > self.max:
+                self.max = v
+
+    def percentile(self, p: float) -> float:
+        """Upper bucket bound holding the p-quantile (0 with no data;
+        ``max`` for the overflow bucket — honest about saturation)."""
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = p * self.count
+            seen = 0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= target:
+                    return (self.BOUNDS[i] if i < len(self.BOUNDS)
+                            else self.max)
+            return self.max
+
+    def summary(self) -> Dict[str, Number]:
+        with self._lock:
+            count, total, mx = self.count, self.sum, self.max
+        return {"count": count, "sum": round(total, 3),
+                "mean": round(total / count, 4) if count else 0.0,
+                "p50": self.percentile(0.50),
+                "p95": self.percentile(0.95),
+                "p99": self.percentile(0.99), "max": round(mx, 3)}
+
+
+_hists: Dict[str, Histogram] = {}
+_hist_lock = threading.Lock()
+
+
+def get_histogram(name: str) -> Histogram:
+    with _hist_lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = Histogram(name)
+        return h
+
+
+def observe(name: str, value: Number):
+    """Record one observation into the named histogram (histogram
+    sibling of :func:`stat_add`)."""
+    get_histogram(name).record(value)
+
+
+def all_histograms() -> Dict[str, Dict[str, Number]]:
+    with _hist_lock:
+        hs = list(_hists.values())
+    return {h.name: h.summary() for h in hs}
+
+
+def reset_all_histograms():
+    with _hist_lock:
+        _hists.clear()
+
+
 def stat_add(name: str, value: Number = 1):
     """STAT_ADD / STAT_INT_ADD / STAT_FLOAT_ADD (monitor.h:135,140)."""
     StatRegistry.instance().get(name).increase(value)

@@ -127,6 +127,9 @@ def _ps_pair(n_rows=32, dim=4, **client_kw):
     t = HostEmbeddingTable(n_rows, dim, optimizer="sgd", learning_rate=1.0)
     srv = PsServer({"emb": t}, port=0)
     srv.start()
+    # f32 wire: the retry-parity assertions here are byte-exact (the
+    # quantized wire's tolerance parity lives in test_ps_transport.py)
+    client_kw.setdefault("wire_dtype", "f32")
     c = PsClient([f"127.0.0.1:{srv.port}"], backoff_base=0.01, **client_kw)
     return t, srv, c
 

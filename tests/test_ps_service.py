@@ -32,7 +32,9 @@ class TestProtocolAndRouting:
         t = HostEmbeddingTable(10, 4, optimizer="sgd", learning_rate=1.0)
         srv = _server({"emb": t})
         try:
-            c = PsClient([f"127.0.0.1:{srv.port}"])
+            # wire pinned to f32: this test asserts EXACT row parity
+            # (the bf16 default is covered by test_ps_transport.py)
+            c = PsClient([f"127.0.0.1:{srv.port}"], wire_dtype="f32")
             ids = np.array([[1, 2], [3, 1]])
             rows = c.pull("emb", ids)
             np.testing.assert_allclose(rows, t._table[ids], rtol=1e-6)
@@ -55,7 +57,8 @@ class TestProtocolAndRouting:
         t1 = HostEmbeddingTable(10, 3, optimizer="sgd", seed=2)
         s0, s1 = _server({"emb": t0}), _server({"emb": t1})
         try:
-            c = PsClient([f"127.0.0.1:{s0.port}", f"127.0.0.1:{s1.port}"])
+            c = PsClient([f"127.0.0.1:{s0.port}", f"127.0.0.1:{s1.port}"],
+                         wire_dtype="f32")      # exact-parity assertions
             ids = np.array([0, 1, 2, 3, 7])
             rows = c.pull("emb", ids)
             for i, idx in enumerate(ids):
@@ -156,7 +159,9 @@ class TestRemoteEmbeddingParity:
         srv = _server({"emb": HostEmbeddingTable(
             20, 4, optimizer="sgd", learning_rate=0.5, seed=0)})
         try:
-            client = PsClient([f"127.0.0.1:{srv.port}"])
+            # f32 wire: this test pins the EXACT local trajectory; the
+            # quantized wire's tolerance parity lives in test_ps_transport
+            client = PsClient([f"127.0.0.1:{srv.port}"], wire_dtype="f32")
             paddle.seed(0)
             remote = DistributedEmbedding(
                 20, 4, table=RemoteEmbeddingTable(client, "emb", 4))
@@ -261,7 +266,8 @@ class TestTwoProcess:
                     losses.append(float(loss))
                 return losses
 
-            client = PsClient([endpoint], worker_id="trainer-0")
+            client = PsClient([endpoint], worker_id="trainer-0",
+                              wire_dtype="f32")   # exact loss parity
             remote_losses = run(lambda: DistributedEmbedding(
                 50, 4, table=RemoteEmbeddingTable(client, "emb", 4)))
             local_losses = run(lambda: DistributedEmbedding(

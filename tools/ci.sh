@@ -41,10 +41,19 @@ echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # promote with --strict once the corpus has been warning-clean a while)
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
+    --zoo ps_transport \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="
 JAX_PLATFORMS=cpu python tools/print_signatures.py --check
+
+echo "== PS transport byte gate (measured wire MB per op, host-side) =="
+# deterministic byte counts per wire dtype — holds the line on
+# transport bytes (a change that silently fattens the wire fails here);
+# localhost wall-clock is reported but NOT gated
+JAX_PLATFORMS=cpu python tools/op_bench.py --ps-transport \
+    --compare tools/op_bench_baseline.json \
+    --thresholds tools/op_bench_thresholds.json
 
 if [ -f tools/op_bench_baseline.json ]; then
   echo "== op benchmark regression gate =="

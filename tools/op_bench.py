@@ -55,6 +55,72 @@ BUILTIN_SUITE = [
 ]
 
 
+# PS transport microbench suite (--ps-transport): an in-process PsServer
+# + PsClient over localhost TCP, per wire dtype.  ``wire_mb`` (measured
+# bytes on the wire per op, from the client's TransportStats) is the
+# gated metric — byte counts are deterministic, so the compare gate can
+# hold the line on transport bytes with a tight threshold while the
+# wall-clock ms stays informational (localhost TCP timing is too noisy
+# to gate).  Names here are registered with the compare gate's key
+# validation like the builtin ops.
+PS_TRANSPORT_SUITE = [
+    {"name": "ps_pull_8kx64_f32", "kind": "pull", "wire": "f32"},
+    {"name": "ps_pull_8kx64_bf16", "kind": "pull", "wire": "bf16"},
+    {"name": "ps_pull_8kx64_int8", "kind": "pull", "wire": "int8"},
+    {"name": "ps_push_8kx64_f32", "kind": "push", "wire": "f32"},
+    {"name": "ps_push_8kx64_bf16", "kind": "push", "wire": "bf16"},
+    {"name": "ps_push_pull_8kx64_bf16", "kind": "push_pull",
+     "wire": "bf16"},
+]
+
+
+def ps_transport_bench(repeats=3):
+    """Measure wire bytes + round-trip time for each PS_TRANSPORT_SUITE
+    entry against an in-process server.  Device-independent (host numpy
+    + TCP), so records carry device 'host' and gate everywhere."""
+    from paddle_tpu.distributed.ps import HostEmbeddingTable
+    from paddle_tpu.distributed.ps.service import PsClient, PsServer
+
+    n_ids, dim, rows = 8192, 64, 65536
+    srv = PsServer({"emb": HostEmbeddingTable(
+        rows, dim, optimizer="sgd", learning_rate=0.0)}, port=0)
+    srv.start()
+    results = []
+    try:
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, rows, size=(n_ids,)).astype(np.int64)
+        grads = rng.standard_normal((n_ids, dim)).astype(np.float32)
+        for cfg in PS_TRANSPORT_SUITE:
+            c = PsClient([f"127.0.0.1:{srv.port}"], wire_dtype=cfg["wire"])
+            ops = {
+                "pull": lambda: c.pull("emb", ids),
+                "push": lambda: c.push("emb", ids, grads),
+                "push_pull": lambda: c.push_pull("emb", ids, grads, ids),
+            }
+            run = ops[cfg["kind"]]
+            run()                            # warm (incl. hello handshake)
+            best = None
+            s0 = c.transport_stats()
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                run()
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            s1 = c.transport_stats()
+            wire_mb = ((s1["bytes_sent"] - s0["bytes_sent"]) +
+                       (s1["bytes_recv"] - s0["bytes_recv"])) \
+                / repeats / 1e6
+            c.bye()
+            r = {"name": cfg["name"], "op": f"ps.{cfg['kind']}",
+                 "ms": round(best * 1e3, 3), "wire_mb": round(wire_mb, 5),
+                 "device": "host"}
+            results.append(r)
+            print(json.dumps(r), flush=True)
+    finally:
+        srv.shutdown()
+    return results
+
+
 def _resolve(path: str):
     mod, _, attr = path.rpartition(".")
     obj = importlib.import_module(mod)
@@ -485,6 +551,10 @@ def main(argv=None):
     ap.add_argument("--eager-transformer", action="store_true",
                     help="eager dispatch cache on a transformer block "
                          "+ hit-rate counters")
+    ap.add_argument("--ps-transport", action="store_true",
+                    help="PS wire microbench (pull/push/push_pull per "
+                         "wire dtype); gates on measured wire_mb, which "
+                         "is deterministic — ms is informational")
     ap.add_argument("--config", help="JSON list of op configs")
     ap.add_argument("--save", help="write results JSON here")
     ap.add_argument("--compare", help="baseline JSON to gate against")
@@ -522,18 +592,23 @@ def main(argv=None):
                 json.dump(rs, f, indent=1)
         return 0
 
-    suite = BUILTIN_SUITE
-    if a.config:
-        with open(a.config) as f:
-            suite = json.load(f)
-    results = []
-    for cfg in suite:
-        try:
-            r = run_one(cfg, iters=a.iters, repeats=a.repeats)
-        except Exception as e:               # noqa: BLE001
-            r = {"name": cfg.get("name", cfg.get("op")), "error": repr(e)}
-        results.append(r)
-        print(json.dumps(r), flush=True)
+    if a.ps_transport:
+        suite = PS_TRANSPORT_SUITE
+        results = ps_transport_bench(repeats=a.repeats)
+    else:
+        suite = BUILTIN_SUITE
+        if a.config:
+            with open(a.config) as f:
+                suite = json.load(f)
+        results = []
+        for cfg in suite:
+            try:
+                r = run_one(cfg, iters=a.iters, repeats=a.repeats)
+            except Exception as e:           # noqa: BLE001
+                r = {"name": cfg.get("name", cfg.get("op")),
+                     "error": repr(e)}
+            results.append(r)
+            print(json.dumps(r), flush=True)
 
     if a.save:
         with open(a.save, "w") as f:
@@ -541,7 +616,9 @@ def main(argv=None):
     if a.compare:
         with open(a.compare) as f:
             base = {r["name"]: r for r in json.load(f) if "ms" in r}
-        stale = [n for n, r in base.items() if "scan_len" not in r]
+        # transport entries gate on wire_mb (no scan estimator involved)
+        stale = [n for n, r in base.items()
+                 if "scan_len" not in r and "wire_mb" not in r]
         if stale:
             print(f"baseline {a.compare} predates the scan-difference "
                   f"estimator (entries without scan_len: {stale}); "
@@ -556,7 +633,8 @@ def main(argv=None):
         # thresholds file serves subset runs; baseline must cover every
         # op this run gates.
         suite_names = {c.get("name", c.get("op")) for c in suite}
-        known = suite_names | {c["name"] for c in BUILTIN_SUITE}
+        known = suite_names | {c["name"] for c in BUILTIN_SUITE} \
+            | {c["name"] for c in PS_TRANSPORT_SUITE}
         missing_base = sorted(suite_names - set(base))
         if missing_base:
             print(f"baseline {a.compare} has no entry for suite op(s): "
@@ -606,12 +684,25 @@ def main(argv=None):
                       file=sys.stderr)
                 continue
             thr = float(per_op.get(r["name"], a.threshold))
-            slowdown = r["ms"] / b["ms"] - 1.0
+            # transport records gate on measured wire bytes (exact,
+            # deterministic — "hold the line on transport bytes"); op
+            # timings gate on the scan-difference ms as before
+            if "wire_mb" in b and "wire_mb" in r:
+                metric, unit = "wire_mb", "MB"
+                if b["wire_mb"] <= 0:
+                    print(f"SKIP {r['name']}: baseline wire_mb "
+                          f"{b['wire_mb']!r} <= 0 — re-record",
+                          file=sys.stderr)
+                    continue
+            else:
+                metric, unit = "ms", "ms"
+            slowdown = r[metric] / b[metric] - 1.0
             if slowdown > thr:
-                failed.append((r["name"], b["ms"], r["ms"], slowdown, thr))
-        for name, bms, rms, s, thr in failed:
-            print(f"REGRESSION {name}: {bms}ms -> {rms}ms (+{s:.0%}, "
-                  f"allowed +{thr:.0%})", file=sys.stderr)
+                failed.append((r["name"], b[metric], r[metric], slowdown,
+                               thr, unit))
+        for name, bms, rms, s, thr, unit in failed:
+            print(f"REGRESSION {name}: {bms}{unit} -> {rms}{unit} "
+                  f"(+{s:.0%}, allowed +{thr:.0%})", file=sys.stderr)
         if failed:
             return 1
     return 0

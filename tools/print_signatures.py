@@ -52,6 +52,8 @@ MODULES = [
     "paddle_tpu.distribution",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.elastic",
+    "paddle_tpu.distributed.ps",
+    "paddle_tpu.distributed.ps.service",
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.vision",
     "paddle_tpu.vision.models",

@@ -47,6 +47,9 @@ ZOO = {
     # returns a finished Report (step trace + chaos-source lint), not a
     # (model, inputs) pair — see the Report branch in main()
     "elastic_step": lambda: _zoo_elastic_step(),
+    # lints the chaos-threaded PS transport sources (ps.rpc /
+    # ps.pipeline fault-point hygiene) — Report, like elastic_step
+    "ps_transport": lambda: _zoo_ps_transport(),
 }
 
 
@@ -114,6 +117,28 @@ def _zoo_elastic_step():
         np.zeros((4, 6), np.float32), np.zeros((4,), np.int64))
     for rel in (os.path.join("paddle_tpu", "distributed", "elastic.py"),
                 os.path.join("paddle_tpu", "framework", "resilient.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_ps_transport():
+    """AST-lint the PS transport tier — the sources threading the
+    ``ps.rpc`` and ``ps.pipeline`` chaos fault points (client retry
+    loop, prefetch pipeline, wire quantization helpers) — so PTA301/302
+    validate every transport fault-point site against the registry and
+    its retry-ownership pragmas."""
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for rel in (os.path.join("paddle_tpu", "distributed", "ps",
+                             "__init__.py"),
+                os.path.join("paddle_tpu", "distributed", "ps",
+                             "service.py"),
+                os.path.join("paddle_tpu", "distributed", "ps",
+                             "device_table.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:
