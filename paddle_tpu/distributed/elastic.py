@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from paddle_tpu.framework import chaos
+from paddle_tpu.framework.observability import flight
 
 __all__ = ["LeaseExpired", "Evicted", "RendezvousStore", "DictStore",
            "FileStore", "ElasticWorkerContext", "WorkerHandle",
@@ -316,6 +317,8 @@ class ElasticWorkerContext:
         # registering freshened the lease and progress record
         self._last_renew = self._last_beat = self.store.clock()
         self.lost_lease = False
+        flight.record("elastic.join", worker=self.worker_id,
+                      epoch=self.epoch)
         return self.epoch
 
     def step_done(self, step: int):
@@ -333,8 +336,10 @@ class ElasticWorkerContext:
             if now - self._last_renew >= self.renew_interval:
                 self.store.renew(self.worker_id)
                 self._last_renew = now
-        except (LeaseExpired, chaos.InjectedFault, OSError):
+        except (LeaseExpired, chaos.InjectedFault, OSError) as e:
             self.lost_lease = True
+            flight.record("elastic.lease_lost", severity="warn",
+                          worker=self.worker_id, step=step, exc=repr(e))
             raise
 
     def membership_changed(self) -> bool:
@@ -632,7 +637,16 @@ class ElasticAgent:
         self.events.extend(events)
         for ev in events:
             self.log(f"elastic-agent: {ev}")
+            flight.record("elastic." + ev[0],
+                          severity=self._EVENT_SEVERITY.get(ev[0], "info"),
+                          detail=list(ev[1:]), epoch=self.store.epoch())
         return events
+
+    _EVENT_SEVERITY = {
+        "crashed": "error", "failed": "error", "hang_killed": "error",
+        "lease_expired": "warn", "fenced": "warn", "shrunk": "warn",
+        "restart_scheduled": "warn",
+    }
 
     def _schedule_or_shrink(self, h: WorkerHandle, now: float,
                             events: List[tuple]):
@@ -751,6 +765,10 @@ def reform(store: RendezvousStore, role_maker, worker_id: str,
         # op, so a shrunk job's servers still shut down on the last bye
         ps_client.set_epoch(epoch, fence_servers=True,
                             n_workers=role_maker.worker_num())
+    flight.record("elastic.reform", worker=worker_id, epoch=epoch,
+                  rank=role_maker.worker_index(),
+                  world=role_maker.worker_num(),
+                  restored_step=restored_step)
     return epoch, role_maker.worker_index(), role_maker.worker_num(), \
         restored_step
 

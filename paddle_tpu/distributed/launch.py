@@ -157,6 +157,16 @@ def _supervise(children: List[_Child], elastic_retries: int = 0,
             c.terminate()
         sys.exit(1)
 
+    def _flight():
+        # lazy: the plain launcher path must not import the framework
+        # (and init a backend) while the job is healthy — the recorder
+        # is only needed once a child has already crashed
+        try:
+            from paddle_tpu.framework.observability import flight
+            return flight
+        except Exception:              # noqa: BLE001
+            return None
+
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
     pending: Dict[str, float] = {}        # name -> restart-at monotonic
@@ -183,6 +193,7 @@ def _supervise(children: List[_Child], elastic_retries: int = 0,
                               "reset", file=sys.stderr)
                         c.restarts = 0
                 elif rc != 0:
+                    fl = _flight()
                     if c.restarts < elastic_retries:
                         delay = min(restart_backoff * (2 ** c.restarts),
                                     backoff_cap)
@@ -190,6 +201,10 @@ def _supervise(children: List[_Child], elastic_retries: int = 0,
                               f"elastic restart "
                               f"{c.restarts + 1}/{elastic_retries} "
                               f"in {delay:.2f}s", file=sys.stderr)
+                        if fl is not None:
+                            fl.record("launch.restart_scheduled",
+                                      severity="warn", worker=c.name,
+                                      rc=rc, delay=delay)
                         pending[c.name] = now + delay  # restart() bumps
                                                        # c.restarts
                         alive = True
@@ -197,6 +212,27 @@ def _supervise(children: List[_Child], elastic_retries: int = 0,
                     print(f"launch: {c.name} exited with {rc}"
                           + (f", see {c.log_path}" if c.log_path else ""),
                           file=sys.stderr)
+                    if fl is not None:
+                        fl.record("launch.child_failed", severity="error",
+                                  worker=c.name, rc=rc, log=c.log_path)
+                        # post-mortem artifact: the supervisor's own view
+                        # of the failing child (exits, restarts, pacing)
+                        # next to its log; a log-less child (tests) has
+                        # no artifact directory and gets no dump.  When
+                        # the child's own crash handler already dumped
+                        # under flight_<name>.json (richer: the actual
+                        # fault trips/retries), keep it and write the
+                        # supervisor view beside it
+                        if c.log_path:
+                            d = os.path.dirname(c.log_path) or "."
+                            p = os.path.join(d, f"flight_{c.name}.json")
+                            if os.path.exists(p):
+                                p = os.path.join(
+                                    d, f"flight_{c.name}.supervisor.json")
+                            try:
+                                fl.dump(p, worker=c.name)
+                            except OSError:
+                                pass
                     for o in children:
                         if o is not c:
                             o.terminate()
@@ -279,7 +315,8 @@ def _launch_collective(args, ips) -> int:
         else endpoints[0],
     }
     name = f"trainer-{rank}"
-    env.update(_elastic_env(args, name))
+    env["PADDLE_TRACE_LABEL"] = name   # per-process span file when
+    env.update(_elastic_env(args, name))   # FLAGS_trace_dir is armed
     os.makedirs(args.log_dir, exist_ok=True)
     cmd = [sys.executable, args.training_script] + args.training_script_args
     child = _Child(name, cmd, env,
@@ -306,14 +343,16 @@ def _launch_ps(args) -> int:
         env = dict(common, TRAINING_ROLE="PSERVER",
                    PADDLE_PSERVER_ID=str(i),
                    PADDLE_PORT=str(args.start_port + i),
-                   POD_IP="127.0.0.1")
+                   POD_IP="127.0.0.1",
+                   PADDLE_TRACE_LABEL=f"server-{i}")
         children.append(_Child(
             f"server-{i}", cmd, env,
             os.path.join(args.log_dir, f"serverlog.{i}")))
     for i in range(n_w):
         env = dict(common, TRAINING_ROLE="TRAINER",
                    PADDLE_TRAINER_ID=str(i),
-                   PADDLE_CURRENT_ENDPOINT=worker_eps[i])
+                   PADDLE_CURRENT_ENDPOINT=worker_eps[i],
+                   PADDLE_TRACE_LABEL=f"trainer-{i}")
         env.update(_elastic_env(args, f"trainer-{i}"))
         children.append(_Child(
             f"trainer-{i}", cmd, env,

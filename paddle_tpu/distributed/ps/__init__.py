@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import Parameter, Tensor, apply1
+from paddle_tpu.framework import monitor
 from paddle_tpu.jit import not_to_static
 from paddle_tpu.distributed.ps.device_table import (
     DeviceEmbeddingTrainStep, MeshShardedEmbedding, mesh_sharded_lookup)
@@ -496,6 +497,20 @@ class PSTrainStep:
         self._pending_push: list = []
         self._prefetch_pool = None           # lazy ThreadPoolExecutor
 
+    def _tracer(self):
+        """The tracer this step's spans go to: the PS client's (so step
+        and RPC spans share one file/label) or the process default."""
+        from paddle_tpu.framework import observability
+        client = getattr(self.embedding.table, "client", None)
+        t = getattr(client, "tracer", None)
+        return t if t is not None else observability.tracer
+
+    @staticmethod
+    def _end_prefetch_span(inf, status, **attrs):
+        sp = inf.get("span")
+        if sp is not None:
+            sp.end(status=status, **attrs)
+
     # -- prefetch pipeline --------------------------------------------------
     @staticmethod
     def _unique_prep(ids_np):
@@ -521,22 +536,26 @@ class PSTrainStep:
         self._announced.append(_np.asarray(
             ids.numpy() if isinstance(ids, Tensor) else ids, _np.int64))
 
-    def _prefetch_task(self, table, ids_np, push):
+    def _prefetch_task(self, table, ids_np, push, span=None):
         """Background fan-out: unique the announced ids and run the
         coalesced push+pull round-trip (plain pull when no push is
-        pending or the table has no coalesced op)."""
+        pending or the table has no coalesced op).  Runs under the
+        prefetch span opened at issue time, so its RPCs parent to it."""
         from paddle_tpu.framework import chaos
-        chaos.fault_point("ps.pipeline",  # pta: disable=PTA301 (PSTrainStep._consume_prefetch owns fallback: sync re-pull + push replay)
-                          meta={"n_ids": int(ids_np.size),
-                                "coalesced_push": push is not None})
-        uniq, inv, uniq_p = self._unique_prep(ids_np)
-        if push is not None and hasattr(table, "push_pull"):
-            rows = table.push_pull(push[0], push[1], uniq_p, seq=push[2])
-        else:
-            if push is not None:
-                self._replay_push(push)
-            rows = table.pull(uniq_p)
-        return uniq, inv, uniq_p, rows
+        ctx = span.context() if span is not None else None
+        with self._tracer().activate(ctx):
+            chaos.fault_point("ps.pipeline",  # pta: disable=PTA301 (PSTrainStep._consume_prefetch owns fallback: sync re-pull + push replay)
+                              meta={"n_ids": int(ids_np.size),
+                                    "coalesced_push": push is not None})
+            uniq, inv, uniq_p = self._unique_prep(ids_np)
+            if push is not None and hasattr(table, "push_pull"):
+                rows = table.push_pull(push[0], push[1], uniq_p,
+                                       seq=push[2])
+            else:
+                if push is not None:
+                    self._replay_push(push)
+                rows = table.pull(uniq_p)
+            return uniq, inv, uniq_p, rows
 
     def _take_pending_push(self):
         """Drain the deferred-push queue into one ``(ids, grads, seq)``
@@ -586,11 +605,20 @@ class PSTrainStep:
                 self._prefetch_pool = ThreadPoolExecutor(
                     max_workers=max(1, self.prefetch_depth),
                     thread_name_prefix="ps-prefetch")
+            # the span covers the whole in-flight window (issue →
+            # settle/consume), ending with the prefetch's real fate:
+            # "ok", or "error" with the reason (task failure, reorder,
+            # reform-staleness)
+            span = self._tracer().start_span(
+                "ps.prefetch", detached=True,
+                attrs={"n_ids": int(ids_np.size),
+                       "coalesced_push": push is not None})
+            span = span if span.span_id is not None else None
             self._inflight.append({
-                "key": ids_np, "push": push,
+                "key": ids_np, "push": push, "span": span,
                 "epoch": getattr(client, "epoch", None),
                 "future": self._prefetch_pool.submit(
-                    self._prefetch_task, table, ids_np, push)})
+                    self._prefetch_task, table, ids_np, push, span)})
 
     def _settle_inflight(self, inf):
         """Resolve one in-flight prefetch, owning the error policy for
@@ -614,11 +642,15 @@ class PSTrainStep:
         try:
             return inf["future"].result()
         except RuntimeError as e:
+            self._end_prefetch_span(inf, "error", reason="server_error",
+                                    exc=repr(e))
             if inf["push"] is not None and \
                     "stale membership epoch" not in str(e):
                 self._replay_push(inf["push"])
             return None
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as e:
+            self._end_prefetch_span(inf, "error", reason="transport",
+                                    exc=repr(e))
             if inf["push"] is not None:
                 self._replay_push(inf["push"])
             return None
@@ -639,12 +671,23 @@ class PSTrainStep:
         inf = self._inflight.popleft()
         client = getattr(self.embedding.table, "client", None)
         got = self._settle_inflight(inf)
-        if got is None:
+        if got is None:            # failed: span ended by the settle path
+            monitor.stat_add("ps_prefetch_misses_total")
             return None
         if not _np.array_equal(inf["key"], ids_np):
-            return None            # stream reordered: rows are another batch's
+            # stream reordered: rows are another batch's
+            self._end_prefetch_span(inf, "error", reason="reordered")
+            monitor.stat_add("ps_prefetch_misses_total")
+            return None
         if client is not None and inf["epoch"] != client.epoch:
-            return None            # re-formed mid-flight: rows are stale
+            # re-formed mid-flight: rows are stale, discard them
+            self._end_prefetch_span(inf, "error", reason="stale_epoch",
+                                    issued_epoch=inf["epoch"],
+                                    epoch=client.epoch)
+            monitor.stat_add("ps_prefetch_misses_total")
+            return None
+        self._end_prefetch_span(inf, "ok")
+        monitor.stat_add("ps_prefetch_hits_total")
         return got
 
     def _make_step(self, ids_shape):
@@ -674,6 +717,19 @@ class PSTrainStep:
         return jax.jit(step, donate_argnums=donate)
 
     def __call__(self, ids, *inputs):
+        import time as _time
+        t_start = _time.perf_counter()
+        with self._tracer().start_span(
+                "train.step",
+                attrs={"step": int(getattr(self.optimizer,
+                                           "_global_step", 0))}):
+            loss = self._call_inner(ids, *inputs)
+        monitor.observe("train_step_ms",
+                        (_time.perf_counter() - t_start) * 1e3)
+        monitor.stat_add("train_steps_total")
+        return loss
+
+    def _call_inner(self, ids, *inputs):
         import numpy as _np
         import ml_dtypes
         ids_np = _np.asarray(
@@ -746,7 +802,9 @@ class PSTrainStep:
         # last step is still pending
         self._announced.clear()
         while self._inflight:
-            self._settle_inflight(self._inflight.popleft())
+            inf = self._inflight.popleft()
+            if self._settle_inflight(inf) is not None:
+                self._end_prefetch_span(inf, "ok", drained=True)
         while self._pending_push:
             ids_p, g_p = self._pending_push.pop(0)
             self.embedding.table.push(ids_p, g_p)

@@ -34,8 +34,9 @@ import numpy as np
 from paddle_tpu.distributed.ps import HostEmbeddingTable
 from paddle_tpu.distributed.ps.device_table import (
     WIRE_DTYPES, dequantize_rows, normalize_wire, quantize_rows)
-from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework import chaos, monitor, observability
 from paddle_tpu.framework.flags import flag
+from paddle_tpu.framework.observability import flight
 
 __all__ = ["PsServer", "PsClient", "RemoteEmbeddingTable",
            "HeartBeatMonitor", "TransportStats", "serve"]
@@ -256,11 +257,22 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             t0 = time.perf_counter()
             ok = True
+            # re-open the client's trace server-side: a request carrying
+            # trace/span ids gets a child span around the op handling, so
+            # the merged timeline shows the server work under the RPC
+            # that caused it
+            ctx = srv.tracer.extract(header)
+            span = srv.tracer.start_span(
+                f"ps.server.{header.get('op')}", parent=ctx, detached=True,
+                attrs={"worker": header.get("worker")}) \
+                if ctx is not None else None
             try:
                 reply, rbufs = srv._dispatch(header, bufs)
                 ok = reply.get("ok", False)
             except Exception as e:                # noqa: BLE001
                 reply, rbufs, ok = {"ok": False, "error": repr(e)}, [], False
+            if span is not None:
+                span.end(status="ok" if ok else "error")
             try:
                 n_out = _send_msg(sock, reply, rbufs)
             except OSError:
@@ -287,8 +299,12 @@ class PsServer:
     def __init__(self, tables: Dict[str, HostEmbeddingTable],
                  host: str = "127.0.0.1", port: int = 0,
                  heartbeat_timeout: float = 30.0,
-                 n_workers: Optional[int] = None):
+                 n_workers: Optional[int] = None,
+                 tracer: Optional[observability.Tracer] = None):
         self.tables = tables
+        # instance tracer for in-process multi-role runs (one span file
+        # per logical process); the module singleton otherwise
+        self.tracer = tracer if tracer is not None else observability.tracer
         self.monitor = HeartBeatMonitor(heartbeat_timeout)
         self.n_workers = n_workers
         self.epoch = 0                 # membership-epoch fence (elastic)
@@ -379,6 +395,9 @@ class PsServer:
         we = header.get("epoch")
         if op in self._FENCED_OPS and self.epoch > 0 and \
                 (we is None or we < self.epoch):
+            flight.record("ps.fence_rejected", severity="warn", op=op,
+                          worker=header.get("worker"), worker_epoch=we,
+                          server_epoch=self.epoch)
             return {"ok": False,
                     "error": f"stale membership epoch {we} < {self.epoch}"
                              " — the job re-formed without this worker; "
@@ -412,8 +431,12 @@ class PsServer:
                 wire = normalize_wire(header.get("wire", "f32"))
             except ValueError:
                 wire = "f32"
+            # "time" rides the handshake so a client can estimate this
+            # server's clock offset (PsClient.sync_clock) — what
+            # trace_merge uses to land every process on one timeline
             return {"ok": True, "wire": wire,
-                    "wire_dtypes": list(WIRE_DTYPES)}, []
+                    "wire_dtypes": list(WIRE_DTYPES),
+                    "time": time.time()}, []
         if op == "pull":
             t = self.tables[header["table"]]
             rows = t.pull(bufs[0].astype(np.int64))
@@ -475,6 +498,7 @@ class PsServer:
                               for w in self.monitor.workers()},
                     "wire_dtypes": list(WIRE_DTYPES),
                     "transport": self.transport.snapshot(),
+                    "flight": flight.recent(32),
                     "epoch": self.epoch}, []
         if op == "bye":
             # a fenced job counts only CURRENT-epoch byes toward the
@@ -641,7 +665,9 @@ class PsClient:
                  max_retries: Optional[int] = None,
                  backoff_base: Optional[float] = None,
                  timeout: Optional[float] = None,
-                 wire_dtype: Optional[str] = None):
+                 wire_dtype: Optional[str] = None,
+                 tracer: Optional[observability.Tracer] = None):
+        self.tracer = tracer if tracer is not None else observability.tracer
         self.transport = TransportStats(role="client")
         self.endpoints = list(endpoints)
         self._conns = [_Conn(ep, timeout=timeout, stats=self.transport)
@@ -672,6 +698,15 @@ class PsClient:
         self.on_endpoint_dead = None       # callback(endpoint, exception)
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        if self.tracer.enabled:
+            # best-effort clock sync so this process's span file carries
+            # a measured offset to the server clock before any span is
+            # written; dead/old peers are fine (a tracer with offset 0
+            # merges untranslated — same as before)
+            try:
+                self.sync_clock()
+            except (ConnectionError, OSError, RuntimeError):
+                pass
 
     @property
     def n(self):
@@ -681,33 +716,55 @@ class PsClient:
     def _rpc(self, s: int, header: dict, bufs=(),
              retries: Optional[int] = None):
         conn, ep = self._conns[s], self.endpoints[s]
+        op = header.get("op")
         if self.epoch is not None:
             header.setdefault("epoch", self.epoch)
         retries = self.max_retries if retries is None else retries
         last: Optional[Exception] = None
+        # one logical span per RPC; each ATTEMPT is a child with a fresh
+        # span id under the same trace id (the retry contract), and the
+        # attempt's context rides the header so the server's child span
+        # links to exactly the attempt that reached it
+        root = self.tracer.start_span(f"ps.{op}", detached=True,
+                                      attrs={"endpoint": ep})
         for attempt in range(retries + 1):
+            asp = self.tracer.start_span(
+                "ps.rpc", parent=root, detached=True,
+                attrs={"op": op, "endpoint": ep, "attempt": attempt})
+            self.tracer.inject(header, asp)
             try:
                 reply, rbufs = conn.rpc(header, bufs)
+                asp.end(status="ok")
+                root.end(status="ok")
                 with self._dead_lock:              # recovered
                     if ep in self.dead_endpoints:
                         self.dead_endpoints.remove(ep)
                 if self.monitor is not None:
                     self.monitor.beat(ep)
                 return reply, rbufs
-            except RuntimeError:
-                raise                      # server-side error: don't retry
+            except RuntimeError as e:      # server-side error: don't retry
+                asp.end(status="error", exc=repr(e))
+                root.end(status="error")
+                raise
             except (ConnectionError, OSError) as e:
                 last = e
+                asp.end(status="error", exc=repr(e))
+                flight.record("ps.retry", severity="warn", op=op,
+                              endpoint=ep, attempt=attempt,
+                              will_retry=attempt < retries, exc=repr(e))
                 if attempt < retries:
                     # conn.rpc invalidated the socket; the next attempt
                     # redials lazily under the connection lock
                     time.sleep(self.backoff_base * (2 ** attempt))
+        root.end(status="error", exc=repr(last))
         self._report_dead(ep, last)
         raise ConnectionError(
             f"ps endpoint {ep} dead after {retries + 1} attempts "
             f"of {header.get('op')!r}: {last!r}")
 
     def _report_dead(self, endpoint: str, exc: Optional[Exception]):
+        flight.record("ps.mark_dead", severity="error", endpoint=endpoint,
+                      exc=repr(exc))
         with self._dead_lock:
             if endpoint not in self.dead_endpoints:
                 self.dead_endpoints.append(endpoint)
@@ -764,13 +821,17 @@ class PsClient:
         flat = ids.reshape(-1)
         owner = flat % self.n
 
+        tctx = self.tracer.current()    # fan-out threads inherit the
+                                        # caller's span as parent
+
         def one(s):
             mask = owner == s
             if not mask.any():
                 return s, mask, None
-            reply, rows = self._rpc(
-                s, {"op": "pull", "table": table,
-                    "wire": self.wire_dtype}, [flat[mask]])
+            with self.tracer.activate(tctx):
+                reply, rows = self._rpc(
+                    s, {"op": "pull", "table": table,
+                        "wire": self.wire_dtype}, [flat[mask]])
             return s, mask, self._decode_pull(table, reply, rows)
 
         first_dim = None
@@ -799,14 +860,17 @@ class PsClient:
         owner = flat % self.n
         seq = self._next_seq() if seq is None else seq
 
+        tctx = self.tracer.current()
+
         def one(s):
             mask = owner == s
             if mask.any():
-                wire = self._push_wire(s)
-                self._rpc(s, {"op": "push", "table": table, "lr": lr,
-                              "wire": wire, "worker": self._push_ident,
-                              "seq": seq},
-                          [flat[mask]] + quantize_rows(g[mask], wire))
+                with self.tracer.activate(tctx):
+                    wire = self._push_wire(s)
+                    self._rpc(s, {"op": "push", "table": table, "lr": lr,
+                                  "wire": wire, "worker": self._push_ident,
+                                  "seq": seq},
+                              [flat[mask]] + quantize_rows(g[mask], wire))
 
         list(self._pool.map(one, range(self.n)))
 
@@ -830,26 +894,30 @@ class PsClient:
         gowner = gids % self.n
         seq = self._next_seq() if seq is None else seq
 
+        tctx = self.tracer.current()
+
         def one(s):
             pmask = powner == s
             gmask = gowner == s
             if not pmask.any() and not gmask.any():
                 return s, pmask, None
-            if not pmask.any():            # push-only shard
+            with self.tracer.activate(tctx):
+                if not pmask.any():            # push-only shard
+                    wire = self._push_wire(s)
+                    self._rpc(s, {"op": "push", "table": table, "lr": lr,
+                                  "wire": wire, "worker": self._push_ident,
+                                  "seq": seq},
+                              [gids[gmask]] + quantize_rows(g[gmask], wire))
+                    return s, pmask, None
                 wire = self._push_wire(s)
-                self._rpc(s, {"op": "push", "table": table, "lr": lr,
-                              "wire": wire, "worker": self._push_ident,
-                              "seq": seq},
-                          [gids[gmask]] + quantize_rows(g[gmask], wire))
-                return s, pmask, None
-            wire = self._push_wire(s)
-            payload = quantize_rows(g[gmask], wire) if gmask.any() else []
-            reply, rows = self._rpc(
-                s, {"op": "push_pull", "table": table, "lr": lr,
-                    "wire": wire, "worker": self._push_ident, "seq": seq,
-                    "n_push_bufs": len(payload)},
-                [gids[gmask]] + payload + [pflat[pmask]])
-            return s, pmask, self._decode_pull(table, reply, rows)
+                payload = quantize_rows(g[gmask], wire) if gmask.any() \
+                    else []
+                reply, rows = self._rpc(
+                    s, {"op": "push_pull", "table": table, "lr": lr,
+                        "wire": wire, "worker": self._push_ident,
+                        "seq": seq, "n_push_bufs": len(payload)},
+                    [gids[gmask]] + payload + [pflat[pmask]])
+                return s, pmask, self._decode_pull(table, reply, rows)
 
         first_dim = None
         parts = list(self._pool.map(one, range(self.n)))
@@ -909,6 +977,29 @@ class PsClient:
         """Measured client-side transport counters: RPC count, wire
         bytes each way, per-op split, latency histograms."""
         return self.transport.snapshot()
+
+    def sync_clock(self, server: int = 0) -> Optional[float]:
+        """Estimate this process's clock offset to ``server`` over the
+        ``hello`` handshake (NTP-style midpoint: ``server_time - (t0 +
+        t1) / 2``) and install it on the tracer, so trace_merge can put
+        every process's spans on the server's timeline.  Returns the
+        offset in seconds, or None from an old server whose hello
+        carries no time.
+
+        The probe rides the RAW connection, single dial, bypassing the
+        retry/death bookkeeping on purpose: it runs at client
+        construction, when a co-launched server may simply not be
+        listening yet, and a failed clock probe must not mark a healthy
+        endpoint dead (mark_dead fires the elastic lost-peer channel
+        and the later revival burns a flap)."""
+        t0 = time.time()
+        reply, _ = self._conns[server].rpc({"op": "hello", "wire": "f32"})
+        t1 = time.time()
+        if "time" not in reply:
+            return None
+        offset = float(reply["time"]) - (t0 + t1) / 2.0
+        self.tracer.set_clock_offset(offset)
+        return offset
 
     def set_epoch(self, epoch: int, fence_servers: bool = False,
                   n_workers: Optional[int] = None):

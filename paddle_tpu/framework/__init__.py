@@ -11,6 +11,7 @@ from paddle_tpu.framework import auto_checkpoint  # noqa: F401
 from paddle_tpu.framework import analysis  # noqa: F401
 from paddle_tpu.framework import chaos  # noqa: F401
 from paddle_tpu.framework import errors  # noqa: F401
+from paddle_tpu.framework import observability  # noqa: F401
 from paddle_tpu.framework.resilient import ResilientTrainStep  # noqa: F401
 from paddle_tpu.framework.io import save, load  # noqa: F401
 from paddle_tpu.tensor.random import (  # noqa: F401

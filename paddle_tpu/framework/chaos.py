@@ -196,6 +196,13 @@ class ChaosRegistry:
             trip = spec.should_trip(self._rng)
         if not trip:
             return payload
+        # every trip lands in the flight recorder: a post-mortem dump
+        # shows the injected fault right before the recovery machinery's
+        # own events (retry, mark_dead, rollback, re-form)
+        from paddle_tpu.framework.observability import flight
+        flight.record("chaos.trip", severity="warn", point=name,
+                      mode=spec.mode, call=spec.calls,
+                      **({"meta": meta} if meta else {}))
         if spec.mode == "latency":
             time.sleep(spec.latency)
             return payload

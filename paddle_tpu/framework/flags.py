@@ -124,3 +124,28 @@ define_flag("ps_prefetch_depth", 1,
             "rides a background executor while the chip runs the "
             "current step, coalesced with the previous step's push "
             "into one RPC round-trip per shard")
+
+# observability tier (framework/observability.py + profiler):
+define_flag("trace_dir", "",
+            "directory for distributed-tracing span files; non-empty "
+            "enables the process-wide Tracer, which appends finished "
+            "spans to trace_<label>.jsonl there (label from "
+            "PADDLE_TRACE_LABEL, set per child by the launcher).  Merge "
+            "the per-process files with tools/trace_merge.py")
+define_flag("flight_capacity", 512,
+            "flight recorder ring size: the last N structured events "
+            "(chaos trips, PS retries, NaN rollbacks, elastic "
+            "membership changes) kept for crash dumps and the PS stat "
+            "op's 'flight' field")
+define_flag("flight_dir", "",
+            "directory for flight_<worker>.json crash dumps "
+            "(install_crash_handler); empty = current directory")
+define_flag("metrics_export_interval", 30.0,
+            "seconds between MetricsReporter writes of "
+            "monitor.export_prometheus() to its textfile (atomic "
+            "tmp+rename, scraper-safe)")
+define_flag("profiler_max_spans", 100000,
+            "cap on retained chrome-trace spans per profiling session; "
+            "beyond it spans are dropped (counted — the Profiling "
+            "Report and chrome-trace metadata report the drop count) "
+            "while the aggregate report keeps counting every event")

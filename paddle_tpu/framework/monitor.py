@@ -11,6 +11,11 @@ from __future__ import annotations
 import threading
 from typing import Dict, Union
 
+__all__ = ["StatRegistry", "Histogram", "get_histogram", "observe",
+           "all_histograms", "reset_all_histograms", "stat_add",
+           "stat_sub", "get_stat", "reset_stat", "all_stats",
+           "reset_all_stats", "export_prometheus"]
+
 Number = Union[int, float]
 
 
@@ -102,20 +107,45 @@ class Histogram:
             if v > self.max:
                 self.max = v
 
+    def reset(self):
+        """Zero the histogram IN PLACE — live references (e.g. the
+        per-op latency histograms TransportStats holds) keep recording
+        into the same registered object."""
+        with self._lock:
+            self._counts = [0] * (len(self.BOUNDS) + 1)
+            self.count = 0
+            self.sum = 0.0
+            self.max = 0.0
+
     def percentile(self, p: float) -> float:
-        """Upper bucket bound holding the p-quantile (0 with no data;
-        ``max`` for the overflow bucket — honest about saturation)."""
+        """Linearly interpolated p-quantile: position within the bucket
+        holding the quantile, between the bucket's lower and upper
+        bounds (0 with no data; ``max`` for the overflow bucket —
+        honest about saturation)."""
         with self._lock:
             if not self.count:
                 return 0.0
             target = p * self.count
             seen = 0
             for i, c in enumerate(self._counts):
+                prev = seen
                 seen += c
-                if seen >= target:
-                    return (self.BOUNDS[i] if i < len(self.BOUNDS)
-                            else self.max)
+                if c and seen >= target:
+                    if i >= len(self.BOUNDS):
+                        return self.max
+                    lo = self.BOUNDS[i - 1] if i > 0 else 0.0
+                    hi = self.BOUNDS[i]
+                    frac = min(1.0, max(0.0, (target - prev) / c))
+                    return lo + frac * (hi - lo)
             return self.max
+
+    def buckets(self):
+        """Snapshot of (bounds, per-bucket counts incl. the overflow
+        slot, total count, sum) — the cumulative-bucket renderer's
+        input (export_prometheus)."""
+        with self._lock:
+            return (list(self.BOUNDS), list(self._counts),
+                    self.count, self.sum)
 
     def summary(self) -> Dict[str, Number]:
         with self._lock:
@@ -152,8 +182,13 @@ def all_histograms() -> Dict[str, Dict[str, Number]]:
 
 
 def reset_all_histograms():
+    """Zero every registered histogram IN PLACE.  Clearing the registry
+    dict instead would orphan live references (TransportStats etc.):
+    their subsequent records would vanish from :func:`all_histograms`."""
     with _hist_lock:
-        _hists.clear()
+        hs = list(_hists.values())
+    for h in hs:
+        h.reset()
 
 
 def stat_add(name: str, value: Number = 1):
@@ -179,3 +214,60 @@ def all_stats() -> Dict[str, Number]:
 
 def reset_all_stats():
     StatRegistry.instance().reset_all()
+
+
+# ---------------------------------------------------------------------------
+# metrics export (Prometheus exposition text format)
+# ---------------------------------------------------------------------------
+
+def _prom_name(name: str) -> str:
+    """Sanitize a stat/histogram name into the Prometheus metric-name
+    charset ([a-zA-Z_:][a-zA-Z0-9_:]*)."""
+    import re
+    n = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not n or not re.match(r"[a-zA-Z_:]", n[0]):
+        n = "_" + n
+    return n
+
+
+def _prom_num(v: Number) -> str:
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def export_prometheus() -> str:
+    """Render every registered stat (as a gauge — ``stat_sub`` means
+    values may go down) and every histogram (cumulative ``_bucket``
+    series + ``_sum``/``_count``) in the Prometheus exposition text
+    format, ready for a textfile collector or HTTP scrape handler.
+    ``observability.validate_prometheus`` checks the grammar; the CI
+    observability lane round-trips this output through it."""
+    lines = []
+    seen = set()
+    for name, v in sorted(all_stats().items()):
+        n = _prom_name(name)
+        if n in seen:
+            continue                      # sanitization collision: first wins
+        seen.add(n)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_prom_num(v)}")
+    with _hist_lock:
+        hs = sorted(_hists.items())
+    for name, h in hs:
+        n = _prom_name(name)
+        if n in seen:
+            continue
+        seen.add(n)
+        bounds, counts, count, total = h.buckets()
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for b, c in zip(bounds, counts):
+            cum += c
+            lines.append(f'{n}_bucket{{le="{_prom_num(b)}"}} {cum}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{n}_sum {_prom_num(total)}")
+        lines.append(f"{n}_count {count}")
+    return "\n".join(lines) + "\n"

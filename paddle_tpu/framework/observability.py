@@ -1,0 +1,587 @@
+"""Unified observability plane: distributed tracing, flight recorder,
+metrics export.
+
+The stack already measures itself in islands — ``framework/monitor.py``
+counters and histograms, per-link ``TransportStats``, a host-only
+profiler — but none of them can follow one request across processes or
+answer "what happened right before the crash".  This module is the
+missing spine, three tools sharing one design center (cheap when off,
+structured when on):
+
+* **Tracer** — trace/span ids layered on the profiler's host spans.
+  A :class:`Span` covers one operation; its context (trace id + span
+  id) travels inside PS RPC headers (``PsClient`` injects, the server
+  re-opens a child span around op handling), so a worker's
+  ``push_pull`` and the server work it caused share one trace id.
+  Retries reuse the trace id with fresh span ids.  Each process
+  appends finished spans to a JSONL file (``FLAGS_trace_dir``);
+  ``tools/trace_merge.py`` merges the per-process files into one
+  chrome-trace JSON with per-process lanes, correcting clocks with the
+  offset measured over the PS ``hello`` handshake
+  (:meth:`PsClient.sync_clock`).
+
+* **FlightRecorder** — a bounded, thread-safe ring buffer of
+  structured events ``{ts, severity, kind, attrs}`` fed by the
+  machinery that matters in a post-mortem: chaos fault firings,
+  ``ResilientTrainStep`` NaN skip/restore, elastic
+  join/leave/epoch-bump/hang-kill, PS retry/mark_dead/fence-rejection.
+  ``recent(n)`` answers live queries (the PS ``stat`` op carries a
+  ``flight`` field); :func:`install_crash_handler` dumps
+  ``flight_<worker>.json`` on an uncaught exception, and
+  ``launch._supervise`` dumps its own recorder when a child fails
+  terminally.
+
+* **Metrics export** — :class:`MetricsReporter` renders
+  ``monitor.export_prometheus()`` (every stat + histogram, cumulative
+  buckets) to a file on an interval, atomically (tmp+rename), so a
+  node exporter / sidecar can scrape training metrics without touching
+  the process.  :func:`validate_prometheus` checks a rendering against
+  the Prometheus text-format grammar (the CI lane's gate).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.flags import flag
+
+__all__ = ["SpanContext", "Span", "Tracer", "tracer", "FlightRecorder",
+           "flight", "MetricsReporter", "install_crash_handler",
+           "validate_prometheus"]
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+class SpanContext:
+    """What travels across a process boundary: (trace id, span id)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return f"SpanContext({self.trace_id}, {self.span_id})"
+
+
+class Span:
+    """One traced operation.  Context-manager use nests it under the
+    thread's current span and ends it on exit; ``detached=True`` spans
+    (cross-thread work: a prefetch in flight, a server-side handler)
+    are ended explicitly via :meth:`end` and never touch the creating
+    thread's stack.
+
+    While profiling is on, entering a span also enters a
+    ``profiler.RecordEvent`` of the same name, so traced operations
+    appear in the Profiling Report without double instrumentation."""
+
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
+                 "attrs", "_t0_wall", "_t0_perf", "_ended", "_rec",
+                 "status")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: str, parent_id: Optional[str],
+                 attrs: Optional[dict] = None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = dict(attrs) if attrs else {}
+        self._t0_wall = time.time()
+        self._t0_perf = time.perf_counter()
+        self._ended = False
+        self._rec = None
+        self.status = "ok"
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_attr(self, key: str, value):
+        self.attrs[key] = value
+
+    def __enter__(self):
+        self.tracer._push(self.context())
+        from paddle_tpu import profiler
+        if profiler.is_profiling():
+            self._rec = profiler.RecordEvent(self.name)
+            self._rec.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._rec is not None:
+            self._rec.__exit__(exc_type, exc, tb)
+            self._rec = None
+        self.tracer._pop()
+        self.end(status="error" if exc_type is not None else self.status,
+                 **({"exc": repr(exc)} if exc is not None else {}))
+        return False
+
+    def end(self, status: str = "ok", **attrs):
+        """Finish the span (idempotent) and append its record to the
+        tracer's JSONL file."""
+        if self._ended:
+            return
+        self._ended = True
+        self.status = status
+        if attrs:
+            self.attrs.update(attrs)
+        self.tracer._write_span(self)
+
+
+class _NullSpan:
+    """Returned by a disabled tracer: every operation is a no-op and the
+    ids are None, so call sites can skip header injection cheaply."""
+
+    trace_id = span_id = parent_id = None
+    attrs: dict = {}
+    status = "ok"
+
+    def context(self):
+        return None
+
+    def set_attr(self, key, value):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def end(self, status: str = "ok", **attrs):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Issues trace/span ids and appends finished spans to a JSONL file.
+
+    One module-level singleton (:data:`tracer`) serves normal use —
+    enabled via ``FLAGS_trace_dir`` (or :meth:`enable`), labeled via
+    ``PADDLE_TRACE_LABEL`` (the launcher sets it per child).  Separate
+    instances may be constructed for in-process multi-role tests (one
+    file per logical "process") and handed to ``PsServer``/``PsClient``.
+
+    Span file format — one JSON object per line:
+
+    * ``{"kind": "process", "label", "pid", "clock_offset"}`` — emitted
+      on open and again whenever :meth:`set_clock_offset` runs;
+      ``clock_offset`` (seconds) is what ``trace_merge`` ADDS to this
+      file's timestamps to land them on the reference clock.
+    * ``{"kind": "span", "name", "trace", "span", "parent", "ts",
+      "dur", "status", "tid", "attrs"}`` — ``ts`` epoch microseconds,
+      ``dur`` microseconds.
+    """
+
+    def __init__(self, trace_dir: Optional[str] = None,
+                 label: Optional[str] = None):
+        self._dir = trace_dir
+        self.label = label or os.environ.get(
+            "PADDLE_TRACE_LABEL") or f"pid{os.getpid()}"
+        self._file = None
+        self._file_lock = threading.Lock()
+        self._local = threading.local()
+        self._checked_env = trace_dir is not None
+        self.clock_offset = 0.0
+        self.spans_written = 0
+
+    # -- enablement ---------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        if not self._checked_env:
+            # lazy env arming, chaos-style: a launcher can turn tracing
+            # on for a whole child tree via FLAGS_trace_dir alone
+            self._checked_env = True
+            d = flag("trace_dir")
+            if d:
+                self._dir = str(d)
+        return bool(self._dir)
+
+    def enable(self, trace_dir: str, label: Optional[str] = None):
+        with self._file_lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            self._dir = trace_dir
+            self._checked_env = True
+            if label:
+                self.label = label
+        return self
+
+    def disable(self):
+        with self._file_lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            self._dir = None
+            self._checked_env = True
+
+    def path(self) -> Optional[str]:
+        """The span file this tracer appends to (None when disabled)."""
+        if not self.enabled:
+            return None
+        return os.path.join(self._dir, f"trace_{self.label}.jsonl")
+
+    # -- thread-local context stack -----------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _push(self, ctx: SpanContext):
+        self._stack().append(ctx)
+
+    def _pop(self):
+        st = self._stack()
+        if st:
+            st.pop()
+
+    def current(self) -> Optional[SpanContext]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def activate(self, ctx: Optional[SpanContext]):
+        """Adopt a foreign span context on THIS thread (background
+        executors: the prefetch task runs under the span opened at
+        issue time, so its RPCs parent correctly).  ``None`` is a
+        no-op."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            if ctx is None:
+                yield
+                return
+            self._push(ctx)
+            try:
+                yield
+            finally:
+                self._pop()
+        return cm()
+
+    # -- span creation ------------------------------------------------------
+    def start_span(self, name: str, parent=None, attrs: Optional[dict] = None,
+                   detached: bool = False) -> Span:
+        """New span under ``parent`` (a Span, SpanContext, or None for
+        the thread's current span; a fresh trace when there is none).
+        Context-manager use ends it automatically; ``detached=True``
+        spans are ended explicitly with :meth:`Span.end`."""
+        if not self.enabled:
+            return _NULL_SPAN
+        if isinstance(parent, Span):
+            parent = parent.context()
+        if parent is None:
+            parent = self.current()
+        if parent is None:
+            trace_id, parent_id = _new_id(), None
+        else:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        return Span(self, name, trace_id, _new_id(), parent_id, attrs)
+
+    # -- wire propagation ---------------------------------------------------
+    def inject(self, header: dict, span: Optional[Span] = None) -> dict:
+        """Stamp ``header`` with the span's (or current) context."""
+        ctx = span.context() if isinstance(span, Span) else self.current()
+        if ctx is not None:
+            header["trace"] = ctx.trace_id
+            header["span"] = ctx.span_id
+        return header
+
+    @staticmethod
+    def extract(header: dict) -> Optional[SpanContext]:
+        t, s = header.get("trace"), header.get("span")
+        if t is None or s is None:
+            return None
+        return SpanContext(str(t), str(s))
+
+    # -- clock correction ---------------------------------------------------
+    def set_clock_offset(self, offset: float):
+        """Record the measured offset to the reference clock (seconds to
+        ADD to this process's timestamps); re-emits the process meta
+        record so the merge uses the freshest measurement."""
+        self.clock_offset = float(offset)
+        if self.enabled:
+            self._write(self._meta_record())
+
+    # -- file plumbing ------------------------------------------------------
+    def _meta_record(self) -> dict:
+        return {"kind": "process", "label": self.label, "pid": os.getpid(),
+                "clock_offset": self.clock_offset}
+
+    def _write(self, record: dict):
+        with self._file_lock:
+            if self._dir is None:
+                # disabled (possibly since the span started): a detached
+                # span draining after shutdown drops its record instead
+                # of crashing the training/serving path
+                return
+            if self._file is None:
+                os.makedirs(self._dir, exist_ok=True)
+                fresh = not os.path.exists(self.path())
+                self._file = open(self.path(), "a")
+                if fresh or os.path.getsize(self.path()) == 0:
+                    self._file.write(json.dumps(self._meta_record()) + "\n")
+            self._file.write(json.dumps(record, default=str) + "\n")
+            self._file.flush()
+
+    def _write_span(self, span: Span):
+        dur = time.perf_counter() - span._t0_perf
+        self._write({
+            "kind": "span", "name": span.name, "trace": span.trace_id,
+            "span": span.span_id, "parent": span.parent_id,
+            "ts": span._t0_wall * 1e6, "dur": dur * 1e6,
+            "status": span.status, "tid": threading.get_ident(),
+            "attrs": span.attrs})
+        self.spans_written += 1
+
+
+#: process-wide default tracer (FLAGS_trace_dir / PADDLE_TRACE_LABEL)
+tracer = Tracer()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+_SEVERITIES = ("debug", "info", "warn", "error")
+
+
+class FlightRecorder:
+    """Bounded ring of structured events — what the process was doing
+    right before it mattered.  Thread-safe; recording is two dict
+    allocations and a deque append, cheap enough for hot-ish paths
+    (retries, fault trips), and the bound (``FLAGS_flight_capacity``)
+    makes a week-long run's recorder the same size as a minute-long
+    one's."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._capacity = capacity
+        self._ring = None                     # lazy: flag read at first use
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def _buf(self) -> "collections.deque":
+        if self._ring is None:
+            cap = int(flag("flight_capacity")) if self._capacity is None \
+                else int(self._capacity)
+            self._ring = collections.deque(maxlen=max(1, cap))
+        return self._ring
+
+    def record(self, kind: str, severity: str = "info", **attrs):
+        if severity not in _SEVERITIES:
+            severity = "info"
+        ev = {"ts": time.time(), "severity": severity, "kind": kind,
+              "attrs": attrs}
+        with self._lock:
+            buf = self._buf()
+            if len(buf) == buf.maxlen:
+                self.dropped += 1
+            buf.append(ev)
+        return ev
+
+    def recent(self, n: int = 50) -> List[dict]:
+        """The most recent ``n`` events, oldest first."""
+        with self._lock:
+            buf = list(self._buf())
+        return buf[-max(0, int(n)):]
+
+    def clear(self):
+        with self._lock:
+            self._buf().clear()
+            self.dropped = 0
+
+    def dump(self, path: str, worker: Optional[str] = None) -> str:
+        """Write the ring to ``path`` as JSON, atomically (tmp+rename
+        via the fs tier's crash-safe helper) — the post-mortem artifact
+        ``launch._supervise`` and the crash handler produce."""
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+        with self._lock:
+            events = list(self._buf())
+            dropped = self.dropped
+        payload = {"worker": worker, "pid": os.getpid(),
+                   "dumped_at": time.time(), "dropped": dropped,
+                   "events": events}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        LocalFS().atomic_write(path, json.dumps(payload, default=str))
+        return path
+
+
+#: process-wide flight recorder (chaos trips, PS retries, NaN rollbacks,
+#: elastic membership events all land here)
+flight = FlightRecorder()
+
+
+def install_crash_handler(worker: Optional[str] = None,
+                          flight_dir: Optional[str] = None,
+                          chain: bool = True):
+    """Hook ``sys.excepthook`` so an uncaught exception dumps the flight
+    recorder to ``<flight_dir>/flight_<worker>.json`` before the normal
+    traceback.  ``worker`` defaults to the elastic worker id the
+    launcher exported (``PADDLE_ELASTIC_WORKER_ID``) or ``pid<n>``;
+    ``flight_dir`` to ``FLAGS_flight_dir`` (cwd when empty).  Returns
+    the installed hook (tests call it directly; ``chain=False``
+    suppresses the chained traceback print)."""
+    import sys
+    worker_id = worker or os.environ.get("PADDLE_ELASTIC_WORKER_ID") \
+        or f"pid{os.getpid()}"
+    base = flight_dir if flight_dir is not None else \
+        (str(flag("flight_dir")) or ".")
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        flight.record("crash", severity="error",
+                      exc=repr(exc), worker=worker_id)
+        try:
+            flight.dump(os.path.join(base, f"flight_{worker_id}.json"),
+                        worker=worker_id)
+        except OSError:
+            pass                    # a full disk must not mask the crash
+        if chain:
+            prev(exc_type, exc, tb)
+
+    sys.excepthook = hook
+    return hook
+
+
+# ---------------------------------------------------------------------------
+# metrics export plane
+# ---------------------------------------------------------------------------
+
+class MetricsReporter:
+    """Background thread rendering ``monitor.export_prometheus()`` to
+    ``path`` every ``interval`` seconds (``FLAGS_metrics_export_interval``
+    default), atomically via tmp+rename — a scraper or node exporter
+    textfile collector never sees a torn file.  ``write_once()`` is the
+    synchronous form (tests, final flush)."""
+
+    def __init__(self, path: str, interval: Optional[float] = None):
+        self.path = path
+        self.interval = float(flag("metrics_export_interval")) \
+            if interval is None else float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.writes = 0
+
+    def write_once(self) -> str:
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+        text = monitor.export_prometheus()
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        LocalFS().atomic_write(self.path, text)
+        self.writes += 1
+        return text
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.write_once()
+            except OSError:
+                pass                # transient fs trouble: keep reporting
+
+    def start(self) -> "MetricsReporter":
+        self.write_once()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="metrics-reporter")
+        self._thread.start()
+        return self
+
+    def stop(self, final_write: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if final_write:
+            try:
+                self.write_once()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# prometheus text-format grammar check (the CI lane's gate)
+# ---------------------------------------------------------------------------
+
+import re as _re  # noqa: E402
+
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_COMMENT_RE = _re.compile(
+    rf"^# (HELP {_PROM_NAME} .*|TYPE {_PROM_NAME} "
+    r"(counter|gauge|histogram|summary|untyped))$")
+_PROM_SAMPLE_RE = _re.compile(
+    rf"^({_PROM_NAME})"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\")*\})?"
+    r" [-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN)"
+    r"(?: [0-9]+)?$")
+_PROM_LE_RE = _re.compile(r'le="([^"]+)"')
+
+
+def validate_prometheus(text: str) -> int:
+    """Validate ``text`` against the Prometheus exposition text-format
+    grammar (comment/sample line shapes) plus histogram invariants:
+    cumulative non-decreasing buckets, a ``+Inf`` bucket equal to
+    ``_count``, and ``_sum``/``_count`` present for every histogram.
+    Returns the number of sample lines; raises ``ValueError`` on the
+    first violation."""
+    samples = 0
+    hist_names: List[str] = []
+    values: Dict[str, float] = {}
+    buckets: Dict[str, List[tuple]] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            if not _PROM_COMMENT_RE.match(line):
+                raise ValueError(f"line {i}: malformed comment: {line!r}")
+            if line.startswith("# TYPE ") and line.endswith(" histogram"):
+                hist_names.append(line.split()[2])
+            continue
+        m = _PROM_SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {i}: malformed sample: {line!r}")
+        samples += 1
+        name = m.group(1)
+        rest = line.split("} ", 1)[1] if "} " in line \
+            else line.split(" ", 1)[1]
+        val = float(rest.split(" ")[0])
+        if name.endswith("_bucket"):
+            le = _PROM_LE_RE.search(line)
+            if le is None:
+                raise ValueError(f"line {i}: bucket without le label")
+            buckets.setdefault(name[:-len("_bucket")], []).append(
+                (le.group(1), val))
+        else:
+            values[name] = val
+    for h in hist_names:
+        bks = buckets.get(h)
+        if not bks:
+            raise ValueError(f"histogram {h}: no buckets")
+        nums = [float("inf") if le == "+Inf" else float(le)
+                for le, _ in bks]
+        counts = [c for _, c in bks]
+        if nums != sorted(nums) or nums[-1] != float("inf"):
+            raise ValueError(f"histogram {h}: buckets not ascending "
+                             "or missing +Inf")
+        if any(b > a for b, a in zip(counts, counts[1:])):
+            raise ValueError(f"histogram {h}: buckets not cumulative")
+        if h + "_count" not in values or h + "_sum" not in values:
+            raise ValueError(f"histogram {h}: missing _sum/_count")
+        if counts[-1] != values[h + "_count"]:
+            raise ValueError(f"histogram {h}: +Inf bucket "
+                             f"{counts[-1]} != _count {values[h + '_count']}")
+    return samples

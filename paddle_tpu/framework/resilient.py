@@ -37,7 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.framework import chaos
+from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework.observability import flight
 
 __all__ = ["ResilientTrainStep"]
 
@@ -119,6 +120,10 @@ class ResilientTrainStep:
         if hasattr(opt, "_global_step"):
             opt._global_step = snap["global_step"]
         self._good_since_snap = 0
+        monitor.stat_add("train_restores_total")
+        flight.record("train.restore", severity="warn",
+                      restored_step=snap["global_step"],
+                      rollbacks=self.rollbacks)
 
     def membership_changed(self, epoch: Optional[int] = None):
         """Surface a membership-epoch bump (elastic shrink/grow) to the
@@ -174,8 +179,14 @@ class ResilientTrainStep:
         self.skipped_steps += 1
         self.rollbacks += 1
         self.last_step_skipped = True
+        monitor.stat_add("train_nan_skips_total")
+        flight.record("train.nan_skip", severity="warn",
+                      consecutive=self.consecutive_bad,
+                      skipped_total=self.skipped_steps)
         self.restore()
         if self.consecutive_bad >= self.max_consecutive_bad:
+            flight.record("train.abort", severity="error",
+                          consecutive=self.consecutive_bad)
             raise FloatingPointError(
                 f"ResilientTrainStep: {self.consecutive_bad} consecutive "
                 "non-finite steps — rollback cannot outrun a systematic "

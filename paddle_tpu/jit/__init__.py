@@ -521,10 +521,18 @@ class TrainStep:
         self._commit_step(losses, "TrainStep.multi_step", named_params,
                           new_params, named_buffers, new_buffers,
                           new_states)
-        self.optimizer._global_step += int(arrs[0].shape[0])
+        k = int(arrs[0].shape[0])
+        self.optimizer._global_step += k
+        from paddle_tpu.framework import monitor
+        monitor.stat_add("train_steps_total", k)
         return Tensor(losses)
 
     def __call__(self, *inputs):
+        import time as _time
+
+        from paddle_tpu.framework import monitor
+        from paddle_tpu.framework.observability import tracer
+        t_start = _time.perf_counter()
         named_params, named_buffers, params, buffers, arrs, key, lr = \
             self._prepare_dispatch(inputs)
         sig = _sig_of(list(named_params.values())) + _sig_of(arrs)
@@ -534,15 +542,21 @@ class TrainStep:
             self._cache[sig] = fn
         self._note_avals(fn, arrs, key)
         from paddle_tpu.profiler import RecordEvent
-        with RecordEvent("TrainStep"):
-            new_params, new_states, new_buffers, loss = fn(
-                params, self._opt_states, buffers, key, lr, *arrs)
+        with tracer.start_span(
+                "train.step",
+                attrs={"step": int(self.optimizer._global_step)}):
+            with RecordEvent("TrainStep"):
+                new_params, new_states, new_buffers, loss = fn(
+                    params, self._opt_states, buffers, key, lr, *arrs)
         # per-step sweep of the jitted tier (the eager per-op guard in
         # core.apply cannot see inside the fused step) — nan_inf_utils
         # role at step granularity; one scalar device->host sync.
         self._commit_step(loss, "TrainStep", named_params, new_params,
                           named_buffers, new_buffers, new_states)
         self.optimizer._global_step += 1
+        monitor.observe("train_step_ms",
+                        (_time.perf_counter() - t_start) * 1e3)
+        monitor.stat_add("train_steps_total")
         if self.optimizer._lr_scheduler is not None:
             pass  # user steps the scheduler explicitly, paddle-style
         return Tensor(loss)

@@ -35,9 +35,19 @@ _state = {
     "trace_dir": None,
 }
 _lock = threading.Lock()
-_events: Dict[str, List[float]] = {}          # name -> list of durations (s)
+# name -> [calls, total, min, max] running aggregates (seconds; calls is
+# an int) — O(1) memory per distinct name, however long the profiling run
+_events: Dict[str, list] = {}
 _spans: List[tuple] = []                      # (name, tid, t0, t1)
+_dropped = [0]                                # spans over the retention cap
 _t_start = [0.0]
+
+
+def _max_spans() -> int:
+    """Retention cap for the chrome-trace span list (the aggregate
+    table above is O(names) regardless).  FLAGS_profiler_max_spans."""
+    from paddle_tpu.framework.flags import flag
+    return int(flag("profiler_max_spans"))
 
 
 def is_profiling() -> bool:
@@ -69,10 +79,26 @@ class RecordEvent:
             self._ann.__exit__(*exc)
             self._ann = None
         if _state["on"]:
+            dur = t1 - self._t0
             with _lock:
-                _events.setdefault(self.name, []).append(t1 - self._t0)
-                _spans.append((self.name, threading.get_ident(),
-                               self._t0, t1))
+                e = _events.get(self.name)
+                if e is None:
+                    _events[self.name] = [1, dur, dur, dur]
+                else:
+                    e[0] += 1
+                    e[1] += dur
+                    if dur < e[2]:
+                        e[2] = dur
+                    if dur > e[3]:
+                        e[3] = dur
+                # the aggregate above keeps counting unconditionally;
+                # only the per-span timeline is bounded (long profiling
+                # runs must not grow host memory without limit)
+                if len(_spans) < _max_spans():
+                    _spans.append((self.name, threading.get_ident(),
+                                   self._t0, t1))
+                else:
+                    _dropped[0] += 1
         return False
 
     def __call__(self, fn):
@@ -95,6 +121,7 @@ def reset_profiler():
     with _lock:
         _events.clear()
         _spans.clear()
+        _dropped[0] = 0
     _t_start[0] = time.perf_counter()
 
 
@@ -145,11 +172,11 @@ def _print_report(sorted_key):
     with _lock:
         rows = []
         grand = 0.0
-        for name, durs in _events.items():
-            tot = sum(durs)
+        for name, (calls, tot, mn, mx) in _events.items():
             grand += tot
-            rows.append((name, len(durs), tot * 1e3, min(durs) * 1e3,
-                         max(durs) * 1e3, tot / len(durs) * 1e3))
+            rows.append((name, calls, tot * 1e3, mn * 1e3,
+                         mx * 1e3, tot / calls * 1e3))
+        dropped = _dropped[0]
     keyi = {"calls": 1, "total": 2, "min": 3, "max": 4, "ave": 5}
     if sorted_key:
         rows.sort(key=lambda r: r[keyi[sorted_key]], reverse=True)
@@ -164,6 +191,10 @@ def _print_report(sorted_key):
         ratio = tot / (grand * 1e3) if grand else 0.0
         print(f"{name:<32}{calls:>8}{tot:>12.4f}{mn:>10.4f}{mx:>10.4f}"
               f"{ave:>10.4f}{ratio:>10.6f}")
+    if dropped:
+        print(f"\n{dropped} span(s) dropped from the timeline "
+              f"(FLAGS_profiler_max_spans={_max_spans()}); the "
+              "aggregates above still count every event")
     if _state["trace_dir"]:
         print(f"\nDevice trace (TensorBoard/XProf): {_state['trace_dir']}")
 
@@ -173,11 +204,14 @@ def export_chrome_tracing(path: str = "/tmp/profile"):
     tools/timeline.py role (its _chrome_trace_format output)."""
     with _lock:
         spans = list(_spans)
+        dropped = _dropped[0]
     t0 = _t_start[0]
     events = [{"name": name, "ph": "X", "pid": 0, "tid": tid,
                "ts": (a - t0) * 1e6, "dur": (b - a) * 1e6,
                "cat": "host"} for name, tid, a, b in spans]
-    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    payload = {"traceEvents": events, "displayTimeUnit": "ms",
+               "metadata": {"dropped_spans": dropped,
+                            "max_spans": _max_spans()}}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

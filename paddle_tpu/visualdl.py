@@ -48,7 +48,8 @@ class LogWriter:
             self._tb.add_text(tag, text, global_step=step)
         else:
             self._jsonl.write(json.dumps(
-                {"tag": tag, "text": text, "step": step}) + "\n")
+                {"tag": tag, "text": text, "step": step,
+                 "time": time.time()}) + "\n")
             self._jsonl.flush()
 
     def add_histogram(self, tag: str, values, step: Optional[int] = None):
@@ -66,11 +67,16 @@ class LogWriter:
     def flush(self):
         if self._tb is not None:
             self._tb.flush()
+        if self._jsonl is not None and not self._jsonl.closed:
+            self._jsonl.flush()
 
     def close(self):
+        # idempotent: the context-manager exit and an explicit close()
+        # (or two callbacks sharing one writer) may both land here
         if self._tb is not None:
             self._tb.close()
-        if self._jsonl is not None:
+            self._tb = None
+        if self._jsonl is not None and not self._jsonl.closed:
             self._jsonl.close()
 
     def __enter__(self):

@@ -43,6 +43,50 @@ class TestLogWriter:
                 open(os.path.join(d, "scalars.jsonl"))]
         assert rows[0]["tag"] == "x" and rows[0]["value"] == 2.5
 
+    def _jsonl_writer(self, tmp_path, monkeypatch):
+        import builtins
+
+        import paddle_tpu.visualdl as vdl
+        real_import = builtins.__import__
+
+        def fake(name, *a, **k):
+            if name.startswith("torch"):
+                raise ImportError("no torch")
+            return real_import(name, *a, **k)
+        monkeypatch.setattr(builtins, "__import__", fake)
+        w = vdl.LogWriter(str(tmp_path / "log"))
+        monkeypatch.setattr(builtins, "__import__", real_import)
+        return w
+
+    def test_flush_flushes_jsonl_backend(self, tmp_path, monkeypatch):
+        w = self._jsonl_writer(tmp_path, monkeypatch)
+        w.add_scalar("y", 1.0, step=0)
+        w.flush()                             # must reach the jsonl too
+        path = os.path.join(w.logdir, "scalars.jsonl")
+        assert json.loads(open(path).readline())["tag"] == "y"
+        w.close()
+
+    def test_close_idempotent(self, tmp_path, monkeypatch):
+        w = self._jsonl_writer(tmp_path, monkeypatch)
+        w.add_scalar("z", 1.0)
+        w.close()
+        w.close()                             # second close must not raise
+        with LogWriter(str(tmp_path / "log2")) as w2:
+            w2.add_scalar("a", 1.0)
+            w2.close()                        # explicit close + __exit__
+
+    def test_add_text_records_time(self, tmp_path, monkeypatch):
+        import time
+        w = self._jsonl_writer(tmp_path, monkeypatch)
+        before = time.time()
+        w.add_text("config", "lr=0.1", step=2)
+        w.close()
+        (row,) = [json.loads(l) for l in
+                  open(os.path.join(w.logdir, "scalars.jsonl"))]
+        # parity with add_scalar: text records carry a wall-clock stamp
+        assert row["tag"] == "config" and row["text"] == "lr=0.1"
+        assert before <= row["time"] <= time.time()
+
 
 class TestVisualDLCallback:
     def test_fit_logs_metrics(self, tmp_path):

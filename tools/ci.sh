@@ -32,6 +32,13 @@ echo "== elastic membership/re-form lane (fixed seed, incl. slow) =="
 JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
     python -m pytest tests/test_elastic.py -q
 
+echo "== observability lane (traced mini train -> trace_merge -> schema; prometheus grammar) =="
+# 3-step mini train with tracing armed, per-process span file merged by
+# tools/trace_merge.py into a chrome trace that must pass the schema
+# check; monitor.export_prometheus() must round-trip through the
+# Prometheus text-format grammar (incl. cumulative-bucket invariants)
+JAX_PLATFORMS=cpu python tools/obs_check.py
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and

@@ -68,6 +68,8 @@ MODULES = [
     "paddle_tpu.regularizer",
     "paddle_tpu.framework.flags",
     "paddle_tpu.framework.crypto",
+    "paddle_tpu.framework.monitor",
+    "paddle_tpu.framework.observability",
     "paddle_tpu.distributed.fleet.metrics",
     "paddle_tpu.distributed.fleet.utils.fs",
     "paddle_tpu.utils.cpp_extension",

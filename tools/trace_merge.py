@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Merge per-process tracer span files into one chrome-trace JSON.
+
+Each process running with FLAGS_trace_dir (or an explicit
+``observability.Tracer``) appends finished spans to its own
+``trace_<label>.jsonl``.  This tool merges those files into a single
+chrome://tracing / Perfetto-loadable JSON:
+
+* one **pid lane per input file** (the process's label becomes the
+  lane name via a ``process_name`` metadata event);
+* **clock-offset correction**: each file's ``process`` meta record
+  carries the offset (seconds) measured against the reference clock
+  (``PsClient.sync_clock`` over the ``hello`` handshake); it is added
+  to every span timestamp so all lanes share one timeline;
+* span args keep the trace/span/parent ids and status, so a client
+  RPC and the server-side child it caused can be matched in the UI
+  (same ``trace``; child's ``parent`` == client span id).
+
+Usage::
+
+    python tools/trace_merge.py --out merged.json trace_a.jsonl ...
+    python tools/trace_merge.py --out merged.json --dir /tmp/traces
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+__all__ = ["load_span_file", "merge", "validate_chrome_trace", "main"]
+
+
+def load_span_file(path: str) -> Tuple[dict, List[dict]]:
+    """Read one tracer JSONL file → (process meta, span records).
+    Later ``process`` meta lines win (sync_clock re-emits with the
+    freshest offset); malformed lines are skipped, not fatal — a trace
+    torn by a crash should still merge."""
+    meta = {"label": os.path.basename(path), "pid": 0, "clock_offset": 0.0}
+    spans: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            kind = rec.get("kind")
+            if kind == "process":
+                meta.update({k: rec[k] for k in
+                             ("label", "pid", "clock_offset") if k in rec})
+            elif kind == "span":
+                spans.append(rec)
+    return meta, spans
+
+
+def merge(paths: List[str]) -> dict:
+    """Merge span files into one chrome-trace dict.  Lane pids are the
+    file index (stable and distinct even for in-process multi-role runs
+    that share one OS pid); real pids land in the lane metadata."""
+    events: List[dict] = []
+    lanes = []
+    for lane, path in enumerate(paths):
+        meta, spans = load_span_file(path)
+        lanes.append({"lane": lane, "file": os.path.basename(path),
+                      "label": meta["label"], "os_pid": meta["pid"],
+                      "clock_offset": meta["clock_offset"],
+                      "spans": len(spans)})
+        events.append({"name": "process_name", "ph": "M", "pid": lane,
+                       "tid": 0,
+                       "args": {"name": f"{meta['label']} "
+                                        f"(pid {meta['pid']})"}})
+        shift_us = float(meta["clock_offset"]) * 1e6
+        for sp in spans:
+            events.append({
+                "name": sp.get("name", "?"), "ph": "X", "pid": lane,
+                "tid": sp.get("tid", 0),
+                "ts": float(sp.get("ts", 0.0)) + shift_us,
+                "dur": float(sp.get("dur", 0.0)),
+                "cat": sp.get("status", "ok"),
+                "args": {"trace": sp.get("trace"), "span": sp.get("span"),
+                         "parent": sp.get("parent"),
+                         "status": sp.get("status"),
+                         **(sp.get("attrs") or {})}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"files": lanes}}
+
+
+def validate_chrome_trace(trace: dict) -> int:
+    """Schema check for the merged artifact (the CI lane's gate): a
+    ``traceEvents`` list of well-formed events — every event has a str
+    ``name``/``ph`` and int ``pid``; complete (``X``) events carry
+    numeric non-negative ``ts``/``dur``; metadata (``M``) events carry
+    ``args``.  Returns the number of ``X`` span events; raises
+    ``ValueError`` on the first violation."""
+    if not isinstance(trace, dict):
+        raise ValueError("trace must be a JSON object")
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("missing traceEvents list")
+    n_spans = 0
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"traceEvents[{i}]: not an object")
+        if not isinstance(ev.get("name"), str) or \
+                not isinstance(ev.get("ph"), str):
+            raise ValueError(f"traceEvents[{i}]: name/ph missing")
+        if not isinstance(ev.get("pid"), int):
+            raise ValueError(f"traceEvents[{i}]: pid must be an int")
+        if ev["ph"] == "X":
+            for k in ("ts", "dur"):
+                v = ev.get(k)
+                if not isinstance(v, (int, float)) or v < 0:
+                    raise ValueError(
+                        f"traceEvents[{i}]: X event needs numeric "
+                        f"non-negative {k}")
+            n_spans += 1
+        elif ev["ph"] == "M":
+            if not isinstance(ev.get("args"), dict):
+                raise ValueError(f"traceEvents[{i}]: M event needs args")
+    return n_spans
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("inputs", nargs="*", help="trace_*.jsonl span files")
+    ap.add_argument("--dir", default=None,
+                    help="merge every trace_*.jsonl under this directory")
+    ap.add_argument("--out", required=True, help="merged chrome-trace path")
+    a = ap.parse_args(argv)
+    paths = list(a.inputs)
+    if a.dir:
+        paths += sorted(glob.glob(os.path.join(a.dir, "trace_*.jsonl")))
+    if not paths:
+        print("trace_merge: no input span files", file=sys.stderr)
+        return 1
+    trace = merge(paths)
+    n = validate_chrome_trace(trace)
+    with open(a.out, "w") as f:
+        json.dump(trace, f)
+    traces = {e["args"].get("trace") for e in trace["traceEvents"]
+              if e["ph"] == "X"}
+    print(f"trace_merge: {len(paths)} file(s) -> {a.out} "
+          f"({n} spans, {len(traces)} trace ids)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
