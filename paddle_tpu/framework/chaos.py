@@ -21,6 +21,13 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         synchronous pull and replay the coalesced
                         push), ``mode="latency"`` a slow one the
                         consume path must simply wait out
+``data.pipeline``       each background fetch+transfer task of the
+                        streaming ingest plane (io/pipeline.py
+                        IngestPipeline) — ``mode="error"`` is a failed
+                        prefetch (the consumer must fall back to a
+                        synchronous fetch+transfer of the same batch:
+                        no sample lost, no duplicate), ``mode="latency"``
+                        a slow decode the wait stage simply absorbs
 ``fs.write``            crash-safe file writes (fleet/utils/fs.py
                         atomic_write)
 ``ckpt.save``           per-file checkpoint writes (distributed/
@@ -71,9 +78,9 @@ __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
            "register_fault_point", "known_fault_points",
            "payload_fault_points"]
 
-FAULT_POINTS = ("ps.rpc", "ps.pipeline", "fs.write", "ckpt.save",
-                "download.fetch", "train.step_grads", "elastic.lease",
-                "elastic.worker_hang")
+FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
+                "ckpt.save", "download.fetch", "train.step_grads",
+                "elastic.lease", "elastic.worker_hang")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

@@ -125,6 +125,28 @@ define_flag("ps_prefetch_depth", 1,
             "current step, coalesced with the previous step's push "
             "into one RPC round-trip per shard")
 
+# ingest tier (io/pipeline.py streaming data plane):
+define_flag("ingest_prefetch_depth", 1,
+            "max in-flight batches in IngestPipeline's double buffer "
+            "(decode+collate pulled from the loader and device-put on a "
+            "background executor while the chip runs the current step); "
+            "0 disables the overlap (synchronous fetch+transfer), 1 is "
+            "the classic double buffer")
+define_flag("ingest_cache_mode", "",
+            "decoded-sample cache for epoch >= 2: '' (off), 'memory' "
+            "(bounded in-RAM dict), or 'disk' (one crash-safe tmp+rename "
+            "file per sample under FLAGS_ingest_cache_dir).  Epoch 1 "
+            "records decoded tensors at cache granularity; later epochs "
+            "skip JPEG decode entirely on a hit")
+define_flag("ingest_cache_dir", "",
+            "directory for the disk-backed decoded-sample cache "
+            "(ingest_cache_mode='disk'); empty = a 'ingest_cache' dir "
+            "under the current directory")
+define_flag("ingest_cache_bytes", 1 << 30,
+            "byte bound on the decoded-sample cache (memory or disk): "
+            "inserts stop once the recorded payload bytes reach the "
+            "bound, so a cache can never eat the host")
+
 # observability tier (framework/observability.py + profiler):
 define_flag("trace_dir", "",
             "directory for distributed-tracing span files; non-empty "

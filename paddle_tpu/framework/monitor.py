@@ -13,7 +13,7 @@ from typing import Dict, Union
 
 __all__ = ["StatRegistry", "Histogram", "get_histogram", "observe",
            "all_histograms", "reset_all_histograms", "stat_add",
-           "stat_sub", "get_stat", "reset_stat", "all_stats",
+           "stat_sub", "stat_set", "get_stat", "reset_stat", "all_stats",
            "reset_all_stats", "export_prometheus"]
 
 Number = Union[int, float]
@@ -34,6 +34,10 @@ class _Stat:
     def decrease(self, v: Number = 1):
         with self._lock:
             self.value -= v
+
+    def set(self, v: Number):
+        with self._lock:
+            self.value = v
 
     def reset(self):
         with self._lock:
@@ -198,6 +202,13 @@ def stat_add(name: str, value: Number = 1):
 
 def stat_sub(name: str, value: Number = 1):
     StatRegistry.instance().get(name).decrease(value)
+
+
+def stat_set(name: str, value: Number):
+    """Overwrite the named stat (gauge semantics — e.g. the ingest
+    plane's ``input_stall_pct``, recomputed per batch rather than
+    accumulated)."""
+    StatRegistry.instance().get(name).set(value)
 
 
 def get_stat(name: str) -> Number:

@@ -24,14 +24,19 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info", "MultiSlotDataFeed"]
+           "get_worker_info", "numpy_collate", "MultiSlotDataFeed",
+           "IngestPipeline", "SampleCache", "CachedDataset"]
 
 
 def __getattr__(name):
-    # lazy: the native engine compiles its .so on first touch
+    # lazy: the native engine compiles its .so on first touch, and the
+    # ingest plane pulls in chaos/monitor/flags only when actually used
     if name == "MultiSlotDataFeed":
         from paddle_tpu.ops.native import MultiSlotDataFeed
         return MultiSlotDataFeed
+    if name in ("IngestPipeline", "SampleCache", "CachedDataset"):
+        from paddle_tpu.io import pipeline as _pipeline
+        return getattr(_pipeline, name)
     raise AttributeError(name)
 
 
@@ -104,11 +109,34 @@ class Subset(Dataset):
         return len(self.indices)
 
 
+def _as_np_rng(generator):
+    """Normalize a ``generator`` argument into a numpy Generator.
+
+    Accepts ``None`` (a fresh unseeded stream — the legacy global-
+    np.random behaviour, minus the cross-module state coupling), an int
+    seed, a ``np.random.Generator``, or a ``paddle_tpu.Generator``
+    (seeded from its key stream, so ``paddle.seed(n)`` makes loader
+    shuffles reproducible across elastic restarts)."""
+    if generator is None:
+        return np.random.default_rng()
+    if isinstance(generator, np.random.Generator):
+        return generator
+    if isinstance(generator, (int, np.integer)):
+        return np.random.default_rng(int(generator))
+    split = getattr(generator, "split", None)
+    if callable(split):                        # paddle_tpu.Generator
+        return np.random.default_rng(
+            np.asarray(split()).astype(np.uint64))
+    raise TypeError(
+        f"generator must be None, an int seed, numpy Generator, or "
+        f"paddle_tpu.Generator — got {type(generator).__name__}")
+
+
 def random_split(dataset, lengths, generator=None):
     total = sum(lengths)
     if total != len(dataset):
         raise ValueError("sum of lengths != dataset size")
-    perm = np.random.permutation(total)
+    perm = _as_np_rng(generator).permutation(total)
     out, off = [], 0
     for n in lengths:
         out.append(Subset(dataset, perm[off:off + n].tolist()))
@@ -133,11 +161,17 @@ class SequenceSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """``generator`` (int seed / numpy Generator / paddle Generator) is
+    the shuffle's RNG — a stateful stream, so consecutive epochs draw
+    different-but-reproducible permutations from one seed."""
+
     def __init__(self, data_source, replacement=False, num_samples=None,
                  generator=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        self.generator = generator
+        self._rng = _as_np_rng(generator) if generator is not None else None
 
     @property
     def num_samples(self):
@@ -145,9 +179,10 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        rng = self._rng if self._rng is not None else _as_np_rng(None)
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+            return iter(rng.integers(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[:self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
@@ -201,7 +236,17 @@ class BatchSampler(Sampler):
 class DistributedBatchSampler(BatchSampler):
     """Shards the dataset across data-parallel ranks (parity:
     python/paddle/io/DistributedBatchSampler; rank/nranks come from
-    paddle_tpu.distributed.ParallelEnv)."""
+    paddle_tpu.distributed.ParallelEnv).
+
+    **Elastic contract**: the global sample order for a data epoch
+    depends only on ``(shuffle seed, epoch)`` — never on membership —
+    and each rank's shard is a stride over the *unconsumed suffix* of
+    that order.  :meth:`reshard` moves the consumed-samples cursor and
+    adopts a new ``(rank, nranks, membership_epoch)``, so a mid-epoch
+    ``elastic.reform()`` re-partitions exactly the not-yet-trained
+    samples across the surviving ranks — deterministically, with no
+    sample lost and none duplicated (padding duplicates only ever land
+    in the final partial stride)."""
 
     def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
                  shuffle=False, drop_last=False):
@@ -217,15 +262,34 @@ class DistributedBatchSampler(BatchSampler):
         self.nranks = num_replicas
         self.local_rank = rank
         self.epoch = 0
-        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.membership_epoch = None
+        self._consumed = 0           # global samples behind the cursor
+        self._recount()
+
+    def _recount(self):
+        remaining = max(0, len(self.dataset) - self._consumed)
+        self.num_samples = int(math.ceil(remaining / self.nranks))
         self.total_size = self.num_samples * self.nranks
 
-    def __iter__(self):
+    def _global_indices(self):
+        """The epoch's membership-independent global sample order."""
         indices = list(range(len(self.dataset)))
         if self.shuffle:
             rng = np.random.RandomState(self.epoch)
             rng.shuffle(indices)
-        indices += indices[: self.total_size - len(indices)]
+        return indices
+
+    def __iter__(self):
+        indices = self._global_indices()[self._consumed:]
+        if not indices:
+            return
+        # pad by CYCLING to an even shard: the old `indices[:pad]` slice
+        # under-pads whenever pad > len(indices) (nranks > dataset),
+        # yielding unequal shards and a hang at the collective
+        pad = self.total_size - len(indices)
+        if pad > 0:
+            reps = -(-pad // len(indices))
+            indices = indices + (indices * reps)[:pad]
         indices = indices[self.local_rank::self.nranks]
         batch = []
         for idx in indices:
@@ -237,7 +301,29 @@ class DistributedBatchSampler(BatchSampler):
             yield batch
 
     def set_epoch(self, epoch):
+        """Start a fresh data epoch: new shuffle order, cursor reset."""
         self.epoch = epoch
+        self._consumed = 0
+        self._recount()
+
+    def reshard(self, rank, nranks, membership_epoch=None,
+                consumed_batches=0):
+        """Adopt a new membership mid-epoch.  ``consumed_batches`` is
+        the number of batches THIS sampler already yielded this epoch
+        (identical on every rank under data-parallel lockstep); the
+        consumed global prefix is ``consumed_batches × batch_size ×
+        old_nranks``, and the next ``__iter__`` yields only the
+        remaining samples, strided over the new ranks."""
+        self._consumed = min(
+            len(self.dataset),
+            self._consumed + int(consumed_batches) * self.batch_size
+            * self.nranks)
+        self.local_rank = int(rank)
+        self.nranks = int(nranks)
+        if membership_epoch is not None:
+            self.membership_epoch = int(membership_epoch)
+        self._recount()
+        return self._consumed
 
     def __len__(self):
         if self.drop_last:
@@ -250,6 +336,29 @@ _worker_info = threading.local()
 
 def get_worker_info():
     return getattr(_worker_info, "info", None)
+
+
+def numpy_collate(batch):
+    """Collate to contiguous numpy arrays — never a device tensor.
+
+    The worker-side collate of the ingest plane (io/pipeline.py): one
+    C-contiguous array per field instead of B per-sample objects, cheap
+    to pickle across the worker boundary, with the device transfer left
+    to the parent's pipelined ``device_put`` stage."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.ascontiguousarray(
+            np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.ascontiguousarray(np.stack(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [numpy_collate(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: numpy_collate([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, str):
+        return list(batch)
+    return np.asarray(batch)
 
 
 def default_collate_fn(batch):
@@ -279,7 +388,8 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, use_shared_memory=True,
                  prefetch_factor=2, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, use_process_workers=False):
+                 persistent_workers=False, use_process_workers=False,
+                 collate_in_worker=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -288,6 +398,23 @@ class DataLoader:
         # reference's _DataLoaderIterMultiProcess); False keeps the thread
         # pool, which is faster to start and fine for numpy-bound datasets
         self.use_process_workers = use_process_workers
+        # collate_in_worker=True → workers run a numpy-pure collate at
+        # batch granularity (collate_fn or numpy_collate) and ship ONE
+        # contiguous array per field; the loader then yields numpy
+        # batches (the ingest pipeline's transfer stage owns the device
+        # copy) and records worker-measured decode/collate wall time in
+        # self.last_stage_ms
+        self.collate_in_worker = collate_in_worker
+        if collate_in_worker and (not use_process_workers
+                                  or num_workers < 1):
+            raise ValueError("collate_in_worker=True requires "
+                             "use_process_workers=True and "
+                             "num_workers >= 1 (with num_workers=0 the "
+                             "loader decodes in-parent and the worker "
+                             "collate would silently never run)")
+        if collate_in_worker and collate_fn is None:
+            self.collate_fn = numpy_collate
+        self.last_stage_ms: dict = {}
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
         self.is_iterable = isinstance(dataset, IterableDataset)
@@ -393,12 +520,22 @@ class DataLoader:
     def _iter_multiprocess(self):
         """Real worker processes (dataloader_iter.py
         _DataLoaderIterMultiProcess): spawn children, feed index batches,
-        reorder results, collate in the parent (see io/_worker.py)."""
+        reorder results, collate in the parent — or, with
+        ``collate_in_worker=True``, receive worker-collated contiguous
+        numpy batches plus their measured decode/collate wall time (see
+        io/_worker.py).
+
+        Fault surface: a worker killed mid-epoch raises a clean
+        RuntimeError naming the worker (tickets map to workers
+        round-robin, so a dead child with an outstanding ticket and an
+        empty result queue can never be progress); ``timeout=`` bounds
+        the per-batch wait the same way."""
         import multiprocessing as mp
         import os
 
         from paddle_tpu.io._worker import ExceptionWrapper, worker_loop
 
+        worker_collate = self.collate_fn if self.collate_in_worker else None
         ctx = mp.get_context("spawn")
         os.environ["PADDLE_TPU_WORKER"] = "1"   # children must not take the chip
         try:
@@ -408,7 +545,7 @@ class DataLoader:
                 ctx.Process(
                     target=worker_loop,
                     args=(self.dataset, index_queues[w], result_queue,
-                          self.worker_init_fn, w),
+                          self.worker_init_fn, w, worker_collate),
                     daemon=True)
                 for w in range(self.num_workers)]
             for p in procs:
@@ -428,27 +565,57 @@ class DataLoader:
                     (sent, batches[sent]))
                 sent += 1
             for i in range(n):
+                waited = 0.0
                 while i not in pending:
-                    if not any(p.is_alive() for p in procs) and \
-                            result_queue.empty():
-                        raise RuntimeError("DataLoader workers died")
+                    poll = min(1.0, timeout) if timeout else 1.0
                     try:
-                        ticket, data = result_queue.get(timeout=timeout
-                                                        or 5.0)
+                        got = result_queue.get(timeout=poll)
                     except _queue.Empty:
-                        if timeout:
+                        waited += poll
+                        if timeout and waited >= timeout:
                             raise RuntimeError(
-                                f"DataLoader timed out after {timeout}s")
+                                f"DataLoader timed out after {timeout}s "
+                                f"waiting for batch {i}")
+                        # a dead worker with an outstanding ticket can
+                        # never produce it: surface a clean error, not
+                        # a hang (ticket t belongs to worker t % W)
+                        dead = {w for w in range(self.num_workers)
+                                if not procs[w].is_alive()}
+                        if dead and result_queue.empty():
+                            lost = [t for t in range(i, sent)
+                                    if t not in pending and
+                                    t % self.num_workers in dead]
+                            if lost:
+                                w = lost[0] % self.num_workers
+                                raise RuntimeError(
+                                    f"DataLoader worker {w} died "
+                                    f"(exitcode="
+                                    f"{procs[w].exitcode}) with batch "
+                                    f"{lost[0]} outstanding")
                         continue
-                    pending[ticket] = data
-                data = pending.pop(i)
+                    ticket, data = got[0], got[1]
+                    pending[ticket] = (data, got[2] if len(got) > 2
+                                       else None)
+                data, stage_ms = pending.pop(i)
                 if sent < n:
                     index_queues[sent % self.num_workers].put(
                         (sent, batches[sent]))
                     sent += 1
                 if isinstance(data, ExceptionWrapper):
                     data.reraise()
-                yield self.collate_fn(data)
+                if worker_collate is not None:
+                    self.last_stage_ms = stage_ms or {}
+                    # counters the worker recorded for this batch (e.g.
+                    # SampleCache hits/misses) — fold into the parent's
+                    # registry, the one export_prometheus() reads
+                    deltas = self.last_stage_ms.pop("stat_deltas", None)
+                    if deltas:
+                        from paddle_tpu.framework import monitor
+                        for name, delta in deltas.items():
+                            monitor.stat_add(name, delta)
+                    yield data          # already a contiguous numpy batch
+                else:
+                    yield self.collate_fn(data)
         finally:
             for q in index_queues:
                 try:

@@ -5,13 +5,26 @@ Reference: python/paddle/fluid/dataloader/dataloader_iter.py
 processes so python-heavy datasets/transforms escape the GIL, unlike the
 thread pool used for numpy-releasing workloads.
 
-Workers run *dataset indexing only* and ship raw (numpy/python) samples
-back; collation to device tensors happens in the parent, keeping jax
-arrays off the pickle path.  Children are spawned with PADDLE_TPU_WORKER=1
-so paddle_tpu forces the cpu platform and never contends for the chip.
+Two shipping modes:
+
+* **per-sample** (default, ``collate_fn=None``): workers run *dataset
+  indexing only* and ship raw (numpy/python) samples back; collation to
+  device tensors happens in the parent, keeping jax arrays off the
+  pickle path.
+* **in-worker collate** (``collate_fn=`` a numpy-pure callable, e.g.
+  ``io.numpy_collate``): the worker decodes+augments AND collates the
+  whole batch into contiguous numpy arrays before pickling — one large
+  array per field instead of B small ones, no per-sample pickling
+  overhead, and never a device tensor (the transfer stage belongs to the
+  parent's ingest pipeline).  Each result carries the measured decode
+  and collate wall time so the parent can export per-stage histograms.
+
+Children are spawned with PADDLE_TPU_WORKER=1 so paddle_tpu forces the
+cpu platform and never contends for the chip.
 """
 from __future__ import annotations
 
+import time
 import traceback
 
 
@@ -26,8 +39,24 @@ class ExceptionWrapper:
             f"DataLoader worker raised {self.type_name}:\n{self.msg}")
 
 
+_stat_snapshot: dict = {}
+
+
+def _drain_stat_deltas():
+    """Counter increments recorded in THIS worker process since the last
+    drain (a worker's monitor registry is otherwise invisible: the
+    parent's ``export_prometheus()`` reads only its own)."""
+    from paddle_tpu.framework import monitor
+    now = monitor.all_stats()
+    deltas = {k: v - _stat_snapshot.get(k, 0)
+              for k, v in now.items() if v != _stat_snapshot.get(k, 0)}
+    _stat_snapshot.clear()
+    _stat_snapshot.update(now)
+    return deltas
+
+
 def worker_loop(dataset, index_queue, result_queue, worker_init_fn,
-                worker_id: int):
+                worker_id: int, collate_fn=None):
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -36,7 +65,21 @@ def worker_loop(dataset, index_queue, result_queue, worker_init_fn,
             return
         ticket, indices = item
         try:
+            t0 = time.perf_counter()
             samples = [dataset[i] for i in indices]
-            result_queue.put((ticket, samples))
+            if collate_fn is None:
+                result_queue.put((ticket, samples))
+                continue
+            t1 = time.perf_counter()
+            batch = collate_fn(samples)
+            t2 = time.perf_counter()
+            # counters recorded THIS process (e.g. SampleCache hit/miss
+            # live in the worker) die with it — ship per-batch deltas so
+            # the parent's monitor registry, the one export_prometheus()
+            # reads, stays the single source of truth
+            result_queue.put((ticket, batch,
+                              {"decode_ms": (t1 - t0) * 1e3,
+                               "collate_ms": (t2 - t1) * 1e3,
+                               "stat_deltas": _drain_stat_deltas()}))
         except Exception as e:                # noqa: BLE001
             result_queue.put((ticket, ExceptionWrapper(e)))

@@ -1,0 +1,590 @@
+"""Streaming ingest plane: pipelined decode, double-buffered device
+transfer, and a decoded-sample cache.
+
+The dense file-fed path was the one tier without a real pipeline: the
+loader decoded JPEGs inline on the consumer thread, the device copy
+blocked the step, and epoch 2 re-paid every decode epoch 1 already did
+(BENCH_r05: 98.98% input stall on the filefed leg).  This module is the
+production rebuild, three stages behind one iterator:
+
+* **decode+collate** — owned by the :class:`~paddle_tpu.io.DataLoader`
+  (process workers with ``collate_in_worker=True`` decode, augment AND
+  collate at batch granularity, shipping one contiguous numpy array per
+  field — no per-sample pickling, never a per-sample device tensor);
+* **transfer** — :class:`IngestPipeline` runs ``fetch(N+1)`` +
+  ``device_put(N+1)`` on a background executor while the chip runs the
+  step on batch N — the same deferred-executor idiom as
+  ``PSTrainStep.prefetch`` (pull/compute overlap), with the same
+  ``flush()``/early-exit contract and a ``data.pipeline`` chaos point
+  at the head of every background task;
+* **cache** — :class:`SampleCache`/:class:`CachedDataset`: an opt-in,
+  byte-bounded decoded-sample cache (in-RAM dict or one crash-safe
+  tmp+rename file per sample) recorded during epoch 1 so epoch >= 2
+  skips JPEG decode entirely — what actually kills the stall on
+  core-starved hosts.
+
+Every stage is instrumented with the PR-5 observability plane: a tracer
+span per stage (``ingest.decode``, ``ingest.transfer``, ``ingest.wait``),
+per-stage time histograms (``ingest_decode_ms``, ``ingest_collate_ms``,
+``ingest_transfer_ms``, ``ingest_wait_ms``), cache hit/miss counters,
+and ``input_stall_pct`` as a first-class exported gauge
+(``monitor.export_prometheus()``) instead of a bench-only number.
+
+**Ordering/parity contract** (the PR-4 discipline): the pipelined stream
+is byte-identical to the plain sequential loader's — order, values,
+dtypes — for a fixed seed.  Fetches are sequence-stamped under one lock,
+the consumer reorders by stamp, and an injected ``data.pipeline`` fault
+degrades that one batch to a synchronous fetch+transfer on the consumer
+thread: no sample lost, none duplicated.  Combined with
+``DistributedBatchSampler.reshard`` (sample assignment derived from
+``(rank, nranks, membership_epoch)`` over the unconsumed suffix of a
+membership-independent epoch order), a mid-epoch ``elastic.reform()``
+re-shards deterministically: ``flush()`` the pipeline, ``reshard`` the
+sampler, re-enter — prefetched-but-unconsumed batches sit beyond the
+consumed cursor and are simply re-yielded under the new membership.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+import warnings
+from collections import deque
+from concurrent.futures import TimeoutError as FuturesTimeout
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from paddle_tpu.core import Tensor
+from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework.flags import flag
+from paddle_tpu.io import Dataset
+
+__all__ = ["IngestPipeline", "SampleCache", "CachedDataset", "to_device"]
+
+
+def to_device(batch):
+    """Default transfer stage: every numpy array in ``batch`` becomes a
+    device :class:`Tensor` (one ``jnp.asarray`` per FIELD, batch
+    granularity — XLA owns the copy stream); nested lists/tuples/dicts
+    map through, Tensors pass untouched."""
+    if isinstance(batch, Tensor):
+        return batch
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(to_device(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: to_device(v) for k, v in batch.items()}
+    return batch
+
+
+def _nbytes(sample) -> int:
+    if isinstance(sample, np.ndarray):
+        return sample.nbytes
+    if isinstance(sample, Tensor):
+        return int(sample._data.nbytes)         # device tensors count too
+    if isinstance(sample, (list, tuple)):
+        return sum(_nbytes(s) for s in sample)
+    if isinstance(sample, dict):
+        return sum(_nbytes(v) for v in sample.values())
+    return 16                                   # scalar/str: nominal
+
+
+class SampleCache:
+    """Bounded decoded-sample cache — epoch 1 records, epoch >= 2 hits.
+
+    ``mode``: ``"memory"`` (in-RAM dict, single-process), ``"disk"``
+    (one file per sample under ``cache_dir``, written crash-safely via
+    the fs tier's tmp+rename helper so a kill mid-insert leaves either
+    no file or a whole one — and shared across DataLoader worker
+    processes), or ``""``/None to read ``FLAGS_ingest_cache_mode``.
+    Inserts stop once recorded payload bytes reach ``max_bytes``
+    (``FLAGS_ingest_cache_bytes``), so a cache can never eat the host;
+    lookups past the bound simply miss.
+
+    Hit/miss totals land in the monitor registry
+    (``ingest_cache_hits_total`` / ``ingest_cache_misses_total``) so
+    they export through ``monitor.export_prometheus()``.  When the
+    cache runs inside DataLoader *worker processes* (disk mode), each
+    child counts into its own registry — the parent's
+    ``export_prometheus()`` reflects only parent-side lookups; and the
+    byte bound is enforced per process against the shared directory's
+    measured size (resynced every :data:`_RESYNC_EVERY` inserts), so
+    concurrent workers can overshoot ``max_bytes`` by at most one
+    resync window, never by a factor of the worker count.
+
+    A disk directory is stamped with the dataset's fingerprint (type
+    name + length) the first time a :class:`CachedDataset` binds it —
+    rebinding a dir recorded for a different dataset raises instead of
+    silently serving the old samples.  (Same-shaped different *content*
+    — e.g. regenerated files, a changed pre-cache transform — is not
+    detectable; point ``cache_dir`` somewhere fresh or :meth:`clear`
+    when the source changes.)
+    """
+
+    _RESYNC_EVERY = 64          # disk puts between directory re-scans
+
+    def __init__(self, mode: Optional[str] = None,
+                 cache_dir: Optional[str] = None,
+                 max_bytes: Optional[int] = None):
+        self.mode = str(flag("ingest_cache_mode")) if mode is None else mode
+        if self.mode not in ("", "memory", "disk"):
+            raise ValueError(
+                f"ingest cache mode must be '', 'memory' or 'disk' — "
+                f"got {self.mode!r}")
+        self.cache_dir = cache_dir or str(flag("ingest_cache_dir")) \
+            or os.path.join(os.getcwd(), "ingest_cache")
+        self.max_bytes = int(flag("ingest_cache_bytes")) \
+            if max_bytes is None else int(max_bytes)
+        self.bytes_used = 0
+        self.hits = 0
+        self.misses = 0
+        self._mem: dict = {}
+        self._puts = 0
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode in ("memory", "disk")
+
+    def _disk_path(self, key) -> str:
+        return os.path.join(self.cache_dir, f"s{key}.pkl")
+
+    def _disk_dir_bytes(self) -> int:
+        try:
+            with os.scandir(self.cache_dir) as it:
+                return sum(e.stat().st_size for e in it
+                           if e.name.startswith("s")
+                           and e.name.endswith(".pkl"))
+        except OSError:
+            return 0
+
+    def bind(self, dataset):
+        """Stamp a disk cache dir with ``dataset``'s fingerprint (type
+        name + length, crash-safe write); raise if the dir was recorded
+        for a different dataset — a stale cache must fail loudly, not
+        serve the previous run's samples."""
+        if self.mode != "disk":
+            return
+        fp = f"{type(dataset).__name__}:{len(dataset)}"
+        meta = os.path.join(self.cache_dir, "meta.json")
+        os.makedirs(self.cache_dir, exist_ok=True)
+        try:
+            with open(meta) as f:
+                recorded = json.load(f).get("fingerprint")
+            if recorded != fp:
+                raise ValueError(
+                    f"stale decoded-sample cache: {self.cache_dir!r} "
+                    f"was recorded for {recorded!r}, now binding "
+                    f"{fp!r} — clear() it or point "
+                    f"FLAGS_ingest_cache_dir somewhere fresh")
+            return
+        except (OSError, json.JSONDecodeError):
+            pass                                 # unstamped dir: stamp it
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+        try:
+            LocalFS().atomic_write(
+                meta, json.dumps({"fingerprint": fp}).encode())
+        except OSError:
+            pass                # unstampable (read-only dir): best effort
+
+    def get(self, key) -> Optional[Any]:
+        """The cached sample for ``key``, or None on a miss."""
+        if not self.enabled:
+            return None
+        if self.mode == "memory":
+            with self._lock:
+                sample = self._mem.get(key)
+        else:
+            try:
+                with open(self._disk_path(key), "rb") as f:
+                    sample = pickle.load(f)
+            except (OSError, pickle.PickleError, EOFError):
+                sample = None
+        if sample is None:
+            self.misses += 1
+            monitor.stat_add("ingest_cache_misses_total")
+            return None
+        self.hits += 1
+        monitor.stat_add("ingest_cache_hits_total")
+        return sample
+
+    def put(self, key, sample) -> bool:
+        """Record ``sample`` under ``key``; False when the byte bound is
+        reached (the cache stays a bounded accelerator, not a spill)."""
+        if not self.enabled:
+            return False
+        if self.mode == "memory":
+            size = _nbytes(sample)
+            with self._lock:
+                if key in self._mem:
+                    return True
+                if self.bytes_used + size > self.max_bytes:
+                    return False
+                self._mem[key] = sample
+                self.bytes_used += size
+            return True
+        blob = pickle.dumps(sample, protocol=4)
+        with self._lock:
+            # the directory is shared (across processes in worker mode):
+            # periodically re-measure it so every process enforces the
+            # bound against the TOTAL payload, not its own inserts
+            if self._puts % self._RESYNC_EVERY == 0:
+                self.bytes_used = self._disk_dir_bytes()
+            self._puts += 1
+            if self.bytes_used + len(blob) > self.max_bytes:
+                return False
+            self.bytes_used += len(blob)
+        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+        os.makedirs(self.cache_dir, exist_ok=True)
+        try:
+            LocalFS().atomic_write(self._disk_path(key), blob)
+        except OSError:
+            return False                # full disk: cache off, train on
+        return True
+
+    def clear(self):
+        with self._lock:
+            self._mem.clear()
+            self.bytes_used = 0
+            self._puts = 0
+        if self.mode == "disk" and os.path.isdir(self.cache_dir):
+            for name in os.listdir(self.cache_dir):
+                if name == "meta.json" or (name.startswith("s")
+                                           and name.endswith(".pkl")):
+                    try:
+                        os.unlink(os.path.join(self.cache_dir, name))
+                    except OSError:
+                        pass
+
+    # pickling (DataLoader spawn workers get the dataset by value): the
+    # lock is recreated; a memory cache arrives EMPTY in the child —
+    # only the disk mode is shared across worker processes
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["_lock"] = None
+        if self.mode == "memory":
+            warnings.warn(
+                "SampleCache(mode='memory') is crossing a process "
+                "boundary (DataLoader process workers?): it arrives "
+                "EMPTY in the child and worker-side inserts never "
+                "return, so the epoch>=2 decode skip will not happen — "
+                "use mode='disk' to share a cache across worker "
+                "processes", RuntimeWarning, stacklevel=2)
+            d["_mem"] = {}
+            d["bytes_used"] = 0
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+        self._puts = 0          # fresh process: resync on first put
+
+
+class CachedDataset(Dataset):
+    """Wrap ``dataset`` with a :class:`SampleCache`: the first access to
+    index ``i`` (epoch 1) pays the full ``dataset[i]`` — JPEG decode,
+    resize — and records the result; later epochs hit the cache and
+    skip decode entirely.  ``transform`` (optional) is applied AFTER
+    the cache on every access, so live augmentation stays live while
+    only the expensive decoded tensor is frozen."""
+
+    def __init__(self, dataset, cache: SampleCache,
+                 transform: Optional[Callable] = None):
+        self.dataset = dataset
+        self.cache = cache
+        self.transform = transform
+        cache.bind(dataset)     # disk mode: refuse a stale directory
+
+    def __getitem__(self, i):
+        sample = self.cache.get(i)
+        if sample is None:
+            sample = self.dataset[i]
+            self.cache.put(i, sample)
+        return self.transform(sample) if self.transform is not None \
+            else sample
+
+    def __len__(self):
+        return len(self.dataset)
+
+
+_DONE = object()      # background fetch hit the end of the stream
+_FAULTED = object()   # injected data.pipeline fault: loader untouched
+
+
+class IngestPipeline:
+    """Double-buffered host->device ingest over any batch iterable.
+
+    Wraps a loader (normally a :class:`~paddle_tpu.io.DataLoader` with
+    ``collate_in_worker=True`` yielding contiguous numpy batches) and
+    yields device batches, with fetch(N+1) + ``device_put``(N+1)
+    running on a background executor while the caller's step consumes
+    batch N — the ``PSTrainStep.prefetch`` deferred-executor idiom
+    applied to the input side::
+
+        pipe = IngestPipeline(loader)
+        for xb, yb in pipe:          # device Tensors, loader order
+            loss = step(xb, yb)
+        # pipe.input_stall_pct, monitor.get_stat("input_stall_pct")
+
+    ``prefetch_depth`` (``FLAGS_ingest_prefetch_depth``) bounds the
+    in-flight batches; 0 disables the overlap (synchronous
+    fetch+transfer, still instrumented), 1 is the classic double
+    buffer.  ``transfer`` replaces the default :func:`to_device` stage.
+    ``timeout`` (seconds) bounds the consumer's wait on a background
+    batch; the loader's own ``timeout=`` still governs its workers.
+
+    **Fault contract** — every background task fires the
+    ``data.pipeline`` chaos point first.  ``mode="error"`` degrades
+    that one batch to a synchronous fetch+transfer on the consumer
+    thread (the loader iterator was not advanced, so it is the SAME
+    batch: no sample lost, none duplicated — fetches are
+    sequence-stamped under one lock and the consumer reorders by
+    stamp); ``mode="latency"`` is a slow decode the wait stage simply
+    absorbs.  Any real exception from the loader (worker death, decode
+    error, loader timeout) propagates to the consumer after the
+    pipeline drains.
+
+    **Early exit / elastic** — breaking out of the iterator flushes the
+    background work (generator finalizer); :meth:`flush` is the
+    explicit form, the barrier to run before a mid-epoch
+    ``elastic.reform()``: flush, ``sampler.reshard(...)``, re-enter.
+    Prefetched-but-unconsumed batches are beyond the sampler's consumed
+    cursor, so the re-formed iteration re-yields exactly them.
+    """
+
+    def __init__(self, loader: Iterable,
+                 prefetch_depth: Optional[int] = None,
+                 transfer: Optional[Callable] = None,
+                 timeout: Optional[float] = None,
+                 tracer=None):
+        self.loader = loader
+        self.prefetch_depth = int(flag("ingest_prefetch_depth")) \
+            if prefetch_depth is None else int(prefetch_depth)
+        self.transfer = transfer or to_device
+        self.timeout = timeout
+        self._tracer = tracer
+        # lifetime stats (across epochs/iterations)
+        self.batches = 0
+        self.wait_ms_total = 0.0
+        self.downstream_ms_total = 0.0
+        self._active = None          # the live _Iteration, for flush()
+
+    def tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from paddle_tpu.framework import observability
+        return observability.tracer
+
+    # -- stall accounting ---------------------------------------------------
+    @property
+    def input_stall_pct(self) -> float:
+        """Share of consumer wall time spent blocked on input: wait /
+        (wait + downstream compute), over this pipeline's lifetime."""
+        total = self.wait_ms_total + self.downstream_ms_total
+        return 100.0 * self.wait_ms_total / total if total > 0 else 0.0
+
+    def _note_wait(self, wait_ms: float):
+        self.wait_ms_total += wait_ms
+        monitor.observe("ingest_wait_ms", wait_ms)
+        monitor.stat_set("input_stall_pct", self.input_stall_pct)
+
+    def _note_batch(self):
+        self.batches += 1
+        monitor.stat_add("ingest_batches_total")
+
+    # -- stage instrumentation ----------------------------------------------
+    @staticmethod
+    def _observe_stage_ms(stage, fetch_ms: float):
+        """Per-stage decode/collate histograms.  A worker-collate
+        DataLoader measured the stages inside the worker
+        (``last_stage_ms``, snapshotted by the caller under the fetch
+        lock — a concurrent fetch overwrites it); otherwise the whole
+        fetch is decode."""
+        monitor.observe("ingest_decode_ms",
+                        stage.get("decode_ms", fetch_ms))
+        monitor.observe("ingest_collate_ms", stage.get("collate_ms", 0.0))
+
+    def _fetch_transfer(self, it, lock, seq_box):
+        """One sequence-stamped fetch + device transfer.  Runs on the
+        background executor (pipelined) or inline on the consumer
+        thread (sync path / fault fallback); the lock serializes the
+        loader iterator and the stamp, so concurrent callers can never
+        skip or duplicate a batch."""
+        tr = self.tracer()
+        with lock:
+            seq = seq_box[0]
+            with tr.start_span("ingest.decode"):
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return _DONE
+                fetch_ms = (time.perf_counter() - t0) * 1e3
+            stage = dict(getattr(self.loader, "last_stage_ms", None) or {})
+            seq_box[0] += 1
+        self._observe_stage_ms(stage, fetch_ms)
+        with tr.start_span("ingest.transfer"):
+            t0 = time.perf_counter()
+            dev = self.transfer(batch)
+            monitor.observe("ingest_transfer_ms",
+                            (time.perf_counter() - t0) * 1e3)
+        return seq, dev
+
+    def _task(self, it, lock, seq_box):
+        """Background unit: chaos gate, then fetch+transfer.  The gate
+        fires BEFORE the loader is touched, so an injected error leaves
+        the iterator un-advanced and the consumer's synchronous
+        fallback fetches the exact batch this task would have."""
+        try:
+            chaos.fault_point("data.pipeline",
+                              meta={"seq": seq_box[0]})
+        except chaos.InjectedFault:
+            return _FAULTED
+        return self._fetch_transfer(it, lock, seq_box)
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self):
+        if self.prefetch_depth <= 0:
+            yield from self._iter_sync()
+            return
+        yield from self._iter_pipelined()
+
+    def _iter_sync(self):
+        it = iter(self.loader)
+        lock, seq_box = threading.Lock(), [0]
+        t_ret = None
+        while True:
+            if t_ret is not None:
+                self.downstream_ms_total += \
+                    (time.perf_counter() - t_ret) * 1e3
+            t0 = time.perf_counter()
+            got = self._fetch_transfer(it, lock, seq_box)
+            if got is _DONE:
+                return
+            self._note_wait((time.perf_counter() - t0) * 1e3)
+            self._note_batch()
+            t_ret = time.perf_counter()
+            yield got[1]
+
+    def _iter_pipelined(self):
+        from concurrent.futures import ThreadPoolExecutor
+        it = iter(self.loader)
+        lock, seq_box = threading.Lock(), [0]
+        pool = ThreadPoolExecutor(max_workers=1,
+                                  thread_name_prefix="ingest")
+        inflight: deque = deque()
+        state = {"pool": pool, "inflight": inflight, "it": it,
+                 "drain_timeout": self.timeout or 30.0}
+        self._active = state
+        tr = self.tracer()
+        expected = 0                  # next sequence stamp to yield
+        ready: dict = {}              # seq -> device batch (reordering)
+        exhausted = False
+        t_ret = None
+        try:
+            while True:
+                while not exhausted and \
+                        len(inflight) < self.prefetch_depth:
+                    inflight.append(pool.submit(
+                        self._task, it, lock, seq_box))
+                if t_ret is not None:
+                    self.downstream_ms_total += \
+                        (time.perf_counter() - t_ret) * 1e3
+                    t_ret = None
+                while expected not in ready:
+                    if not inflight:
+                        if exhausted:
+                            return
+                        raise RuntimeError(
+                            "ingest pipeline wedged: nothing in flight "
+                            f"while waiting for batch {expected}")
+                    fut = inflight.popleft()
+                    with tr.start_span("ingest.wait"):
+                        t0 = time.perf_counter()
+                        try:
+                            got = fut.result(timeout=self.timeout)
+                        except FuturesTimeout:
+                            raise RuntimeError(
+                                f"ingest pipeline timed out after "
+                                f"{self.timeout}s waiting for batch "
+                                f"{expected}") from None
+                        self._note_wait(
+                            (time.perf_counter() - t0) * 1e3)
+                    if got is _DONE:
+                        exhausted = True
+                    elif got is _FAULTED:
+                        # degraded batch: same-stream synchronous
+                        # fetch+transfer (see class docstring)
+                        monitor.stat_add("ingest_prefetch_misses_total")
+                        got = self._fetch_transfer(it, lock, seq_box)
+                        if got is _DONE:
+                            exhausted = True
+                        else:
+                            ready[got[0]] = got[1]
+                    else:
+                        monitor.stat_add("ingest_prefetch_hits_total")
+                        ready[got[0]] = got[1]
+                dev = ready.pop(expected)
+                expected += 1
+                self._note_batch()
+                t_ret = time.perf_counter()
+                yield dev
+        finally:
+            self._active = None
+            self._flush_state(state)
+
+    # -- flush / early-exit contract ----------------------------------------
+    @staticmethod
+    def _flush_state(state):
+        inflight, pool = state["inflight"], state["pool"]
+        for fut in inflight:
+            fut.cancel()
+        drained = True
+        for fut in inflight:
+            if not fut.cancelled():
+                try:
+                    fut.result(timeout=state["drain_timeout"])
+                except FuturesTimeout:
+                    drained = False     # fetch thread still in the loader
+                except Exception:       # noqa: BLE001 — draining only
+                    pass
+        inflight.clear()
+        pool.shutdown(wait=False)
+        if not drained:
+            # a background fetch is wedged inside the loader: the
+            # iterator generator is mid-execution (close() would raise
+            # 'generator already executing') and the thread may still
+            # touch the loader — the barrier cannot settle, so fail
+            # loudly instead of letting a reform race the loader
+            raise RuntimeError(
+                "ingest flush timed out: a background fetch is still "
+                f"running after {state['drain_timeout']}s — the loader "
+                "is wedged (dead worker? hung decode?); tear it down "
+                "instead of re-entering")
+        close = getattr(state["it"], "close", None)
+        if close is not None:
+            try:
+                close()
+            except ValueError:
+                # 'generator already executing': a fetch thread is in
+                # its last instants inside the loader (woke up between
+                # the drain and here, or a re-entrant flush during
+                # generator finalization) — it no longer has a future
+                # to deliver to, so abandoning the close is safe
+                pass
+
+    def flush(self):
+        """Settle all background work (the ``PSTrainStep.flush``
+        contract): cancel queued tasks, drain running ones, close the
+        loader iterator.  The barrier before a mid-epoch
+        ``elastic.reform()``/``sampler.reshard`` — after it, no
+        background thread touches the loader, and every un-yielded
+        batch is still unconsumed from the sampler's point of view."""
+        state = self._active
+        self._active = None
+        if state is not None:
+            self._flush_state(state)

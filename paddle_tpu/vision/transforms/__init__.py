@@ -1,9 +1,18 @@
 """Transforms (parity: python/paddle/vision/transforms/transforms.py +
 functional.py).
 
-Numpy-first: images are HWC uint8/float arrays (or CHW float after
-ToTensor); no PIL dependency — resize/crop are numpy/jax ops, so the same
-code runs in DataLoader workers and inside jit where needed.
+Numpy-first AND host-side: images are HWC uint8/float arrays (CHW
+float32 after ToTensor) and STAY numpy through the whole per-sample
+pipeline — a per-sample device tensor costs one host->device transfer
+per IMAGE (measured 1.5 img/s vs 22 img/s at batch granularity,
+perf/filefed_analysis.md), so the device conversion belongs to the
+loader's collate / the ingest pipeline's transfer stage, at batch
+granularity.  ``to_tensor``/``ToTensor`` therefore return a host
+ndarray by default (``out="tensor"`` restores the reference's
+per-sample Tensor for code that needs it).  ``resize`` routes uint8
+images through PIL's SIMD resize when PIL is present (~3x the numpy
+path); crop/flip/color ops are pure numpy, so the same code runs
+inside DataLoader worker processes.
 """
 from __future__ import annotations
 
@@ -36,7 +45,14 @@ def _as_hwc(img):
 # -- functional -------------------------------------------------------------
 
 
-def to_tensor(img, data_format="CHW"):
+def to_tensor(img, data_format="CHW", out="numpy"):
+    """HWC image -> float32 in [0,1], CHW by default.
+
+    ``out="numpy"`` (default) returns a HOST ndarray — the per-sample
+    pipeline must never mint a device tensor (one host->device RPC per
+    image; the loader's collate owns the transfer at batch
+    granularity).  ``out="tensor"`` restores the reference's per-sample
+    device Tensor."""
     img = _as_hwc(img)
     if img.dtype == np.uint8:
         img = img.astype(np.float32) / 255.0
@@ -44,7 +60,7 @@ def to_tensor(img, data_format="CHW"):
         img = img.astype(np.float32)
     if data_format == "CHW":
         img = img.transpose(2, 0, 1)
-    return Tensor(img)
+    return Tensor(img) if out == "tensor" else img
 
 
 def normalize(img, mean, std, data_format="CHW", to_rgb=False):
@@ -170,12 +186,18 @@ class Compose:
 
 
 class ToTensor(BaseTransform):
-    def __init__(self, data_format="CHW", keys=None):
+    """float32 [0,1] CHW conversion — host-side by default (see
+    :func:`to_tensor`): the output is a numpy array the collate stage
+    batches into ONE device transfer; ``out="tensor"`` restores the
+    per-sample device Tensor."""
+
+    def __init__(self, data_format="CHW", keys=None, out="numpy"):
         super().__init__(keys)
         self.data_format = data_format
+        self.out = out
 
     def _apply_image(self, img):
-        return to_tensor(img, self.data_format)
+        return to_tensor(img, self.data_format, out=self.out)
 
 
 class Normalize(BaseTransform):

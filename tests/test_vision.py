@@ -90,8 +90,14 @@ def test_transforms_pipeline():
     img = (np.random.default_rng(0).random((48, 40, 3)) * 255).astype(
         np.uint8)
     out = t(img)
-    assert out.shape == [3, 32, 32]
+    # host-side contract: the per-sample pipeline yields a numpy array
+    # (never a per-sample device tensor — the collate owns the device
+    # transfer at batch granularity)
+    assert isinstance(out, np.ndarray) and out.dtype == np.float32
+    assert tuple(out.shape) == (3, 32, 32)
     assert abs(float(out.mean())) < 2.0
+    dev = transforms.ToTensor(out="tensor")(img)
+    assert not isinstance(dev, np.ndarray)      # opt-in Tensor path
 
 
 def test_transforms_resize_bilinear_values():

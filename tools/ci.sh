@@ -39,6 +39,16 @@ echo "== observability lane (traced mini train -> trace_merge -> schema; prometh
 # Prometheus text-format grammar (incl. cumulative-bucket invariants)
 JAX_PLATFORMS=cpu python tools/obs_check.py
 
+echo "== ingest lane (JPEG corpus -> full pipeline; stall + cache gates) =="
+# a small on-disk JPEG corpus through the streaming ingest plane
+# (decode -> uint8 augment -> batch collate -> double-buffered device
+# transfer): asserts op_bench-style thresholds on the cache-epoch
+# speedup and the overlapped input_stall_pct, and that the gauge,
+# per-stage histograms and cache counters export via
+# monitor.export_prometheus(); parity/chaos/fault behavior is covered
+# by tests/test_ingest_pipeline.py in the pytest lane above
+JAX_PLATFORMS=cpu python tools/ingest_check.py
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and
@@ -48,7 +58,7 @@ echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # promote with --strict once the corpus has been warning-clean a while)
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
-    --zoo ps_transport \
+    --zoo ps_transport --zoo ingest \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="

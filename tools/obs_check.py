@@ -4,11 +4,16 @@
 End-to-end check of the tracing + metrics plane on a real (tiny) train:
 
 1. arm the process tracer, run a 3-step mini train (TrainStep emits a
-   ``train.step`` span per step);
+   ``train.step`` span per step) AND drain a small ingest pipeline
+   (io/pipeline.py emits a span per stage: ``ingest.decode``,
+   ``ingest.transfer``, ``ingest.wait``);
 2. merge the span file(s) with tools/trace_merge.py and validate the
-   chrome-trace schema;
+   chrome-trace schema — train-step and ingest-stage spans must both
+   appear in the merged trace;
 3. render ``monitor.export_prometheus()`` and validate it against the
-   Prometheus text-format grammar (plus histogram invariants).
+   Prometheus text-format grammar (plus histogram invariants) —
+   ``input_stall_pct``, the per-stage ingest histograms, and the cache
+   hit/miss counters must all export.
 
 Exits non-zero on any violation.  Deterministic, CPU-only, seconds.
 """
@@ -52,12 +57,41 @@ def mini_train(n_steps: int = STEPS):
     return [float(step(x, y)) for _ in range(n_steps)]
 
 
+INGEST_SPANS = ("ingest.decode", "ingest.transfer", "ingest.wait")
+INGEST_METRICS = ("input_stall_pct", "ingest_decode_ms_bucket",
+                  "ingest_collate_ms_bucket", "ingest_transfer_ms_bucket",
+                  "ingest_wait_ms_bucket", "ingest_cache_hits_total",
+                  "ingest_cache_misses_total")
+
+
+def mini_ingest():
+    """Two epochs of a cached, pipelined ingest drain — one pass to
+    record the sample cache, one to hit it, so the hit AND miss
+    counters both export."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.io.pipeline import (CachedDataset, IngestPipeline,
+                                        SampleCache)
+    rng = np.random.default_rng(0)
+    ds = TensorDataset([paddle.to_tensor(
+        rng.standard_normal((16, 4)).astype(np.float32))])
+    cds = CachedDataset(ds, SampleCache(mode="memory",
+                                        max_bytes=1 << 20))
+    n = 0
+    for _ in range(2):
+        pipe = IngestPipeline(DataLoader(cds, batch_size=4),
+                              prefetch_depth=1)
+        n += sum(1 for _ in pipe)
+    return n
+
+
 def main() -> int:
     with tempfile.TemporaryDirectory() as d:
-        # -- 1. traced mini train ------------------------------------------
+        # -- 1. traced mini train + ingest drain ---------------------------
         tracer.enable(os.path.join(d, "traces"), label="trainer")
         losses = mini_train()
         assert all(np.isfinite(losses)), f"mini train diverged: {losses}"
+        n_batches = mini_ingest()
+        assert n_batches == 8, f"ingest drain short: {n_batches}"
         span_file = tracer.path()
         tracer.disable()
         assert os.path.exists(span_file), "tracer wrote no span file"
@@ -74,8 +108,12 @@ def main() -> int:
                  if e["ph"] == "X"]
         assert names.count("train.step") >= STEPS, \
             f"expected >= {STEPS} train.step spans, got {names}"
+        for span in INGEST_SPANS:
+            assert span in names, \
+                f"ingest stage span {span!r} missing from merged trace"
         print(f"obs_check: chrome trace OK ({n_spans} spans, "
-              f"{names.count('train.step')} train.step)")
+              f"{names.count('train.step')} train.step, "
+              f"{sum(names.count(s) for s in INGEST_SPANS)} ingest.*)")
 
         # -- 3. prometheus export grammar ----------------------------------
         text = monitor.export_prometheus()
@@ -83,7 +121,10 @@ def main() -> int:
         assert "train_steps_total" in text, "steps counter not exported"
         assert "train_step_ms_bucket" in text, \
             "step-time histogram not exported"
-        print(f"obs_check: prometheus export OK ({n_samples} samples)")
+        for metric in INGEST_METRICS:
+            assert metric in text, f"{metric} not exported"
+        print(f"obs_check: prometheus export OK ({n_samples} samples, "
+              f"ingest metrics present)")
     print("obs_check: PASSED")
     return 0
 

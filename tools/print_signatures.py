@@ -43,6 +43,7 @@ MODULES = [
     "paddle_tpu.optimizer.lr",
     "paddle_tpu.tensor",
     "paddle_tpu.io",
+    "paddle_tpu.io.pipeline",
     "paddle_tpu.amp",
     "paddle_tpu.autograd",
     "paddle_tpu.jit",

@@ -50,6 +50,9 @@ ZOO = {
     # lints the chaos-threaded PS transport sources (ps.rpc /
     # ps.pipeline fault-point hygiene) — Report, like elastic_step
     "ps_transport": lambda: _zoo_ps_transport(),
+    # lints the streaming ingest plane sources (data.pipeline
+    # fault-point hygiene) — Report, like elastic_step
+    "ingest": lambda: _zoo_ingest(),
 }
 
 
@@ -139,6 +142,25 @@ def _zoo_ps_transport():
                              "service.py"),
                 os.path.join("paddle_tpu", "distributed", "ps",
                              "device_table.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_ingest():
+    """AST-lint the streaming ingest plane — the sources threading the
+    ``data.pipeline`` chaos fault point (IngestPipeline background
+    tasks, the worker-collate loader, the decoded-sample cache) — so
+    PTA301/302 validate the new fault-point site against the registry
+    and its retry-ownership pragma."""
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for rel in (os.path.join("paddle_tpu", "io", "pipeline.py"),
+                os.path.join("paddle_tpu", "io", "__init__.py"),
+                os.path.join("paddle_tpu", "io", "_worker.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:
