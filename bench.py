@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -65,14 +66,65 @@ _ARTIFACT = os.environ.get(
                  "BENCH_artifact.json"))
 
 
+_META = None
+
+
+def _run_meta():
+    """Run metadata stamped into the artifact, so a regression the
+    health plane flags is attributable to the change that caused it:
+    git sha (+dirty), host, active FLAGS overrides, versions.  Computed
+    once, every field best-effort — metadata must never fail a bench."""
+    global _META
+    if _META is not None:
+        return _META
+    import platform
+    import socket
+    import subprocess
+    import time as _t
+    meta = {"host": socket.gethostname(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+            "time": _t.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "argv": sys.argv[1:]}
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        meta["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo, capture_output=True,
+            text=True, timeout=10).stdout.strip() or None
+    except Exception:              # noqa: BLE001 — no git, shallow, etc.
+        meta["git_sha"] = None
+    try:
+        # independent of the sha: a slow/failed `git status` must not
+        # clobber an already-computed sha
+        meta["git_dirty"] = bool(subprocess.run(
+            ["git", "status", "--porcelain"], cwd=repo,
+            capture_output=True, text=True, timeout=10).stdout.strip())
+    except Exception:              # noqa: BLE001
+        meta["git_dirty"] = None
+    try:
+        import jax
+        meta["jax"] = jax.__version__
+    except Exception:              # noqa: BLE001
+        pass
+    try:
+        from paddle_tpu.framework import flags as _flags
+        meta["flags_overrides"] = _flags.overrides()
+    except Exception:              # noqa: BLE001
+        meta["flags_overrides"] = {}
+    _META = meta
+    return meta
+
+
 def _write_artifact(complete):
     try:
         tmp = _ARTIFACT + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump({"records": _RECORDS, "complete": complete},
-                      f, indent=1)
+            # default=str: a non-JSON-serializable flag override in the
+            # meta must degrade to its repr, not raise mid-bench
+            json.dump({"meta": _run_meta(), "records": _RECORDS,
+                       "complete": complete}, f, indent=1, default=str)
         os.replace(tmp, _ARTIFACT)
-    except OSError:
+    except Exception:              # noqa: BLE001
         pass                       # the artifact must never fail a bench
 
 

@@ -686,6 +686,36 @@ class ElasticAgent:
                 return h
         return None
 
+    def arm_hang_deadline(self, histogram: str = "train_step_ms",
+                          multiplier: float = 50.0, floor: float = 5.0,
+                          cap: Optional[float] = None) -> float:
+        """Arm the progress watchdog from the MEASURED step-time
+        distribution (framework.health discipline) instead of a
+        hardcoded budget: ``hang_deadline = clamp(multiplier *
+        p99(histogram) seconds, floor, cap)``.  A job whose steps take
+        50 ms gets a tight few-second deadline; one whose steps take
+        30 s is not falsely killed by a budget sized for the former.
+        Call after enough steps have landed in the histogram (e.g.
+        post-warmup, or after a re-form); raises RuntimeError on an
+        empty histogram — silently keeping the old deadline would look
+        exactly like a successful arming."""
+        from paddle_tpu.framework import monitor
+        h = monitor.get_histogram(histogram)
+        if not h.count:
+            raise RuntimeError(
+                f"arm_hang_deadline: histogram {histogram!r} has no "
+                "samples — run some steps before arming the measured "
+                "deadline")
+        p99_ms = h.percentile(0.99)
+        deadline = max(float(floor), float(multiplier) * p99_ms / 1e3)
+        if cap is not None:
+            deadline = min(deadline, float(cap))
+        self.hang_deadline = deadline
+        flight.record("elastic.deadline_armed", histogram=histogram,
+                      p99_ms=round(p99_ms, 3), samples=h.count,
+                      hang_deadline=round(deadline, 3))
+        return deadline
+
     def failed(self) -> bool:
         return bool(self._failed_names)
 

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import Parameter, Tensor, apply1
-from paddle_tpu.framework import monitor
+from paddle_tpu.framework import health, monitor
 from paddle_tpu.jit import not_to_static
 from paddle_tpu.distributed.ps.device_table import (
     DeviceEmbeddingTrainStep, MeshShardedEmbedding, mesh_sharded_lookup)
@@ -673,11 +673,13 @@ class PSTrainStep:
         got = self._settle_inflight(inf)
         if got is None:            # failed: span ended by the settle path
             monitor.stat_add("ps_prefetch_misses_total")
+            health.observe("ps_prefetch_miss", 1.0)
             return None
         if not _np.array_equal(inf["key"], ids_np):
             # stream reordered: rows are another batch's
             self._end_prefetch_span(inf, "error", reason="reordered")
             monitor.stat_add("ps_prefetch_misses_total")
+            health.observe("ps_prefetch_miss", 1.0)
             return None
         if client is not None and inf["epoch"] != client.epoch:
             # re-formed mid-flight: rows are stale, discard them
@@ -685,9 +687,11 @@ class PSTrainStep:
                                     issued_epoch=inf["epoch"],
                                     epoch=client.epoch)
             monitor.stat_add("ps_prefetch_misses_total")
+            health.observe("ps_prefetch_miss", 1.0)
             return None
         self._end_prefetch_span(inf, "ok")
         monitor.stat_add("ps_prefetch_hits_total")
+        health.observe("ps_prefetch_miss", 0.0)
         return got
 
     def _make_step(self, ids_shape):
@@ -724,9 +728,10 @@ class PSTrainStep:
                 attrs={"step": int(getattr(self.optimizer,
                                            "_global_step", 0))}):
             loss = self._call_inner(ids, *inputs)
-        monitor.observe("train_step_ms",
-                        (_time.perf_counter() - t_start) * 1e3)
+        step_ms = (_time.perf_counter() - t_start) * 1e3
+        monitor.observe("train_step_ms", step_ms)
         monitor.stat_add("train_steps_total")
+        health.observe("train_step_ms", step_ms)
         return loss
 
     def _call_inner(self, ids, *inputs):
@@ -766,14 +771,21 @@ class PSTrainStep:
         sig = (rows_u.shape, str(rows_u.dtype), ids_np.shape,
                tuple((a.shape, str(a.dtype)) for a in arrs))
         fn = self._cache.get(sig)
+        compile_cause = None
         if fn is None:
+            compile_cause = health.classify_recompile(
+                sig, list(self._cache))
             fn = self._cache[sig] = self._make_step(ids_np.shape)
+        else:
+            health.note_cache_hit("PSTrainStep")
         from paddle_tpu.tensor.random import default_generator
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())
-        new_params, self._opt_states, new_buffers, loss, drows_u = fn(
-            params, self._opt_states, buffers, key, lr,
-            jnp.asarray(rows_u), jnp.asarray(inv.astype(_np.int32)), *arrs)
+        with health.timed_compile("PSTrainStep", compile_cause):
+            new_params, self._opt_states, new_buffers, loss, drows_u = fn(
+                params, self._opt_states, buffers, key, lr,
+                jnp.asarray(rows_u), jnp.asarray(inv.astype(_np.int32)),
+                *arrs)
         # the chip is busy from here until the grad fetch below: issue
         # the announced next batch's shard fan-out NOW so its pull (and
         # the previous step's coalesced push) hides behind the device

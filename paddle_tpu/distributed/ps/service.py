@@ -34,7 +34,7 @@ import numpy as np
 from paddle_tpu.distributed.ps import HostEmbeddingTable
 from paddle_tpu.distributed.ps.device_table import (
     WIRE_DTYPES, dequantize_rows, normalize_wire, quantize_rows)
-from paddle_tpu.framework import chaos, monitor, observability
+from paddle_tpu.framework import chaos, health, monitor, observability
 from paddle_tpu.framework.flags import flag
 from paddle_tpu.framework.observability import flight
 
@@ -146,6 +146,11 @@ class TransportStats:
         if error:
             monitor.stat_add(f"ps_{self.role}_rpc_errors")
         monitor.observe(f"ps_{self.role}_rpc_ms_{op}", ms)
+        if self.role == "client":
+            # every client-side RPC latency feeds the health plane's
+            # straggler/storm detector (one stream across ops — an
+            # injected ps.rpc latency or a slow peer trips it)
+            health.observe("ps_rpc_ms", ms)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -499,6 +504,9 @@ class PsServer:
                     "wire_dtypes": list(WIRE_DTYPES),
                     "transport": self.transport.snapshot(),
                     "flight": flight.recent(32),
+                    # detector + compile-site state, so a worker set can
+                    # spot its straggler from one stat() call
+                    "health": health.snapshot(),
                     "epoch": self.epoch}, []
         if op == "bye":
             # a fenced job counts only CURRENT-epoch byes toward the

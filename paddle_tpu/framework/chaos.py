@@ -42,6 +42,13 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         ElasticWorkerContext.step_done) —
                         ``mode="latency"`` is a straggler/hung worker the
                         agent's hang deadline must catch
+``health.detector``     head of every health-plane observation
+                        (framework/health.py HealthMonitor.observe) —
+                        ``mode="error"`` is a broken detector the
+                        observe path must swallow and count (the
+                        watcher must never crash the watched train
+                        loop), ``mode="latency"`` a slow one the loop
+                        simply absorbs
 =====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
@@ -80,7 +87,8 @@ __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
 
 FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "ckpt.save", "download.fetch", "train.step_grads",
-                "elastic.lease", "elastic.worker_hang")
+                "elastic.lease", "elastic.worker_hang",
+                "health.detector")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

@@ -13,6 +13,7 @@ import threading
 from typing import Any, Dict
 
 _registry: Dict[str, Any] = {}
+_defaults: Dict[str, Any] = {}
 _lock = threading.Lock()
 
 
@@ -30,6 +31,7 @@ def define_flag(name: str, default, help_str: str = ""):
             value = env
     with _lock:
         _registry[name] = value
+        _defaults[name] = default
     return value
 
 
@@ -56,6 +58,16 @@ def set_flags(flags: dict):
 
 def flag(name: str):
     return _registry[name]
+
+
+def overrides() -> Dict[str, Any]:
+    """Every flag whose current value differs from its registered
+    default — whether env-seeded (FLAGS_<name>) or set at runtime
+    (set_flags).  This is what bench.py stamps into its artifact so a
+    regression is attributable to the configuration that produced it."""
+    with _lock:
+        return {n: v for n, v in _registry.items()
+                if n in _defaults and v != _defaults[n]}
 
 
 # the flags the reference exposes that still mean something on TPU
@@ -166,6 +178,35 @@ define_flag("metrics_export_interval", 30.0,
             "seconds between MetricsReporter writes of "
             "monitor.export_prometheus() to its textfile (atomic "
             "tmp+rename, scraper-safe)")
+# perf health tier (framework/health.py detectors + compile/memory
+# observability):
+define_flag("health_detectors", "",
+            "streaming anomaly detectors (framework/health.py): "
+            "'' = off, 'default' arms the built-in signal set "
+            "(train_step_ms, ps_rpc_ms, input_stall_pct, "
+            "ps_prefetch_miss), or a JSON object "
+            "'{\"signal\": {detector kwargs}}' for a custom set.  Env "
+            "form lets a launcher arm a whole child-process tree")
+define_flag("health_warmup", 16,
+            "baseline samples a health.Detector collects before it "
+            "starts scoring (per signal; the warmup absorbs compile "
+            "steps and cold caches)")
+define_flag("health_z_threshold", 8.0,
+            "robust MAD z-score at which a health.Detector flags an "
+            "anomaly (per-signal override via the detector spec)")
+define_flag("health_compile_warmup_calls", 10,
+            "calls per jit site within which recompiles count as "
+            "warmup (shape bucketing, lazy first use); a recompile "
+            "past this window is steady-state "
+            "(jit_recompiles_steady_total) and feeds the "
+            "compile-storm detector")
+define_flag("health_compile_storm_k", 3,
+            "post-warmup recompiles at one jit site that constitute a "
+            "compile storm (health.compile_storm flight event)")
+define_flag("health_mem_sample_every", 0,
+            "sample jax.live_arrays() into device_mem_* gauges every "
+            "N train steps (health.MemoryTracker); 0 disables the "
+            "per-step hook (sample() stays callable directly)")
 define_flag("profiler_max_spans", 100000,
             "cap on retained chrome-trace spans per profiling session; "
             "beyond it spans are dropped (counted — the Profiling "

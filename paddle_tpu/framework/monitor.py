@@ -14,7 +14,7 @@ from typing import Dict, Union
 __all__ = ["StatRegistry", "Histogram", "get_histogram", "observe",
            "all_histograms", "reset_all_histograms", "stat_add",
            "stat_sub", "stat_set", "get_stat", "reset_stat", "all_stats",
-           "reset_all_stats", "export_prometheus"]
+           "reset_all_stats", "export_prometheus", "snapshot"]
 
 Number = Union[int, float]
 
@@ -225,6 +225,24 @@ def all_stats() -> Dict[str, Number]:
 
 def reset_all_stats():
     StatRegistry.instance().reset_all()
+
+
+def snapshot() -> Dict[str, dict]:
+    """One JSON-able capture of the whole registry: every stat value
+    plus every histogram's summary AND raw buckets — the metrics
+    snapshot ``tools/health_check.py`` consumes (richer than the
+    Prometheus rendering: percentiles come pre-interpolated and the
+    bucket arrays survive round-tripping)."""
+    with _hist_lock:
+        hs = sorted(_hists.items())
+    hists = {}
+    for name, h in hs:
+        bounds, counts, count, total = h.buckets()
+        rec = h.summary()
+        rec["bounds"] = bounds
+        rec["bucket_counts"] = counts
+        hists[name] = rec
+    return {"stats": all_stats(), "histograms": hists}
 
 
 # ---------------------------------------------------------------------------

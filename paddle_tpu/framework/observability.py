@@ -369,7 +369,11 @@ class FlightRecorder:
     def __init__(self, capacity: Optional[int] = None):
         self._capacity = capacity
         self._ring = None                     # lazy: flag read at first use
-        self._lock = threading.Lock()
+        # reentrant: the SIGTERM crash handler dumps the recorder from
+        # a signal frame that may interrupt the main thread mid-record
+        # — a plain Lock would self-deadlock exactly when the launcher
+        # kills a hung child
+        self._lock = threading.RLock()
         self.dropped = 0
 
     def _buf(self) -> "collections.deque":
@@ -391,11 +395,27 @@ class FlightRecorder:
             buf.append(ev)
         return ev
 
-    def recent(self, n: int = 50) -> List[dict]:
-        """The most recent ``n`` events, oldest first."""
+    def recent(self, n: int = 50, kind: Optional[str] = None,
+               min_severity: Optional[str] = None) -> List[dict]:
+        """The most recent ``n`` events, oldest first.  ``kind`` keeps
+        only events of that kind; ``min_severity`` drops events below
+        the floor (severity order: debug < info < warn < error) — so a
+        post-mortem query like ``recent(20, min_severity="warn")``
+        skips the routine chatter."""
         with self._lock:
             buf = list(self._buf())
-        return buf[-max(0, int(n)):]
+        if kind is not None:
+            buf = [ev for ev in buf if ev["kind"] == kind]
+        if min_severity is not None:
+            if min_severity not in _SEVERITIES:
+                raise ValueError(
+                    f"unknown severity {min_severity!r} — one of "
+                    f"{_SEVERITIES}")
+            floor = _SEVERITIES.index(min_severity)
+            buf = [ev for ev in buf
+                   if _SEVERITIES.index(ev["severity"]) >= floor]
+        n = int(n)
+        return buf[-n:] if n > 0 else []
 
     def clear(self):
         with self._lock:
@@ -427,14 +447,22 @@ flight = FlightRecorder()
 
 def install_crash_handler(worker: Optional[str] = None,
                           flight_dir: Optional[str] = None,
-                          chain: bool = True):
+                          chain: bool = True, sigterm: bool = True):
     """Hook ``sys.excepthook`` so an uncaught exception dumps the flight
     recorder to ``<flight_dir>/flight_<worker>.json`` before the normal
     traceback.  ``worker`` defaults to the elastic worker id the
     launcher exported (``PADDLE_ELASTIC_WORKER_ID``) or ``pid<n>``;
     ``flight_dir`` to ``FLAGS_flight_dir`` (cwd when empty).  Returns
     the installed hook (tests call it directly; ``chain=False``
-    suppresses the chained traceback print)."""
+    suppresses the chained traceback print).
+
+    ``sigterm=True`` (default) additionally dumps on SIGTERM: a hung
+    child the launcher/agent kills never reaches the excepthook, and a
+    post-mortem with no flight file is exactly when one is needed.  The
+    SIGTERM dump chains to the previously installed handler — or, under
+    the default disposition, restores it and re-delivers the signal so
+    the exit status still says SIGTERM.  Installing from a non-main
+    thread skips the signal hook (the excepthook still installs)."""
     import sys
     worker_id = worker or os.environ.get("PADDLE_ELASTIC_WORKER_ID") \
         or f"pid{os.getpid()}"
@@ -442,18 +470,43 @@ def install_crash_handler(worker: Optional[str] = None,
         (str(flag("flight_dir")) or ".")
     prev = sys.excepthook
 
-    def hook(exc_type, exc, tb):
-        flight.record("crash", severity="error",
-                      exc=repr(exc), worker=worker_id)
+    def _dump(kind: str, **attrs):
+        flight.record(kind, severity="error", worker=worker_id, **attrs)
         try:
             flight.dump(os.path.join(base, f"flight_{worker_id}.json"),
                         worker=worker_id)
         except OSError:
             pass                    # a full disk must not mask the crash
+
+    def hook(exc_type, exc, tb):
+        _dump("crash", exc=repr(exc))
         if chain:
             prev(exc_type, exc, tb)
 
     sys.excepthook = hook
+    if sigterm:
+        import signal as _signal
+        prev_term = _signal.getsignal(_signal.SIGTERM)
+
+        def term_hook(signum, frame):
+            _dump("sigterm")
+            if callable(prev_term):
+                prev_term(signum, frame)
+            elif prev_term is _signal.SIG_IGN:
+                # explicitly ignored before we installed: the dump must
+                # not turn an ignored SIGTERM into process death
+                return
+            else:
+                # default disposition (or a handler we cannot chain):
+                # restore and re-deliver, so the process still dies
+                # with the SIGTERM exit status the supervisor expects
+                _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+                os.kill(os.getpid(), _signal.SIGTERM)
+
+        try:
+            _signal.signal(_signal.SIGTERM, term_hook)
+        except ValueError:
+            pass                    # non-main thread: no signal hook
     return hook
 
 

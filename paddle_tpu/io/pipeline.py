@@ -58,7 +58,7 @@ from typing import Any, Callable, Iterable, Optional
 import numpy as np
 
 from paddle_tpu.core import Tensor
-from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework import chaos, health, monitor
 from paddle_tpu.framework.flags import flag
 from paddle_tpu.io import Dataset
 
@@ -391,6 +391,7 @@ class IngestPipeline:
         self.wait_ms_total += wait_ms
         monitor.observe("ingest_wait_ms", wait_ms)
         monitor.stat_set("input_stall_pct", self.input_stall_pct)
+        health.observe("input_stall_pct", self.input_stall_pct)
 
     def _note_batch(self):
         self.batches += 1
@@ -432,6 +433,12 @@ class IngestPipeline:
             dev = self.transfer(batch)
             monitor.observe("ingest_transfer_ms",
                             (time.perf_counter() - t0) * 1e3)
+        if int(flag("health_mem_sample_every")) > 0:
+            # attribute the in-flight device batch to the ingest tag
+            # (metadata walk only — no device sync); same switch as
+            # the TrainStep memory hook, so the tags snapshot and the
+            # live/peak gauges it annotates turn on together
+            health.memory.track("ingest", _nbytes(dev))
         return seq, dev
 
     def _task(self, it, lock, seq_box):

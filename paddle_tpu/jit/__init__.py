@@ -190,14 +190,23 @@ class StaticFunction:
                (training,
                 tuple(sorted((k, _hashable(v)) for k, v in kwargs.items()))))
 
+        from paddle_tpu.framework import health
+        site = f"to_static:{getattr(self._function, '__name__', '?')}"
         entry = self._cache.get(sig)
+        compile_cause = None
         if entry is None:
+            # a cache miss is an XLA compile: attribute the cause by
+            # diffing against the cached signatures BEFORE inserting
+            compile_cause = health.classify_recompile(
+                sig, list(self._cache))
             out_meta: list = []
             jitted = self._build(sig, len(named_params), len(named_buffers),
                                  param_names, buffer_names, static_args,
                                  kwargs, out_meta)
             entry = {"fn": jitted, "out_meta": out_meta}
             self._cache[sig] = entry
+        else:
+            health.note_cache_hit(site)
 
         key = default_generator.split()
         n_p, n_b = len(named_params), len(named_buffers)
@@ -206,12 +215,16 @@ class StaticFunction:
         buffer_tensors = [b for _, b in named_buffers]
         all_inputs = param_tensors + buffer_tensors + tensor_args
 
-        # run through the tape: one node for the whole compiled block
+        # run through the tape: one node for the whole compiled block.
+        # On a cache miss the first dispatch of the fresh executable
+        # (trace+compile+run) is timed into compile_ms and spanned as
+        # jit.compile; on a hit timed_compile is a no-op context.
         fn = entry["fn"]
-        outs = apply(lambda *arrs: fn(arrs[0], *arrs[1:]), Tensor(key),
-                     *all_inputs, nondiff=(0,) + tuple(
-                         i + 1 for i in range(n_p, n_p + n_b)),
-                     name="to_static")
+        with health.timed_compile(site, compile_cause):
+            outs = apply(lambda *arrs: fn(arrs[0], *arrs[1:]), Tensor(key),
+                         *all_inputs, nondiff=(0,) + tuple(
+                             i + 1 for i in range(n_p, n_p + n_b)),
+                         name="to_static")
         treedef = entry["out_meta"][0]
         n_out = treedef.num_leaves
         out_tensors = list(outs[:n_out])
@@ -502,20 +515,28 @@ class TrainStep:
         different — equally independent — randomness than K sequential
         ``__call__``s, and the host generator advances once, not K times.
         """
+        from paddle_tpu.framework import health
         named_params, named_buffers, params, buffers, arrs, key, lr = \
             self._prepare_dispatch(inputs)
         sig = ("multi", bool(unroll)) + _sig_of(list(named_params.values())) \
             + _sig_of(arrs)
         fn = self._cache.get(sig)
+        compile_cause = None
         if fn is None:
+            compile_cause = health.classify_recompile(
+                sig, [s for s in self._cache if s and s[0] == "multi"])
             scan_fn, unrolled_fn = self._make_multi_step()
             fn = unrolled_fn if unroll else scan_fn
             self._cache[sig] = fn
+        else:
+            health.note_cache_hit("TrainStep.multi_step")
         self._note_avals(fn, arrs, key)
         from paddle_tpu.profiler import RecordEvent
         with RecordEvent("TrainStep.multi_step"):
-            new_params, new_states, new_buffers, losses = fn(
-                params, self._opt_states, buffers, key, lr, *arrs)
+            with health.timed_compile("TrainStep.multi_step",
+                                      compile_cause):
+                new_params, new_states, new_buffers, losses = fn(
+                    params, self._opt_states, buffers, key, lr, *arrs)
         # same per-step guard as __call__, swept over the K losses in one
         # host sync
         self._commit_step(losses, "TrainStep.multi_step", named_params,
@@ -530,33 +551,50 @@ class TrainStep:
     def __call__(self, *inputs):
         import time as _time
 
-        from paddle_tpu.framework import monitor
+        from paddle_tpu.framework import health, monitor
         from paddle_tpu.framework.observability import tracer
         t_start = _time.perf_counter()
         named_params, named_buffers, params, buffers, arrs, key, lr = \
             self._prepare_dispatch(inputs)
         sig = _sig_of(list(named_params.values())) + _sig_of(arrs)
         fn = self._cache.get(sig)
+        compile_cause = None
         if fn is None:
+            # miss = XLA compile: classify the recompile cause against
+            # the cached signatures before this one is inserted
+            compile_cause = health.classify_recompile(
+                sig, [s for s in self._cache
+                      if not (s and s[0] == "multi")])
             fn = self._make_step()
             self._cache[sig] = fn
+        else:
+            health.note_cache_hit("TrainStep")
         self._note_avals(fn, arrs, key)
         from paddle_tpu.profiler import RecordEvent
         with tracer.start_span(
                 "train.step",
                 attrs={"step": int(self.optimizer._global_step)}):
             with RecordEvent("TrainStep"):
-                new_params, new_states, new_buffers, loss = fn(
-                    params, self._opt_states, buffers, key, lr, *arrs)
+                with health.timed_compile("TrainStep", compile_cause):
+                    new_params, new_states, new_buffers, loss = fn(
+                        params, self._opt_states, buffers, key, lr, *arrs)
         # per-step sweep of the jitted tier (the eager per-op guard in
         # core.apply cannot see inside the fused step) — nan_inf_utils
         # role at step granularity; one scalar device->host sync.
         self._commit_step(loss, "TrainStep", named_params, new_params,
                           named_buffers, new_buffers, new_states)
         self.optimizer._global_step += 1
-        monitor.observe("train_step_ms",
-                        (_time.perf_counter() - t_start) * 1e3)
+        step_ms = (_time.perf_counter() - t_start) * 1e3
+        monitor.observe("train_step_ms", step_ms)
         monitor.stat_add("train_steps_total")
+        health.observe("train_step_ms", step_ms)
+        health.maybe_sample_memory(lambda: {
+            "params": sum(int(p._data.nbytes)
+                          for p in named_params.values()),
+            "opt_state": sum(int(x.nbytes) for x in
+                             jax.tree_util.tree_leaves(self._opt_states)),
+            "buffers": sum(int(b._data.nbytes)
+                           for b in named_buffers.values())})
         if self.optimizer._lr_scheduler is not None:
             pass  # user steps the scheduler explicitly, paddle-style
         return Tensor(loss)

@@ -49,6 +49,15 @@ echo "== ingest lane (JPEG corpus -> full pipeline; stall + cache gates) =="
 # by tests/test_ingest_pipeline.py in the pytest lane above
 JAX_PLATFORMS=cpu python tools/ingest_check.py
 
+echo "== perf health lane (traced mini train -> health_check; zero anomalies, zero steady recompiles) =="
+# the health plane's decision surface end-to-end: a fixed-seed,
+# fixed-shape mini train with the default detectors armed must compile
+# once per jit site and trip nothing — a steady-state recompile or a
+# detector anomaly on a healthy run fails here (the same gate the
+# acceptance test drives with injected ps.rpc latency, inverted)
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 30 \
+    --max-anomalies 0 --max-steady-recompiles 0
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and
@@ -58,7 +67,7 @@ echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # promote with --strict once the corpus has been warning-clean a while)
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
-    --zoo ps_transport --zoo ingest \
+    --zoo ps_transport --zoo ingest --zoo health \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="

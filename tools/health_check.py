@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""Health report CLI — the perf health plane's decision surface.
+
+Renders one report (text or JSON) from a metrics snapshot and/or a
+trace directory, and exits nonzero when a gate trips — so CI, a
+launcher wrapper, and the future autotuner all consume the same
+verdict the detectors produce:
+
+* **anomalies** — ``health_anomalies_total`` (+ the per-signal
+  ``health_anomaly_<signal>_total`` split) from the streaming
+  detectors (framework/health.py);
+* **compiles** — ``jit_compiles_total`` / ``jit_cache_hits_total`` /
+  per-cause counters, the ``compile_ms`` histogram, and the
+  steady-state recompile count the compile-storm detector feeds;
+* **memory** — ``device_mem_live_bytes`` / ``device_mem_peak_bytes``
+  and the per-tag attribution gauges;
+* **spans** — the per-span-name aggregate table
+  (``tools/trace_merge.py summarize``) over ``--trace-dir``.
+
+Inputs:
+
+* ``--metrics FILE`` — a ``monitor.snapshot()`` JSON file, or a
+  Prometheus text rendering (``MetricsReporter`` output; gauges and
+  ``_total`` counters are read, histogram summaries need the JSON
+  form);
+* ``--trace-dir DIR`` — per-process ``trace_*.jsonl`` span files;
+* ``--mini-train N`` — self-contained mode: run a traced N-step mini
+  train with the default detectors armed, snapshot, and evaluate
+  in-process (the CI health lane; no files needed).
+
+Gates (any trip → exit 1): ``--max-anomalies`` (default 0),
+``--max-steady-recompiles`` (default 0), ``--max-input-stall``
+(percent; off by default).
+
+Usage::
+
+    python tools/health_check.py --mini-train 30
+    python tools/health_check.py --metrics snap.json --trace-dir /tmp/tr
+    python tools/health_check.py --metrics metrics.prom --format json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+__all__ = ["load_metrics", "build_report", "evaluate_gates",
+           "format_report", "mini_train", "main"]
+
+
+# ---------------------------------------------------------------------------
+# input loading
+# ---------------------------------------------------------------------------
+
+def _parse_prometheus(text: str) -> dict:
+    """Reduce a Prometheus text rendering to the snapshot shape: plain
+    samples become stats; histogram ``_sum``/``_count`` pairs become
+    minimal histogram records (no percentiles — the JSON snapshot form
+    carries those)."""
+    stats = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#") or "{" in line:
+            continue
+        parts = line.split()
+        if len(parts) < 2:
+            continue
+        try:
+            stats[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    hists = {}
+    for name, v in list(stats.items()):
+        if name.endswith("_count") and name[:-len("_count")] + "_sum" \
+                in stats:
+            base = name[:-len("_count")]
+            count = int(v)
+            total = stats[base + "_sum"]
+            hists[base] = {"count": count, "sum": total,
+                           "mean": total / count if count else 0.0}
+    return {"stats": stats, "histograms": hists}
+
+
+def load_metrics(path: str) -> dict:
+    """Load a metrics snapshot: ``monitor.snapshot()`` JSON or a
+    Prometheus text file (sniffed by the leading character)."""
+    with open(path) as f:
+        text = f.read()
+    if text.lstrip().startswith("{"):
+        snap = json.loads(text)
+        snap.setdefault("stats", {})
+        snap.setdefault("histograms", {})
+        return snap
+    return _parse_prometheus(text)
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+def build_report(snap: dict, trace_dir: Optional[str] = None,
+                 health_snapshot: Optional[dict] = None) -> dict:
+    """Fold a metrics snapshot (+ optional trace dir and live health
+    state) into the report dict the gates and renderers consume."""
+    stats = snap.get("stats", {})
+    hists = snap.get("histograms", {})
+
+    anomalies = {k: int(v) for k, v in stats.items()
+                 if k.startswith("health_anomaly_") and k.endswith("_total")}
+    compiles = {
+        "jit_compiles_total": int(stats.get("jit_compiles_total", 0)),
+        "jit_cache_hits_total": int(stats.get("jit_cache_hits_total", 0)),
+        "jit_recompiles_steady_total": int(
+            stats.get("jit_recompiles_steady_total", 0)),
+        "by_cause": {k[len("jit_compiles_"):-len("_total")]: int(v)
+                     for k, v in stats.items()
+                     if k.startswith("jit_compiles_") and
+                     k.endswith("_total") and k != "jit_compiles_total"},
+        "compile_ms": hists.get("compile_ms"),
+    }
+    memory = {
+        "live_bytes": int(stats.get("device_mem_live_bytes", 0)),
+        "peak_bytes": int(stats.get("device_mem_peak_bytes", 0)),
+        "tags": {k[len("device_mem_"):-len("_bytes")]: int(v)
+                 for k, v in stats.items()
+                 if k.startswith("device_mem_") and k.endswith("_bytes")
+                 and k not in ("device_mem_live_bytes",
+                               "device_mem_peak_bytes")},
+    }
+    report = {
+        "anomalies": {
+            "total": int(stats.get("health_anomalies_total", 0)),
+            "by_signal": anomalies,
+            "observe_errors": int(
+                stats.get("health_observe_errors_total", 0)),
+        },
+        "compiles": compiles,
+        "memory": memory,
+        "steps": {
+            "train_steps_total": int(stats.get("train_steps_total", 0)),
+            "train_step_ms": hists.get("train_step_ms"),
+            "input_stall_pct": stats.get("input_stall_pct"),
+        },
+    }
+    if health_snapshot is not None:
+        report["detectors"] = health_snapshot.get("signals", {})
+        report["compiles"]["sites"] = health_snapshot.get("compile", {})
+    if trace_dir:
+        import glob
+
+        import trace_merge
+        paths = sorted(glob.glob(os.path.join(trace_dir,
+                                              "trace_*.jsonl")))
+        if paths:
+            report["spans"] = trace_merge.summarize(
+                trace_merge.merge(paths))
+    return report
+
+
+def evaluate_gates(report: dict, max_anomalies: int = 0,
+                   max_steady_recompiles: int = 0,
+                   max_input_stall: Optional[float] = None) -> list:
+    """Returns the list of tripped-gate descriptions (empty = healthy)."""
+    tripped = []
+    n_anom = report["anomalies"]["total"]
+    if n_anom > max_anomalies:
+        tripped.append(f"anomalies: {n_anom} > {max_anomalies} "
+                       f"(signals: {report['anomalies']['by_signal']})")
+    n_re = report["compiles"]["jit_recompiles_steady_total"]
+    if n_re > max_steady_recompiles:
+        tripped.append(f"steady-state recompiles: {n_re} > "
+                       f"{max_steady_recompiles} "
+                       f"(causes: {report['compiles']['by_cause']})")
+    stall = report["steps"].get("input_stall_pct")
+    if max_input_stall is not None and stall is not None and \
+            stall > max_input_stall:
+        tripped.append(f"input stall: {stall:.2f}% > {max_input_stall}%")
+    return tripped
+
+
+def format_report(report: dict, tripped: list) -> str:
+    a, c, m, s = (report["anomalies"], report["compiles"],
+                  report["memory"], report["steps"])
+    lines = ["== health report =="]
+    lines.append(f"anomalies: {a['total']}"
+                 + (f"  by signal: {a['by_signal']}" if a["by_signal"]
+                    else "")
+                 + (f"  (observe errors: {a['observe_errors']})"
+                    if a["observe_errors"] else ""))
+    hit_line = (f"compiles: {c['jit_compiles_total']}  cache hits: "
+                f"{c['jit_cache_hits_total']}  steady recompiles: "
+                f"{c['jit_recompiles_steady_total']}")
+    if c["by_cause"]:
+        hit_line += f"  by cause: {c['by_cause']}"
+    lines.append(hit_line)
+    cms = c.get("compile_ms")
+    if cms:
+        lines.append(f"compile_ms: count={cms.get('count')} "
+                     f"mean={cms.get('mean')} max={cms.get('max')}")
+    if m["peak_bytes"]:
+        mb = 1.0 / (1 << 20)
+        tag_txt = "  ".join(f"{t}={b * mb:.2f}MB"
+                            for t, b in sorted(m["tags"].items()))
+        lines.append(f"device memory: live={m['live_bytes'] * mb:.2f}MB "
+                     f"peak={m['peak_bytes'] * mb:.2f}MB"
+                     + (f"  [{tag_txt}]" if tag_txt else ""))
+    step_txt = f"steps: {s['train_steps_total']}"
+    if s.get("train_step_ms"):
+        h = s["train_step_ms"]
+        step_txt += (f"  step_ms: mean={h.get('mean')} p99={h.get('p99')} "
+                     f"max={h.get('max')}")
+    if s.get("input_stall_pct") is not None:
+        step_txt += f"  input_stall: {s['input_stall_pct']:.2f}%"
+    lines.append(step_txt)
+    if report.get("spans"):
+        import trace_merge
+        lines.append("-- span summary --")
+        lines.append(trace_merge.format_summary(report["spans"]))
+    if tripped:
+        lines.append("TRIPPED:")
+        lines += [f"  - {t}" for t in tripped]
+    else:
+        lines.append("healthy: no gate tripped")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# self-contained mini-train mode (the CI health lane)
+# ---------------------------------------------------------------------------
+
+def mini_train(n_steps: int, trace_dir: str) -> dict:
+    """Run a traced, health-armed N-step mini train and return
+    ``monitor.snapshot()``.  Fixed seeds and shapes: a healthy run
+    compiles exactly once per jit site and trips zero detectors —
+    which is precisely what the CI gate asserts."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework import health, monitor
+    from paddle_tpu.framework.observability import tracer
+    from paddle_tpu.jit import TrainStep
+
+    for signal, kw in health.DEFAULT_SIGNALS.items():
+        health.watch(signal, **dict(kw))
+    tracer.enable(trace_dir, label="health_check")
+    try:
+        paddle.seed(0)
+        net = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        step = TrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(),
+                         opt)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((16, 8))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((16, 4))
+                             .astype(np.float32))
+        losses = [float(step(x, y)) for _ in range(n_steps)]
+        assert all(np.isfinite(losses)), f"mini train diverged: {losses}"
+        health.memory.sample(tags={
+            "params": sum(int(p._data.nbytes) for p in net.parameters())})
+    finally:
+        tracer.disable()
+    return monitor.snapshot()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="health_check.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--metrics", default=None,
+                    help="metrics snapshot: monitor.snapshot() JSON or "
+                         "Prometheus text (MetricsReporter output)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="directory of trace_*.jsonl span files "
+                         "(adds the per-span summary to the report)")
+    ap.add_argument("--mini-train", type=int, default=None, metavar="N",
+                    help="self-contained mode: run a traced, "
+                         "health-armed N-step mini train and evaluate "
+                         "its own snapshot (the CI health lane)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--max-anomalies", type=int, default=0,
+                    help="gate: tolerated health_anomalies_total "
+                         "(default 0)")
+    ap.add_argument("--max-steady-recompiles", type=int, default=0,
+                    help="gate: tolerated post-warmup recompiles "
+                         "(default 0)")
+    ap.add_argument("--max-input-stall", type=float, default=None,
+                    help="gate: tolerated input_stall_pct (off by "
+                         "default)")
+    a = ap.parse_args(argv)
+    if a.metrics is None and a.mini_train is None:
+        ap.error("nothing to check: pass --metrics or --mini-train")
+    if a.metrics is not None and a.mini_train is not None:
+        ap.error("--metrics and --mini-train are mutually exclusive: "
+                 "the mini train evaluates its own fresh snapshot")
+
+    health_snapshot = None
+    if a.mini_train is not None:
+        if a.trace_dir is None:
+            tmp = tempfile.TemporaryDirectory(prefix="health_check_")
+            a.trace_dir = tmp.name          # kept alive by the local ref
+        snap = mini_train(a.mini_train, a.trace_dir)
+        from paddle_tpu.framework import health
+        health_snapshot = health.snapshot()
+    else:
+        snap = load_metrics(a.metrics)
+
+    report = build_report(snap, trace_dir=a.trace_dir,
+                          health_snapshot=health_snapshot)
+    tripped = evaluate_gates(
+        report, max_anomalies=a.max_anomalies,
+        max_steady_recompiles=a.max_steady_recompiles,
+        max_input_stall=a.max_input_stall)
+    report["tripped"] = tripped
+    if a.format == "json":
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(format_report(report, tripped))
+    return 1 if tripped else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

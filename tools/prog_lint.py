@@ -53,6 +53,10 @@ ZOO = {
     # lints the streaming ingest plane sources (data.pipeline
     # fault-point hygiene) — Report, like elastic_step
     "ingest": lambda: _zoo_ingest(),
+    # lints the perf health plane sources (health.detector fault-point
+    # hygiene + the jit compile-observability hooks) — Report, like
+    # elastic_step
+    "health": lambda: _zoo_health(),
 }
 
 
@@ -161,6 +165,24 @@ def _zoo_ingest():
     for rel in (os.path.join("paddle_tpu", "io", "pipeline.py"),
                 os.path.join("paddle_tpu", "io", "__init__.py"),
                 os.path.join("paddle_tpu", "io", "_worker.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_health():
+    """AST-lint the perf health plane — framework/health.py (which
+    threads the ``health.detector`` chaos fault point through every
+    observation) plus the jit tier carrying the compile-observability
+    hooks — so PTA301/302 validate the new fault-point site against
+    the registry and its swallow-and-count guard."""
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for rel in (os.path.join("paddle_tpu", "framework", "health.py"),
+                os.path.join("paddle_tpu", "jit", "__init__.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:

@@ -20,6 +20,12 @@ Usage::
 
     python tools/trace_merge.py --out merged.json trace_a.jsonl ...
     python tools/trace_merge.py --out merged.json --dir /tmp/traces
+    python tools/trace_merge.py --summary --dir /tmp/traces
+
+``--summary`` prints a per-span-name aggregate table (count, total /
+mean / p99 / max ms, error count) from the merged trace — a trace is
+readable at the terminal without ever opening Chrome.  ``--out`` is
+optional with ``--summary``.
 """
 from __future__ import annotations
 
@@ -30,7 +36,8 @@ import os
 import sys
 from typing import Dict, List, Tuple
 
-__all__ = ["load_span_file", "merge", "validate_chrome_trace", "main"]
+__all__ = ["load_span_file", "merge", "validate_chrome_trace",
+           "summarize", "format_summary", "main"]
 
 
 def load_span_file(path: str) -> Tuple[dict, List[dict]]:
@@ -125,13 +132,64 @@ def validate_chrome_trace(trace: dict) -> int:
     return n_spans
 
 
+def summarize(trace: dict) -> List[dict]:
+    """Per-span-name aggregates over a merged chrome-trace dict: count,
+    total/mean/p99/max ms, and how many spans closed with an error
+    status.  Rows sorted by total time, heaviest first — the terminal
+    answer to "where did the time go" without opening Chrome."""
+    durs: Dict[str, List[float]] = {}
+    errors: Dict[str, int] = {}
+    for ev in trace.get("traceEvents", ()):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        durs.setdefault(name, []).append(float(ev.get("dur", 0.0)) / 1e3)
+        status = (ev.get("args") or {}).get("status", ev.get("cat"))
+        if status == "error":
+            errors[name] = errors.get(name, 0) + 1
+    rows = []
+    for name, ms in durs.items():
+        ms.sort()
+        n = len(ms)
+        p99 = ms[min(n - 1, max(0, int(0.99 * n + 0.5) - 1))]
+        rows.append({"name": name, "count": n,
+                     "total_ms": round(sum(ms), 3),
+                     "mean_ms": round(sum(ms) / n, 3),
+                     "p99_ms": round(p99, 3),
+                     "max_ms": round(ms[-1], 3),
+                     "errors": errors.get(name, 0)})
+    rows.sort(key=lambda r: r["total_ms"], reverse=True)
+    return rows
+
+
+def format_summary(rows: List[dict]) -> str:
+    """Render :func:`summarize` rows as an aligned text table."""
+    cols = ("name", "count", "total_ms", "mean_ms", "p99_ms", "max_ms",
+            "errors")
+    table = [cols] + [tuple(str(r[c]) for c in cols) for r in rows]
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    lines = []
+    for j, row in enumerate(table):
+        lines.append("  ".join(
+            cell.ljust(widths[i]) if i == 0 else cell.rjust(widths[i])
+            for i, cell in enumerate(row)))
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("inputs", nargs="*", help="trace_*.jsonl span files")
     ap.add_argument("--dir", default=None,
                     help="merge every trace_*.jsonl under this directory")
-    ap.add_argument("--out", required=True, help="merged chrome-trace path")
+    ap.add_argument("--out", default=None, help="merged chrome-trace path")
+    ap.add_argument("--summary", action="store_true",
+                    help="print a per-span-name aggregate table "
+                         "(count, total/mean/p99/max ms, errors)")
     a = ap.parse_args(argv)
+    if a.out is None and not a.summary:
+        ap.error("nothing to do: pass --out and/or --summary")
     paths = list(a.inputs)
     if a.dir:
         paths += sorted(glob.glob(os.path.join(a.dir, "trace_*.jsonl")))
@@ -140,12 +198,15 @@ def main(argv=None) -> int:
         return 1
     trace = merge(paths)
     n = validate_chrome_trace(trace)
-    with open(a.out, "w") as f:
-        json.dump(trace, f)
-    traces = {e["args"].get("trace") for e in trace["traceEvents"]
-              if e["ph"] == "X"}
-    print(f"trace_merge: {len(paths)} file(s) -> {a.out} "
-          f"({n} spans, {len(traces)} trace ids)")
+    if a.out is not None:
+        with open(a.out, "w") as f:
+            json.dump(trace, f)
+        traces = {e["args"].get("trace") for e in trace["traceEvents"]
+                  if e["ph"] == "X"}
+        print(f"trace_merge: {len(paths)} file(s) -> {a.out} "
+              f"({n} spans, {len(traces)} trace ids)")
+    if a.summary:
+        print(format_summary(summarize(trace)))
     return 0
 
 
