@@ -27,6 +27,15 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(os.path.dirname(
                           os.path.abspath(__file__)), ".jax_cache"))
 
+# the simulated 2-replica sharded-update leg (bench_gpt2_zero) needs a
+# dp=2 mesh: give the CPU host virtual devices before jax initializes
+# (this flag only affects the host platform — a no-op on TPU/GPU)
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=2"
+                               ).strip()
+
 V100_BERT_TOKENS_PER_SEC = 25_000.0
 V100_RESNET50_SAMPLES_PER_SEC = 380.0
 V100_GPT2_345M_TOKENS_PER_SEC = 6_000.0
@@ -256,6 +265,65 @@ def bench_gpt2_345m(on_accel):
     tps = B * S * iters / dt
     _emit("gpt2_345m_train_tokens_per_sec_per_chip_bf16", tps, "tokens/s",
           tps / V100_GPT2_345M_TOKENS_PER_SEC)
+
+
+def bench_gpt2_zero(on_accel):
+    """GPT-2 under the ZeRO sharded weight update at dp=2 (simulated
+    replicas on CPU, real chips when >= 2 are attached): tokens/s plus
+    the measured optimizer-state bytes ONE replica holds vs the
+    replicated-baseline bytes (vs_baseline on that metric is the
+    sharded/replicated ratio — lower is better, ~0.5 at dp=2), and the
+    bf16 collective wire bytes vs the f32 leg (~0.5)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPT, gpt_tiny, gpt2_345m, gpt_loss
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+
+    if len(jax.devices()) < 2:
+        _emit("gpt2_zero_dp2_SKIPPED_single_device", 0.0, "n/a", 0.0)
+        return
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    if on_accel:
+        B, S = 8, 1024
+        cfg = gpt2_345m(remat=False, max_seq_len=S, scan_unroll=24)
+    else:
+        B, S = 2, 128
+        cfg = gpt_tiny(num_layers=2, remat=True, max_seq_len=S)
+    model = GPT(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = ShardedUpdateTrainStep(model, gpt_loss, opt, mesh=mesh,
+                                  wire_dtype="bf16", amp_level="O2",
+                                  amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
+                                        size=(B, S)).astype(np.int32))
+    iters = 10 if on_accel else 3
+    dt, _ = _timeit(lambda: step(ids, ids), 2, iters)
+    tps = B * S * iters / dt
+    _emit("gpt2_zero_dp2_tokens_per_sec_bf16_wire", tps, "tokens/s",
+          tps / V100_GPT2_345M_TOKENS_PER_SEC)
+
+    sharded_bytes = step.opt_state_bytes_per_replica()
+    # replicated baseline: every replica holds full-width moments —
+    # slot-for-slot the same structure on the UNPADDED leaves
+    probe = opt.init_state(jnp.zeros((4,), jnp.float32))
+    vec_slots = sum(1 for v in probe.values() if jnp.ndim(v) == 1)
+    scalar_bytes = sum(int(jnp.asarray(v).nbytes) for v in probe.values()
+                       if jnp.ndim(v) == 0)
+    replicated = sum(vec_slots * int(p._data.nbytes) + scalar_bytes
+                     for _, p in model.named_parameters())
+    _emit("gpt2_zero_opt_state_bytes_per_replica", sharded_bytes,
+          "bytes", sharded_bytes / max(replicated, 1))
+
+    wire = step.collective_wire_bytes()
+    f32 = step.collective_wire_bytes(wire="f32")   # pure shape math
+    bf16_total = wire["reduce_scatter"] + wire["all_gather"]
+    f32_total = f32["reduce_scatter"] + f32["all_gather"]
+    _emit("gpt2_zero_bf16_collective_bytes_per_step", bf16_total,
+          "bytes", bf16_total / max(f32_total, 1))
 
 
 def bench_widedeep(on_accel):
@@ -936,7 +1004,7 @@ def main():
     set_mesh(make_mesh({"dp": 1}, devices=jax.devices()[:1]))
 
     for bench in (bench_bert, bench_resnet50, bench_gpt2_345m,
-                  bench_widedeep, bench_widedeep_ps,
+                  bench_gpt2_zero, bench_widedeep, bench_widedeep_ps,
                   bench_widedeep_device, bench_int8_resnet18,
                   bench_resnet50_filefed, bench_lenet,
                   bench_longseq_flash, bench_masked_flash):

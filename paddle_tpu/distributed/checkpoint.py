@@ -303,7 +303,12 @@ def save_train_state(step, dirpath: str, global_step: Optional[int] = None,
     optimizer state save (framework/io.py save path).  ``world_size``
     (data-parallel width at save time) is recorded in the metadata so an
     elastic job restoring at a *different* width — shrink-to-survive —
-    can tell, via :func:`checkpoint_meta`, that it is crossing layouts."""
+    can tell, via :func:`checkpoint_meta`, that it is crossing layouts.
+
+    A ZeRO step (``parallel.zero.ShardedUpdateTrainStep``) persists its
+    dp-sharded flat moments as-is (one file per dp shard) and stamps its
+    shard bookkeeping (``checkpoint_extra_meta``) into the metadata, so
+    :func:`load_train_state` can reshard onto a different dp width."""
     model = step.model
     state = {
         "params": {n: p._data for n, p in model.named_parameters()},
@@ -314,14 +319,37 @@ def save_train_state(step, dirpath: str, global_step: Optional[int] = None,
         "global_step": np.int64(global_step if global_step is not None
                                 else step.optimizer._global_step),
     }
+    extra: Dict[str, Any] = {}
+    if world_size is not None:
+        extra["world_size"] = int(world_size)
+    meta_fn = getattr(step, "checkpoint_extra_meta", None)
+    if callable(meta_fn):
+        extra.update(meta_fn())
     save_sharded(state, dirpath, step=global_step,
-                 extra_meta=({"world_size": int(world_size)}
-                             if world_size is not None else None))
+                 extra_meta=extra or None)
 
 
 def load_train_state(step, dirpath: str):
     """Restore into a live (Sharded)TrainStep, adopting the current arrays'
-    shardings (so a checkpoint taken on one mesh restores onto another)."""
+    shardings (so a checkpoint taken on one mesh restores onto another).
+
+    ZeRO interop (``parallel.zero.ShardedUpdateTrainStep``), both ways:
+
+    * a step exposing ``load_checkpoint_state`` adopts the checkpoint
+      itself — moments saved at ANY dp width (or by a replicated
+      TrainStep) are resharded onto the step's own dp/padding using the
+      ``zero`` bookkeeping stamped at save time;
+    * a replicated step restoring a ZeRO checkpoint gets the flat
+      padded moments stripped back to each parameter's logical shape
+      before the ordinary layout-adopting restore.
+    """
+    meta = checkpoint_meta(dirpath)
+    zmeta = meta.get("zero")
+    hook = getattr(step, "load_checkpoint_state", None)
+    if callable(hook):
+        return hook(load_sharded(dirpath), zmeta)
+    if zmeta:
+        return _load_zero_into_replicated(step, dirpath, zmeta)
     model = step.model
     named_params = {n: p for n, p in model.named_parameters()}
     named_buffers = {n: b for n, b in model.named_buffers()
@@ -342,4 +370,54 @@ def load_train_state(step, dirpath: str):
         b._data = state["buffers"][n]
     step._opt_states = state["opt_states"]
     step.optimizer._global_step = int(np.asarray(state["global_step"]))
+    return state
+
+
+def _load_zero_into_replicated(step, dirpath: str, zmeta: dict):
+    """A ZeRO checkpoint into a plain TrainStep: moments were saved as
+    dp-padded flat vectors — strip each back to its logical size (from
+    the ``zero`` bookkeeping) and reshape to the parameter's shape;
+    scalars pass through."""
+    import jax.numpy as jnp
+    model = step.model
+    state = load_sharded(dirpath)
+    named_params = {n: p for n, p in model.named_parameters()}
+    sizes = {n: rec["size"] for n, rec in zmeta.get("leaves", {}).items()}
+
+    def adopt(arr, template):
+        """Keep load_train_state's layout contract: the restored leaf
+        takes the LIVE array's sharding (a model that only fits sharded
+        must not come back replicated on one device)."""
+        arr = jnp.asarray(arr)
+        if isinstance(template, jax.Array) and \
+                hasattr(template, "sharding") and \
+                template.shape == arr.shape:
+            return jax.device_put(arr, template.sharding)
+        return arr
+
+    for n, p in named_params.items():
+        p._data = adopt(np.asarray(state["params"][n]).astype(
+            np.dtype(p._data.dtype)), p._data)
+    for n, b in model.named_buffers():
+        if b is not None and n in state.get("buffers", {}):
+            b._data = adopt(state["buffers"][n], b._data)
+    opt_states = {}
+    for n, slots in (state.get("opt_states") or {}).items():
+        if n not in named_params:
+            raise ValueError(f"checkpoint moment {n!r} has no matching "
+                             "parameter")
+        template = named_params[n]._data
+        shape = tuple(template.shape)
+        out = {}
+        for k, v in slots.items():
+            arr = np.asarray(v)
+            if arr.ndim == 0:
+                out[k] = jnp.asarray(arr)
+                continue
+            flat = arr.reshape(-1)[:sizes.get(n, int(np.prod(shape)))]
+            out[k] = adopt(flat.reshape(shape), template)
+        opt_states[n] = out
+    step._opt_states = opt_states
+    step.optimizer._global_step = int(
+        np.asarray(state.get("global_step", 0)))
     return state

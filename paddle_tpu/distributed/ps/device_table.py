@@ -59,56 +59,15 @@ __all__ = ["MeshShardedEmbedding", "mesh_sharded_lookup",
 
 # ---------------------------------------------------------------------------
 # wire row quantization — shared by both ends of the PS TCP transport
-# (ps/service.py pull replies / push grads) and reusable for any other
-# host-boundary row movement.  EQuARX-style trade (PAPERS.md): embedding
-# rows and their grads tolerate bf16 (and usually int8 + per-row scale)
-# with near-lossless training quality, at 1/2 (1/4) the f32 bytes.
+# (ps/service.py pull replies / push grads) and any other host-boundary
+# row movement.  The encode/decode math now lives in
+# ``distributed/wire.py`` (one discipline for the PS wire AND the
+# ZeRO quantized collectives); these re-exports keep the PR-4 import
+# surface stable.
 # ---------------------------------------------------------------------------
 
-WIRE_DTYPES = ("f32", "bf16", "int8")
-
-_WIRE_ALIASES = {"f32": "f32", "float32": "f32", "fp32": "f32",
-                 "bf16": "bf16", "bfloat16": "bf16",
-                 "int8": "int8", "s8": "int8"}
-
-
-def normalize_wire(name) -> str:
-    """Canonical wire-dtype name ('f32' | 'bf16' | 'int8'); raises on
-    anything unrecognized so a typo'd FLAGS_ps_wire_dtype fails loudly
-    instead of silently shipping f32."""
-    w = _WIRE_ALIASES.get(str(name).lower())
-    if w is None:
-        raise ValueError(f"unknown PS wire dtype {name!r} "
-                         f"(known: {sorted(set(_WIRE_ALIASES))})")
-    return w
-
-
-def quantize_rows(rows: np.ndarray, wire: str):
-    """Encode f32 rows ``(N, D)`` for the wire.  Returns the buffer list
-    to ship: ``[rows]`` for f32/bf16, ``[q_int8, scale_f32]`` for int8
-    (symmetric per-row scale ``max|row| / 127``; all-zero rows get scale
-    1 so they decode to exact zeros)."""
-    r = np.asarray(rows, np.float32)
-    wire = normalize_wire(wire)
-    if wire == "f32":
-        return [r]
-    if wire == "bf16":
-        import ml_dtypes
-        return [r.astype(ml_dtypes.bfloat16)]
-    scale = np.max(np.abs(r), axis=-1) / np.float32(127.0)
-    scale = np.where(scale > 0, scale, np.float32(1.0)).astype(np.float32)
-    q = np.clip(np.rint(r / scale[..., None]), -127, 127).astype(np.int8)
-    return [q, scale]
-
-
-def dequantize_rows(bufs, wire: str) -> np.ndarray:
-    """Decode :func:`quantize_rows` buffers back to f32 rows."""
-    wire = normalize_wire(wire)
-    if wire == "int8":
-        q, scale = bufs[0], bufs[1]
-        return q.astype(np.float32) * np.asarray(scale,
-                                                 np.float32)[..., None]
-    return np.asarray(bufs[0], np.float32)
+from paddle_tpu.distributed.wire import (  # noqa: F401,E402
+    WIRE_DTYPES, dequantize_rows, normalize_wire, quantize_rows)
 
 
 def _sort_dedup(flat):
@@ -172,10 +131,10 @@ def mesh_sharded_lookup(w, ids, axis: str = "dp", mesh=None,
             got = mine[inv]
         return got.reshape(ids_l.shape + (dim,))
 
-    from jax import shard_map
-    mapped = shard_map(local, mesh=mesh,
-                       in_specs=(P(axis, None), P(axis)),
-                       out_specs=P(axis), check_vma=False)
+    from paddle_tpu.parallel.mesh import shard_map_compat
+    mapped = shard_map_compat(local, mesh=mesh,
+                              in_specs=(P(axis, None), P(axis)),
+                              out_specs=P(axis))
     return mapped(w, ids)
 
 
@@ -363,13 +322,12 @@ class DeviceEmbeddingTrainStep:
                 w_l = w_l.at[tgt].add(-table_lr * contrib)
             return w_l, g2_l, dparams, new_buffers, loss
 
-        from jax import shard_map
+        from paddle_tpu.parallel.mesh import shard_map_compat
         in_specs = (P(axis, None), P(axis), P(), P(), P(),
                     P(axis)) + (P(axis),) * n_inputs
-        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
-                           out_specs=(P(axis, None), P(axis), P(), P(),
-                                      P()),
-                           check_vma=False)
+        mapped = shard_map_compat(local, mesh=mesh, in_specs=in_specs,
+                                  out_specs=(P(axis, None), P(axis), P(),
+                                             P(), P()))
 
         def step(w, g2, params, opt_states, buffers, key, lr, ids,
                  *inputs):

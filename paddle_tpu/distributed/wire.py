@@ -1,0 +1,156 @@
+"""Shared wire quantization — one encode/decode discipline for every
+host- or chip-boundary byte stream.
+
+Grown out of ``ps/device_table.py``'s row quantizers (PR 4): the PS TCP
+transport (pull replies / push grads, numpy buffers) and the in-XLA
+collective legs of the ZeRO sharded update (``parallel/zero.py``
+reduce-scatter / all-gather, traced jnp values) ship the same three-way
+trade — exact f32, bf16 at half the bytes, int8 + per-row scale at a
+quarter — so the quantization math lives here ONCE, in two mirrored
+forms:
+
+- :func:`quantize_rows` / :func:`dequantize_rows` — numpy, the PS wire
+  (unchanged semantics from PR 4; parity tests pin them);
+- :func:`quantize_rows_traced` / :func:`dequantize_rows_traced` — jnp
+  twins with identical math (same per-row symmetric scale, same
+  round-half-to-even), traceable inside ``shard_map`` so a quantized
+  collective's encode/dequantize fuses into the train step.
+
+The EQuARX observation (PAPERS.md) that makes the trade safe: gradient
+and parameter rows tolerate bf16 (and usually int8 with a per-row/chunk
+scale) with near-lossless training quality.  The exact f32 path stays a
+first-class fallback everywhere, pinned by parity tests.
+
+``COLLECTIVE_WIRE_DTYPES`` additionally admits ``f16`` — the
+fp16_allreduce compress dtype of ``CompressedAllReduceTrainStep`` —
+which the PS wire protocol does NOT negotiate (``WIRE_DTYPES`` is the
+frozen PS set; old peers would mis-decode an f16 reply).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["WIRE_DTYPES", "COLLECTIVE_WIRE_DTYPES", "normalize_wire",
+           "quantize_rows", "dequantize_rows", "quantize_rows_traced",
+           "dequantize_rows_traced", "wire_nbytes"]
+
+#: the PS-transport negotiated set (frozen: peers handshake over it)
+WIRE_DTYPES = ("f32", "bf16", "int8")
+
+#: the in-XLA collective set — adds f16 (fp16-compressed allreduce),
+#: which never crosses the PS TCP wire
+COLLECTIVE_WIRE_DTYPES = ("f32", "bf16", "f16", "int8")
+
+_WIRE_ALIASES = {"f32": "f32", "float32": "f32", "fp32": "f32",
+                 "bf16": "bf16", "bfloat16": "bf16",
+                 "f16": "f16", "float16": "f16", "fp16": "f16",
+                 "int8": "int8", "s8": "int8"}
+
+
+def normalize_wire(name, known=WIRE_DTYPES) -> str:
+    """Canonical wire-dtype name; raises on anything outside ``known``
+    so a typo'd FLAGS_ps_wire_dtype/FLAGS_zero_wire_dtype fails loudly
+    instead of silently shipping f32.  ``known`` defaults to the PS
+    negotiated set; collective call sites pass
+    :data:`COLLECTIVE_WIRE_DTYPES`."""
+    w = _WIRE_ALIASES.get(str(name).lower())
+    if w is None or w not in known:
+        kind = "PS wire" if tuple(known) == WIRE_DTYPES else "wire"
+        raise ValueError(f"unknown {kind} dtype {name!r} "
+                         f"(known: {sorted(known)})")
+    return w
+
+
+# ---------------------------------------------------------------------------
+# numpy pair — the PS TCP wire (moved verbatim from ps/device_table.py)
+# ---------------------------------------------------------------------------
+
+def quantize_rows(rows: np.ndarray, wire: str):
+    """Encode f32 rows ``(N, D)`` for the wire.  Returns the buffer list
+    to ship: ``[rows]`` for f32/bf16, ``[q_int8, scale_f32]`` for int8
+    (symmetric per-row scale ``max|row| / 127``; all-zero rows get scale
+    1 so they decode to exact zeros).  Validates against the FROZEN PS
+    set — a peer naming a dtype outside it (e.g. f16) must fail loudly,
+    exactly as in PR 4."""
+    r = np.asarray(rows, np.float32)
+    wire = normalize_wire(wire)
+    if wire == "f32":
+        return [r]
+    if wire == "bf16":
+        import ml_dtypes
+        return [r.astype(ml_dtypes.bfloat16)]
+    scale = np.max(np.abs(r), axis=-1) / np.float32(127.0)
+    scale = np.where(scale > 0, scale, np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(r / scale[..., None]), -127, 127).astype(np.int8)
+    return [q, scale]
+
+
+def dequantize_rows(bufs, wire: str) -> np.ndarray:
+    """Decode :func:`quantize_rows` buffers back to f32 rows (PS wire
+    dtypes only — see :func:`quantize_rows`)."""
+    wire = normalize_wire(wire)
+    if wire == "int8":
+        q, scale = bufs[0], bufs[1]
+        return q.astype(np.float32) * np.asarray(scale,
+                                                 np.float32)[..., None]
+    return np.asarray(bufs[0], np.float32)
+
+
+# ---------------------------------------------------------------------------
+# traced pair — in-XLA collectives (shard_map bodies)
+# ---------------------------------------------------------------------------
+
+def quantize_rows_traced(rows, wire: str):
+    """jnp twin of :func:`quantize_rows`: encode ``(..., D)`` rows for a
+    collective's wire.  Returns the buffer tuple the collective ships —
+    ``(rows,)`` for f32 (identity: the exact fallback), the cast array
+    for bf16/f16, ``(q_int8, scale_f32)`` for int8 with the same
+    symmetric per-row scale as the numpy pair (``jnp.round`` is
+    round-half-to-even, matching ``np.rint``)."""
+    import jax.numpy as jnp
+    wire = normalize_wire(wire, known=COLLECTIVE_WIRE_DTYPES)
+    r = rows.astype(jnp.float32)
+    if wire == "f32":
+        return (r,)
+    if wire == "bf16":
+        return (r.astype(jnp.bfloat16),)
+    if wire == "f16":
+        return (r.astype(jnp.float16),)
+    scale = jnp.max(jnp.abs(r), axis=-1) / jnp.float32(127.0)
+    scale = jnp.where(scale > 0, scale,
+                      jnp.float32(1.0)).astype(jnp.float32)
+    q = jnp.clip(jnp.round(r / scale[..., None]), -127, 127).astype(
+        jnp.int8)
+    return (q, scale)
+
+
+def dequantize_rows_traced(bufs, wire: str):
+    """Decode :func:`quantize_rows_traced` buffers back to f32 rows."""
+    import jax.numpy as jnp
+    wire = normalize_wire(wire, known=COLLECTIVE_WIRE_DTYPES)
+    if wire == "int8":
+        q, scale = bufs[0], bufs[1]
+        return q.astype(jnp.float32) * scale[..., None]
+    return bufs[0].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# byte accounting — deterministic, so a CI gate can hold the line
+# ---------------------------------------------------------------------------
+
+_ELEM_BYTES = {"f32": 4.0, "bf16": 2.0, "f16": 2.0, "int8": 1.0}
+
+
+def wire_nbytes(n_elems: int, wire: str, row: int = 0) -> int:
+    """Bytes on the wire for ``n_elems`` encoded values.  For int8,
+    ``row`` is the per-scale chunk length (one f32 scale per ``row``
+    elements — :func:`quantize_rows` emits one scale per trailing-axis
+    row); 0 means a single row."""
+    wire = normalize_wire(wire, known=COLLECTIVE_WIRE_DTYPES)
+    payload = _ELEM_BYTES[wire] * n_elems
+    if wire == "int8":
+        rows = math.ceil(n_elems / row) if row else 1
+        payload += 4.0 * rows
+    return int(payload)

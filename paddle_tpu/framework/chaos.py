@@ -49,6 +49,15 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         watcher must never crash the watched train
                         loop), ``mode="latency"`` a slow one the loop
                         simply absorbs
+``zero.collective``     once per collective leg (reduce_scatter /
+                        all_gather) at the dispatch head of the ZeRO
+                        sharded update (parallel/zero.py
+                        ShardedUpdateTrainStep) — ``mode="error"`` is a
+                        dropped collective the step re-issues (bounded
+                        pre-dispatch retry; no state was consumed, so
+                        the retried trajectory is bit-identical),
+                        ``mode="latency"`` a slow interconnect the
+                        dispatch simply absorbs
 =====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
@@ -88,7 +97,7 @@ __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
 FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "ckpt.save", "download.fetch", "train.step_grads",
                 "elastic.lease", "elastic.worker_hang",
-                "health.detector")
+                "health.detector", "zero.collective")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

@@ -129,6 +129,14 @@ define_flag("ps_wire_dtype", "bf16",
             "quantize only after a hello handshake confirms the "
             "server understands the dtype — old/new peers always "
             "interoperate at f32")
+define_flag("zero_wire_dtype", "bf16",
+            "wire encoding for the ZeRO sharded-update collectives "
+            "(parallel/zero.py ShardedUpdateTrainStep reduce-scatter / "
+            "all-gather legs): 'bf16' (default, half the f32 bytes), "
+            "'int8' (quarter the bytes + one f32 scale per chunk), or "
+            "'f32' (exact fallback — trajectory-parity with the "
+            "replicated TrainStep, pinned by tests).  Per-step "
+            "override via ShardedUpdateTrainStep(wire_dtype=...)")
 define_flag("ps_prefetch_depth", 1,
             "max in-flight prefetched pulls in PSTrainStep's pipeline "
             "(PSTrainStep.prefetch): 0 disables the pipeline, 1 is the "

@@ -23,11 +23,12 @@ meta_optimizers/).  All of it collapses into three TPU-idioms:
 """
 from paddle_tpu.parallel.mesh import (  # noqa: F401
     DistAttr, HybridTopology, auto_mesh, get_mesh, set_mesh, make_mesh,
-    mesh_axis_size, shard_spec,
+    mesh_axis_size, shard_map_compat, shard_spec,
 )
 from paddle_tpu.parallel.sharded import ShardedTrainStep, shard_module  # noqa: F401
 from paddle_tpu.parallel.dp_meta import (  # noqa: F401
     CompressedAllReduceTrainStep, LocalSGDTrainStep)
+from paddle_tpu.parallel.zero import ShardedUpdateTrainStep  # noqa: F401
 from paddle_tpu.parallel.pipeline import (  # noqa: F401
     make_pipeline_train_1f1b, pipeline_forward)
 from paddle_tpu.parallel.ring_attention import ring_attention  # noqa: F401

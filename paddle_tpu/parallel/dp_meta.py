@@ -34,7 +34,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from paddle_tpu.core import Tensor, no_grad
 from paddle_tpu.jit import _GeneratorKeyGuard
 from paddle_tpu.nn.layer.layers import Layer
-from paddle_tpu.parallel.mesh import get_mesh
+from paddle_tpu.parallel.mesh import (get_mesh, manual_region,
+                                      shard_map_compat)
 from paddle_tpu.tensor.random import default_generator
 
 __all__ = ["LocalSGDTrainStep", "CompressedAllReduceTrainStep",
@@ -178,12 +179,11 @@ class LocalSGDTrainStep:
             return (expand(new_params), expand(new_states),
                     expand(new_buffers), mean_loss)
 
-        from jax import shard_map
         in_specs = (P("dp"), P("dp"), P("dp"), P(), P(), P(), P()) + \
             (P("dp"),) * n_inputs
         out_specs = (P("dp"), P("dp"), P("dp"), P())
-        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False)
+        mapped = shard_map_compat(local, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs)
         return jax.jit(mapped, donate_argnums=(0, 1, 2))
 
     def __call__(self, *inputs):
@@ -195,9 +195,10 @@ class LocalSGDTrainStep:
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())
         params_s, states_s, buffers_s = self._stacked
-        params_s, states_s, buffers_s, loss = self._fn(
-            params_s, states_s, buffers_s, jnp.int32(self._step),
-            jnp.int32(self.k_steps), key, lr, *arrs)
+        with manual_region():    # model-internal constrain() no-ops
+            params_s, states_s, buffers_s, loss = self._fn(
+                params_s, states_s, buffers_s, jnp.int32(self._step),
+                jnp.int32(self.k_steps), key, lr, *arrs)
         self._stacked = (params_s, states_s, buffers_s)
         self._step += 1
         loss_f = loss  # jax array; host sync only if adaptive needs it
@@ -235,22 +236,35 @@ class LocalSGDTrainStep:
 class CompressedAllReduceTrainStep:
     """DP train step whose gradient allreduce runs in a reduced dtype.
 
-    The local gradient is computed per-shard under ``shard_map``, cast to
-    ``compress_dtype`` (fp16 default, matching the reference's
-    fp16_allreduce; bf16 recommended on TPU), ``pmean``-ed over ``dp``,
-    cast back to the param dtype, and fed to one replicated optimizer
-    update.
+    The local gradient is computed per-shard under ``shard_map``,
+    encoded for the wire by the shared quantization helpers
+    (``distributed/wire.py`` — the same encode/decode the PS transport
+    and the ZeRO collectives use), ``pmean``-ed over ``dp`` in the wire
+    dtype, decoded back to the param dtype, and fed to one replicated
+    optimizer update.
+
+    ``compress_dtype``: ``float16`` (default, matching the reference's
+    fp16_allreduce), ``bfloat16`` (recommended on TPU) or ``float32``
+    (exact passthrough — the parity-pinned fallback).  ``int8`` is NOT
+    accepted here: summing int8 payloads inside a pmean would overflow;
+    the chunk-exchange int8 collective lives in
+    :class:`paddle_tpu.parallel.zero.ShardedUpdateTrainStep`.
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  mesh: Optional[Mesh] = None, compress_dtype="float16",
                  amp_level=None, amp_dtype="bfloat16", recompute=False):
+        from paddle_tpu.distributed.wire import normalize_wire
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh or get_mesh()
         _require_pure_dp(self.mesh, "compressed-allreduce")
-        self.compress_dtype = jnp.dtype(compress_dtype)
+        self.wire = normalize_wire(compress_dtype,
+                                   known=("f32", "f16", "bf16"))
+        self.compress_dtype = {"f32": jnp.dtype(jnp.float32),
+                               "f16": jnp.dtype(jnp.float16),
+                               "bf16": jnp.dtype(jnp.bfloat16)}[self.wire]
         self.amp_level = amp_level
         self.amp_dtype = jnp.bfloat16 if str(amp_dtype) in (
             "bfloat16", "bf16") else jnp.float16
@@ -259,29 +273,38 @@ class CompressedAllReduceTrainStep:
         self._fn = None
 
     def _build(self, n_inputs):
+        from paddle_tpu.distributed.wire import (dequantize_rows_traced,
+                                                 quantize_rows_traced)
         mesh = self.mesh
         opt = self.optimizer
-        cdtype = self.compress_dtype
+        wire = self.wire
         loss_from = _loss_closure(self.model, self.loss_fn, self.amp_level,
                                   self.amp_dtype, self.recompute)
+
+        def reduce_one(g, p):
+            if not jnp.issubdtype(g.dtype, jnp.floating):
+                return g
+            bufs = quantize_rows_traced(g, wire)
+            # XLA:CPU's AllReducePromotion pass crashes on sub-f32
+            # all-reduce (see parallel/pipeline._psum) — promote the
+            # reduce there; the wire dtype is what ships on TPU/GPU
+            if wire != "f32" and jax.default_backend() == "cpu":
+                red = (jax.lax.pmean(bufs[0].astype(jnp.float32), "dp")
+                       .astype(bufs[0].dtype),)
+            else:
+                red = (jax.lax.pmean(bufs[0], "dp"),)
+            return dequantize_rows_traced(red, wire).astype(p.dtype)
 
         def local_grads(params, buffers, key, *inputs):
             (loss, new_buffers), grads = jax.value_and_grad(
                 lambda p: loss_from(p, buffers, key, list(inputs)),
                 has_aux=True)(params)
-            comp = jax.tree_util.tree_map(
-                lambda g: g.astype(cdtype) if jnp.issubdtype(
-                    g.dtype, jnp.floating) else g, grads)
-            reduced = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, "dp"), comp)
-            grads = jax.tree_util.tree_map(
-                lambda g, p: g.astype(p.dtype), reduced, grads)
+            grads = jax.tree_util.tree_map(reduce_one, grads, params)
             return jax.lax.pmean(loss, "dp"), new_buffers, grads
 
-        from jax import shard_map
         in_specs = (P(), P(), P()) + (P("dp"),) * n_inputs
-        mapped = shard_map(local_grads, mesh=mesh, in_specs=in_specs,
-                           out_specs=(P(), P(), P()), check_vma=False)
+        mapped = shard_map_compat(local_grads, mesh=mesh, in_specs=in_specs,
+                                  out_specs=(P(), P(), P()))
 
         def step(params, states, buffers, key, lr, *inputs):
             loss, new_buffers, grads = mapped(params, buffers, key, *inputs)
@@ -306,8 +329,9 @@ class CompressedAllReduceTrainStep:
             self._fn = self._build(len(arrs))
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())
-        new_params, self._opt_states, new_buffers, loss = self._fn(
-            params, self._opt_states, buffers, key, lr, *arrs)
+        with manual_region():    # model-internal constrain() no-ops
+            new_params, self._opt_states, new_buffers, loss = self._fn(
+                params, self._opt_states, buffers, key, lr, *arrs)
         for n, p in named_params.items():
             p._data = new_params[n]
         for n, b in named_buffers.items():
@@ -442,11 +466,10 @@ class DGCTrainStep:
             return jax.lax.pmean(loss, "dp"), new_buffers, out_g, \
                 out_u, out_v
 
-        from jax import shard_map
         in_specs = (P(), P(), P(), P("dp"), P("dp")) + (P("dp"),) * n_inputs
-        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
-                           out_specs=(P(), P(), P(), P("dp"), P("dp")),
-                           check_vma=False)
+        mapped = shard_map_compat(local, mesh=mesh, in_specs=in_specs,
+                                  out_specs=(P(), P(), P(), P("dp"),
+                                             P("dp")))
 
         def step(params, states, buffers, key, lr, u, v, *inputs):
             loss, new_buffers, grads, u2, v2 = mapped(
@@ -476,8 +499,9 @@ class DGCTrainStep:
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())
         u, v = self._uv
-        new_params, self._opt_states, new_buffers, loss, u2, v2 = fn(
-            params, self._opt_states, buffers, key, lr, u, v, *arrs)
+        with manual_region():    # model-internal constrain() no-ops
+            new_params, self._opt_states, new_buffers, loss, u2, v2 = fn(
+                params, self._opt_states, buffers, key, lr, u, v, *arrs)
         self._uv = (u2, v2)
         for n, p in named_params.items():
             p._data = new_params[n]

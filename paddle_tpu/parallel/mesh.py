@@ -15,7 +15,9 @@ Axis-name conventions (used across the framework):
 """
 from __future__ import annotations
 
+import contextlib
 import math
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -23,7 +25,25 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "get_mesh", "set_mesh", "auto_mesh", "mesh_axis_size",
-           "HybridTopology", "DistAttr", "shard_spec"]
+           "HybridTopology", "DistAttr", "shard_spec", "shard_map_compat"]
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """``shard_map`` across the jax versions this repo meets: new jax
+    exposes ``jax.shard_map`` (replication check knob ``check_vma``),
+    older releases only ``jax.experimental.shard_map.shard_map``
+    (``check_rep``).  Every fully-manual region in the repo goes through
+    here so the version fork lives in ONE place."""
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:        # pre-check_vma spelling of the knob
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 _global_mesh: Optional[Mesh] = None
 
@@ -113,6 +133,31 @@ def shard_spec(*axes) -> PartitionSpec:
     return _clean_axes(axes, get_mesh())
 
 
+_MANUAL_REGION = threading.local()
+
+
+@contextlib.contextmanager
+def manual_region():
+    """Mark the dynamic extent of a fully-manual ``shard_map`` trace:
+    :func:`constrain` becomes a no-op inside it.  Newer jax raises a
+    recognizable "manual" error that constrain already swallows, but on
+    older releases a with_sharding_constraint staged inside a manual
+    region traces against the GLOBAL mesh and only fails at run time
+    with a device mismatch — the explicitly-collective train steps
+    (``parallel/zero.py``, ``dp_meta``) wrap their dispatch in this so
+    model-internal activation constraints (e.g. GPT's) are skipped."""
+    prev = getattr(_MANUAL_REGION, "depth", 0)
+    _MANUAL_REGION.depth = prev + 1
+    try:
+        yield
+    finally:
+        _MANUAL_REGION.depth = prev
+
+
+def in_manual_region() -> bool:
+    return getattr(_MANUAL_REGION, "depth", 0) > 0
+
+
 def constrain(arr, *axes, strip=()):
     """with_sharding_constraint on a raw array over the active mesh.
 
@@ -121,6 +166,8 @@ def constrain(arr, *axes, strip=()):
     fully-manual shard_map region the constraint is skipped (meaningless
     there); any other failure is a real error and raises."""
     import jax
+    if in_manual_region():
+        return arr
     axes = tuple(None if a in strip else a for a in axes)
     spec = shard_spec(*axes)
     if len(spec) > arr.ndim:

@@ -1,0 +1,665 @@
+"""ZeRO-style sharded weight update (parallel/zero.py) + shared wire
+quantization (distributed/wire.py).
+
+The contract under test, in order of importance:
+
+1. **Exact f32 parity** — the sharded update is element-for-element the
+   replicated data-parallel trajectory AND optimizer state (the update
+   math is elementwise; sharding it must change nothing).  Pinned
+   against the pmean-reduced replicated reference
+   (CompressedAllReduceTrainStep at f32 — bitwise-identical gradient
+   path) and, with float tolerance, against the plain full-batch
+   jit.TrainStep.
+2. bf16/int8 wire modes drift BOUNDEDLY and still train.
+3. The ``zero.collective`` chaos point: injected faults are absorbed
+   deterministically (bit-identical trajectory to a clean run).
+4. Interop: ResilientTrainStep NaN skip-and-restore, checkpoint
+   save/restore incl. a DIFFERENT dp world size on load, and
+   replicated <-> sharded checkpoint exchange.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.wire import (dequantize_rows,
+                                         dequantize_rows_traced,
+                                         normalize_wire, quantize_rows,
+                                         quantize_rows_traced, wire_nbytes)
+from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework.resilient import ResilientTrainStep
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.parallel import make_mesh, set_mesh
+from paddle_tpu.parallel.dp_meta import CompressedAllReduceTrainStep
+from paddle_tpu.parallel.zero import (ShardedUpdateTrainStep,
+                                      build_shard_specs)
+
+
+def _mlp(seed=0):
+    """Deliberately uneven leaves: a (1,)-bias smaller than any dp
+    width, a (33,)-bias not divisible by anything, odd fan-ins."""
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(7, 33), nn.ReLU(), nn.Linear(33, 1))
+
+
+def _loss_fn(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def _data(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 7)).astype(np.float32)
+    y = (x @ rng.standard_normal((7, 1))).astype(np.float32)
+    return x, y
+
+
+def _params(model):
+    return {n: np.asarray(p._data) for n, p in model.named_parameters()}
+
+
+def _mesh(dp):
+    mesh = make_mesh({"dp": dp}, devices=jax.devices()[:dp])
+    set_mesh(mesh)
+    return mesh
+
+
+def _run(step, x, y, steps):
+    T = paddle.to_tensor
+    return [float(step(T(x), T(y))) for _ in range(steps)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset(0)
+    yield
+    chaos.reset(0)
+
+
+# ---------------------------------------------------------------------------
+# shared wire helpers
+# ---------------------------------------------------------------------------
+
+class TestWireHelpers:
+    def test_traced_matches_numpy_int8(self):
+        rng = np.random.default_rng(3)
+        rows = rng.standard_normal((5, 16)).astype(np.float32)
+        q_np = quantize_rows(rows, "int8")
+        q_tr = quantize_rows_traced(jnp.asarray(rows), "int8")
+        np.testing.assert_array_equal(q_np[0], np.asarray(q_tr[0]))
+        np.testing.assert_array_equal(q_np[1], np.asarray(q_tr[1]))
+        np.testing.assert_array_equal(
+            dequantize_rows(q_np, "int8"),
+            np.asarray(dequantize_rows_traced(q_tr, "int8")))
+
+    def test_int8_roundtrip_error_bounded_by_half_scale(self):
+        rng = np.random.default_rng(4)
+        rows = rng.standard_normal((3, 64)).astype(np.float32) * 10
+        q, scale = quantize_rows_traced(jnp.asarray(rows), "int8")
+        back = np.asarray(dequantize_rows_traced((q, scale), "int8"))
+        bound = np.asarray(scale)[:, None] * 0.5 + 1e-7
+        assert (np.abs(back - rows) <= bound).all()
+
+    def test_zero_rows_decode_to_exact_zero(self):
+        rows = jnp.zeros((2, 8), jnp.float32)
+        back = dequantize_rows_traced(
+            quantize_rows_traced(rows, "int8"), "int8")
+        np.testing.assert_array_equal(np.asarray(back), 0.0)
+
+    def test_f32_is_identity(self):
+        rows = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 4)).astype(np.float32))
+        (out,) = quantize_rows_traced(rows, "f32")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(rows))
+
+    def test_normalize_wire_collective_set_admits_f16(self):
+        assert normalize_wire("float16", known=("f32", "f16")) == "f16"
+        with pytest.raises(ValueError):
+            normalize_wire("float16")          # PS set: f16 not negotiated
+        with pytest.raises(ValueError):
+            normalize_wire("int7")
+
+    def test_wire_nbytes(self):
+        assert wire_nbytes(1024, "f32") == 4096
+        assert wire_nbytes(1024, "bf16") == 2048
+        # int8: payload + one f32 scale per 256-chunk
+        assert wire_nbytes(1024, "int8", row=256) == 1024 + 4 * 4
+
+    def test_ps_device_table_reexports_shared_helpers(self):
+        from paddle_tpu.distributed.ps import device_table
+        from paddle_tpu.distributed import wire
+        assert device_table.quantize_rows is wire.quantize_rows
+        assert device_table.normalize_wire is wire.normalize_wire
+
+
+# ---------------------------------------------------------------------------
+# shard bookkeeping
+# ---------------------------------------------------------------------------
+
+class TestShardSpecs:
+    def test_padding_is_dp_chunk_divisible(self):
+        params = {"w": jnp.zeros((33, 7)), "tiny": jnp.zeros((1,))}
+        specs = build_shard_specs(params, dp=4, chunk=8)
+        for s in specs.values():
+            assert s.padded % (4 * 8) == 0
+            assert s.shard_len * 4 == s.padded
+            assert s.padded >= s.size
+        assert specs["w"].size == 231
+        assert specs["tiny"].size == 1       # leaf smaller than dp
+
+    def test_layout_independent_of_wire(self):
+        params = {"w": jnp.zeros((100,))}
+        a = build_shard_specs(params, dp=2, chunk=16)
+        # wire dtype never enters the bookkeeping — checkpoint layouts
+        # from f32 and int8 runs are interchangeable
+        assert a == build_shard_specs(params, dp=2, chunk=16)
+
+
+# ---------------------------------------------------------------------------
+# exact f32 parity
+# ---------------------------------------------------------------------------
+
+class TestExactParity:
+    @pytest.mark.parametrize("dp", [2, 4])
+    @pytest.mark.parametrize("opt_cls", ["momentum", "adam"])
+    def test_trajectory_and_state_match_replicated_dp(self, dp, opt_cls):
+        """Multi-step BITWISE parity of params, moments and losses with
+        the pmean-reduced replicated reference on the same mesh."""
+        mesh = _mesh(dp)
+        x, y = _data()
+
+        def make_opt(m):
+            if opt_cls == "momentum":
+                return optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                          parameters=m.parameters())
+            return optimizer.Adam(learning_rate=0.05,
+                                  parameters=m.parameters())
+
+        m_z, m_r = _mlp(), _mlp()
+        o_z, o_r = make_opt(m_z), make_opt(m_r)
+        z = ShardedUpdateTrainStep(m_z, _loss_fn, o_z, mesh=mesh,
+                                   wire_dtype="f32", chunk=8)
+        r = CompressedAllReduceTrainStep(m_r, _loss_fn, o_r, mesh=mesh,
+                                         compress_dtype="float32")
+        lz = _run(z, x, y, 6)
+        lr_ = _run(r, x, y, 6)
+        assert lz == lr_
+        for (n, pz), (_, pr) in zip(m_z.named_parameters(),
+                                    m_r.named_parameters()):
+            np.testing.assert_array_equal(
+                np.asarray(pz._data), np.asarray(pr._data), err_msg=n)
+        # optimizer state: gather each sharded moment, strip padding,
+        # compare against the replicated moments elementwise
+        for n, slots in z._opt_states.items():
+            spec = z._specs[n]
+            ref_slots = r._opt_states[n]
+            for k, v in slots.items():
+                got = np.asarray(v).reshape(-1)[:spec.size]
+                want = np.asarray(ref_slots[k]).reshape(-1)
+                np.testing.assert_array_equal(got, want,
+                                              err_msg=f"{n}/{k}")
+
+    def test_close_to_plain_full_batch_trainstep(self):
+        """vs the single-device full-batch TrainStep the only difference
+        is batch-mean reduction order — float-tolerance parity."""
+        mesh = _mesh(2)
+        x, y = _data()
+        m_z, m_t = _mlp(), _mlp()
+        o_z = optimizer.Adam(learning_rate=0.05,
+                             parameters=m_z.parameters())
+        o_t = optimizer.Adam(learning_rate=0.05,
+                             parameters=m_t.parameters())
+        z = ShardedUpdateTrainStep(m_z, _loss_fn, o_z, mesh=mesh,
+                                   wire_dtype="f32", chunk=8)
+        t = TrainStep(m_t, _loss_fn, o_t)
+        lz = _run(z, x, y, 5)
+        lt = _run(t, x, y, 5)
+        np.testing.assert_allclose(lz, lt, rtol=1e-4, atol=1e-5)
+        for (n, pz), (_, pt) in zip(m_z.named_parameters(),
+                                    m_t.named_parameters()):
+            np.testing.assert_allclose(
+                np.asarray(pz._data), np.asarray(pt._data),
+                rtol=1e-4, atol=1e-5, err_msg=n)
+
+    def test_global_norm_clip_matches_replicated(self):
+        """ClipGradByGlobalNorm over SHARDED grads (shard-local sum of
+        squares + psum) matches the replicated clip trajectory."""
+        mesh = _mesh(2)
+        x, y = _data()
+        m_z, m_r = _mlp(), _mlp()
+        clip = lambda: nn.ClipGradByGlobalNorm(0.25)  # noqa: E731
+        o_z = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                 parameters=m_z.parameters(),
+                                 grad_clip=clip())
+        o_r = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                 parameters=m_r.parameters(),
+                                 grad_clip=clip())
+        z = ShardedUpdateTrainStep(m_z, _loss_fn, o_z, mesh=mesh,
+                                   wire_dtype="f32", chunk=8)
+        t = TrainStep(m_r, _loss_fn, o_r)
+        lz = _run(z, x, y, 4)
+        lt = _run(t, x, y, 4)
+        np.testing.assert_allclose(lz, lt, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# quantized wire modes
+# ---------------------------------------------------------------------------
+
+class TestQuantizedCollectives:
+    @pytest.mark.parametrize("wire,tol", [("bf16", 2e-2), ("int8", 8e-2)])
+    def test_bounded_drift_and_still_trains(self, wire, tol):
+        mesh = _mesh(2)
+        x, y = _data()
+        m_q, m_f = _mlp(), _mlp()
+        o_q = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                 parameters=m_q.parameters())
+        o_f = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                 parameters=m_f.parameters())
+        q = ShardedUpdateTrainStep(m_q, _loss_fn, o_q, mesh=mesh,
+                                   wire_dtype=wire, chunk=8)
+        f = ShardedUpdateTrainStep(m_f, _loss_fn, o_f, mesh=mesh,
+                                   wire_dtype="f32", chunk=8)
+        lq = _run(q, x, y, 6)
+        lf = _run(f, x, y, 6)
+        assert lq[-1] < lq[0] * 0.5          # it trains
+        for a, b in zip(lq, lf):             # and tracks the exact run
+            assert abs(a - b) <= tol * max(1.0, abs(b))
+
+    def test_all_replicas_hold_identical_params(self):
+        """The quantized all-gather dequantizes EVERY chunk (including
+        the locally owned one): a second step from the gathered params
+        must be deterministic, which it can only be if all replicas
+        left step 1 with identical parameters."""
+        mesh = _mesh(4)
+        x, y = _data()
+        runs = []
+        for _ in range(2):
+            m = _mlp()
+            o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                   parameters=m.parameters())
+            s = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                       wire_dtype="int8", chunk=8)
+            runs.append((_run(s, x, y, 3), _params(m)))
+        assert runs[0][0] == runs[1][0]
+        for n in runs[0][1]:
+            np.testing.assert_array_equal(runs[0][1][n], runs[1][1][n])
+
+    def test_wire_bytes_accounting(self):
+        mesh = _mesh(2)
+        x, y = _data()
+        steps = {}
+        for wire in ("f32", "bf16", "int8"):
+            m = _mlp()
+            o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                   parameters=m.parameters())
+            steps[wire] = ShardedUpdateTrainStep(
+                m, _loss_fn, o, mesh=mesh, wire_dtype=wire, chunk=256)
+        f32 = steps["f32"].collective_wire_bytes()
+        bf16 = steps["bf16"].collective_wire_bytes()
+        int8 = steps["int8"].collective_wire_bytes()
+        for leg in ("reduce_scatter", "all_gather"):
+            assert bf16[leg] / f32[leg] == 0.5       # the acceptance bar
+            assert int8[leg] / f32[leg] <= 0.26
+        # the monitor gauges export after a step
+        _run(steps["bf16"], x, y, 1)
+        per_step = (bf16["reduce_scatter"] + bf16["all_gather"])
+        assert monitor.get_stat("zero_collective_bytes_per_step") == \
+            per_step
+        assert monitor.get_stat("opt_state_bytes_per_replica") > 0
+
+    def test_opt_state_bytes_sharded_below_replicated(self):
+        """The acceptance bar: dp=2 optimizer-state bytes per replica
+        <= 0.6x the replicated baseline (on leaves where padding is
+        amortized)."""
+        mesh = _mesh(2)
+        paddle.seed(0)
+        m_z = nn.Sequential(nn.Linear(256, 512), nn.ReLU(),
+                            nn.Linear(512, 256))
+        m_t = nn.Sequential(nn.Linear(256, 512), nn.ReLU(),
+                            nn.Linear(512, 256))
+        o_z = optimizer.Adam(learning_rate=0.01,
+                             parameters=m_z.parameters())
+        o_t = optimizer.Adam(learning_rate=0.01,
+                             parameters=m_t.parameters())
+        z = ShardedUpdateTrainStep(m_z, _loss_fn, o_z, mesh=mesh)
+        t = TrainStep(m_t, _loss_fn, o_t)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 256)).astype(np.float32)
+        y = rng.standard_normal((4, 256)).astype(np.float32)
+        _run(z, x, y, 1)
+        _run(t, x, y, 1)
+        replicated = sum(int(np.asarray(v).nbytes) for v in
+                         jax.tree_util.tree_leaves(t._opt_states))
+        assert z.opt_state_bytes_per_replica() <= 0.6 * replicated
+
+    def test_norm_per_parameter_optimizer_rejected(self):
+        """LARS trust ratios over 1/dp chunks would silently diverge —
+        the step must refuse at construction."""
+        _mesh(2)
+        m = _mlp()
+        o = optimizer.LarsMomentum(learning_rate=0.05, momentum=0.9,
+                                   parameters=m.parameters())
+        with pytest.raises(TypeError, match="norm-per-parameter"):
+            ShardedUpdateTrainStep(m, _loss_fn, o)
+
+    def test_int8_requires_no_special_chunk_divisibility(self):
+        mesh = _mesh(2)
+        x, y = _data()
+        m = _mlp()
+        o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                               parameters=m.parameters())
+        s = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                   wire_dtype="int8", chunk=13)
+        losses = _run(s, x, y, 2)
+        assert losses[1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# chaos: zero.collective
+# ---------------------------------------------------------------------------
+
+class TestChaosCollective:
+    def test_injected_error_is_retried_deterministically(self):
+        mesh = _mesh(2)
+        x, y = _data()
+
+        def run(with_fault):
+            chaos.reset(11)
+            m = _mlp()
+            o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                   parameters=m.parameters())
+            s = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                       wire_dtype="bf16", chunk=8)
+            if with_fault:
+                with chaos.inject("zero.collective", mode="error",
+                                  nth=3, n_times=1) as spec:
+                    losses = _run(s, x, y, 4)
+                assert spec.trips == 1
+            else:
+                losses = _run(s, x, y, 4)
+            return losses, _params(m)
+
+        clean, p_clean = run(False)
+        faulted, p_faulted = run(True)
+        assert clean == faulted                 # bit-identical trajectory
+        for n in p_clean:
+            np.testing.assert_array_equal(p_clean[n], p_faulted[n])
+
+    def test_retry_budget_exhaustion_raises(self):
+        mesh = _mesh(2)
+        x, y = _data()
+        m = _mlp()
+        o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                               parameters=m.parameters())
+        s = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                   wire_dtype="f32", chunk=8,
+                                   collective_retries=1)
+        with chaos.inject("zero.collective", mode="error", every=1):
+            with pytest.raises(chaos.InjectedFault):
+                _run(s, x, y, 1)
+
+    def test_latency_mode_is_absorbed(self):
+        mesh = _mesh(2)
+        x, y = _data()
+        m = _mlp()
+        o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                               parameters=m.parameters())
+        s = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                   wire_dtype="f32", chunk=8)
+        with chaos.inject("zero.collective", mode="latency",
+                          latency=0.01, every=1):
+            losses = _run(s, x, y, 2)
+        assert losses[1] < losses[0]
+
+    def test_fault_point_is_registered(self):
+        assert "zero.collective" in chaos.known_fault_points()
+
+
+# ---------------------------------------------------------------------------
+# resilient / reform interop
+# ---------------------------------------------------------------------------
+
+class TestResilientInterop:
+    def test_nan_skip_and_restore_reaches_clean_state(self):
+        mesh = _mesh(2)
+        x, y = _data()
+        m_p = _mlp()
+        o_p = optimizer.Adam(learning_rate=0.05,
+                             parameters=m_p.parameters())
+        poisoned = ResilientTrainStep(ShardedUpdateTrainStep(
+            m_p, _loss_fn, o_p, mesh=mesh, wire_dtype="f32", chunk=8))
+        with chaos.inject("train.step_grads", mode="nan", nth=2,
+                          n_times=1):
+            for _ in range(5):
+                poisoned(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert poisoned.skipped_steps == 1
+        m_c = _mlp()
+        o_c = optimizer.Adam(learning_rate=0.05,
+                             parameters=m_c.parameters())
+        clean = ShardedUpdateTrainStep(m_c, _loss_fn, o_c, mesh=mesh,
+                                       wire_dtype="f32", chunk=8)
+        _run(clean, x, y, 4)                    # 5 calls - 1 skipped
+        for (n, pp), (_, pc) in zip(m_p.named_parameters(),
+                                    m_c.named_parameters()):
+            np.testing.assert_array_equal(
+                np.asarray(pp._data), np.asarray(pc._data), err_msg=n)
+
+    def test_membership_changed_snapshots_sharded_moments(self):
+        mesh = _mesh(2)
+        x, y = _data()
+        m = _mlp()
+        o = optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+        r = ResilientTrainStep(ShardedUpdateTrainStep(
+            m, _loss_fn, o, mesh=mesh, wire_dtype="f32", chunk=8),
+            snapshot_every=100)    # only membership_changed snapshots
+        _run(r, x, y, 2)
+        r.membership_changed(epoch=3)
+        assert r.membership_epoch == 3
+        # the snapshot holds the padded flat moments; restore re-places
+        # them onto the dp sharding and training continues bit-stable
+        before = _params(m)
+        _run(r, x, y, 1)
+        r.restore()
+        for n, v in _params(m).items():
+            np.testing.assert_array_equal(v, before[n])
+        losses = _run(r, x, y, 2)
+        assert np.isfinite(losses).all()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: sharded save/restore + reshard-on-load
+# ---------------------------------------------------------------------------
+
+class TestCheckpointInterop:
+    def _train(self, mesh, steps, x, y, seed=0, chunk=8):
+        m = _mlp(seed)
+        o = optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+        z = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                   wire_dtype="f32", chunk=chunk)
+        losses = _run(z, x, y, steps)
+        return z, losses
+
+    def test_same_dp_roundtrip_is_exact(self, tmp_path):
+        mesh = _mesh(2)
+        x, y = _data()
+        z, _ = self._train(mesh, 3, x, y)
+        ckpt.save_train_state(z, str(tmp_path), world_size=2)
+        ref = _run(z, x, y, 3)                 # uninterrupted continuation
+        z2, _ = self._train(mesh, 0, x, y, seed=9)
+        ckpt.load_train_state(z2, str(tmp_path))
+        assert _run(z2, x, y, 3) == ref        # bit-identical resume
+
+    @pytest.mark.parametrize("new_dp", [4, 8])
+    def test_restore_onto_different_dp_world_size(self, tmp_path, new_dp):
+        """Loss-trajectory parity after a reshard-on-load: the moments
+        saved at dp=2 continue at dp=4/8 on the dp=2 trajectory (grad
+        math is identical; only float reduction order may differ)."""
+        mesh2 = _mesh(2)
+        x, y = _data()
+        z, _ = self._train(mesh2, 3, x, y)
+        ckpt.save_train_state(z, str(tmp_path), world_size=2)
+        meta = ckpt.checkpoint_meta(str(tmp_path))
+        assert meta["zero"]["dp"] == 2 and meta["world_size"] == 2
+        ref = _run(z, x, y, 3)
+        mesh_n = _mesh(new_dp)
+        zn, _ = self._train(mesh_n, 0, x, y, seed=9)
+        ckpt.load_train_state(zn, str(tmp_path))
+        np.testing.assert_allclose(_run(zn, x, y, 3), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_zero_checkpoint_into_replicated_step(self, tmp_path):
+        mesh = _mesh(2)
+        x, y = _data()
+        z, _ = self._train(mesh, 3, x, y)
+        ckpt.save_train_state(z, str(tmp_path), world_size=2)
+        ref = _run(z, x, y, 3)
+        m = _mlp(9)
+        o = optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+        t = TrainStep(m, _loss_fn, o, donate=False)
+        ckpt.load_train_state(t, str(tmp_path))
+        # moments arrive reshaped to the parameter shapes
+        for n, p in m.named_parameters():
+            assert t._opt_states[n]["moment1"].shape == p._data.shape
+        np.testing.assert_allclose(_run(t, x, y, 3), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_replicated_checkpoint_into_zero_step(self, tmp_path):
+        mesh = _mesh(2)
+        x, y = _data()
+        m = _mlp()
+        o = optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+        t = TrainStep(m, _loss_fn, o, donate=False)
+        _run(t, x, y, 3)
+        ckpt.save_train_state(t, str(tmp_path), world_size=1)
+        ref = _run(t, x, y, 3)
+        z, _ = self._train(mesh, 0, x, y, seed=9)
+        ckpt.load_train_state(z, str(tmp_path))
+        np.testing.assert_allclose(_run(z, x, y, 3), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_adopt_rejects_size_mismatch(self):
+        mesh = _mesh(2)
+        x, y = _data()
+        z, _ = self._train(mesh, 1, x, y)
+        name = next(iter(z._specs))
+        bad = {name: {"moment1": np.zeros(7777, np.float32)}}
+        with pytest.raises(ValueError):
+            z.adopt_opt_state(bad)
+
+
+# ---------------------------------------------------------------------------
+# CompressedAllReduceTrainStep on the shared helpers
+# ---------------------------------------------------------------------------
+
+class TestCompressedRefactor:
+    def test_f32_wire_matches_plain_trainstep_closely(self):
+        mesh = _mesh(2)
+        x, y = _data()
+        m_c, m_t = _mlp(), _mlp()
+        o_c = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                 parameters=m_c.parameters())
+        o_t = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                 parameters=m_t.parameters())
+        c = CompressedAllReduceTrainStep(m_c, _loss_fn, o_c, mesh=mesh,
+                                         compress_dtype="float32")
+        t = TrainStep(m_t, _loss_fn, o_t)
+        np.testing.assert_allclose(_run(c, x, y, 4), _run(t, x, y, 4),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_bf16_wire_runs_on_cpu(self):
+        """The shared-helper path promotes the bf16 pmean around
+        XLA:CPU's AllReducePromotion crash — the step must run."""
+        mesh = _mesh(2)
+        x, y = _data()
+        m = _mlp()
+        o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                               parameters=m.parameters())
+        c = CompressedAllReduceTrainStep(m, _loss_fn, o, mesh=mesh,
+                                         compress_dtype="bfloat16")
+        losses = _run(c, x, y, 3)
+        assert losses[-1] < losses[0]
+
+    def test_int8_compress_rejected(self):
+        mesh = _mesh(2)
+        m = _mlp()
+        o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                               parameters=m.parameters())
+        with pytest.raises(ValueError):
+            CompressedAllReduceTrainStep(m, _loss_fn, o, mesh=mesh,
+                                         compress_dtype="int8")
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_step_spans_carry_byte_attrs(self, tmp_path):
+        from paddle_tpu.framework import observability as obs
+        mesh = _mesh(2)
+        x, y = _data()
+        tracer = obs.Tracer(trace_dir=str(tmp_path), label="zero_test")
+        import paddle_tpu.parallel.zero as zero_mod
+        saved_mod = zero_mod.tracer
+        zero_mod.tracer = tracer
+        try:
+            m = _mlp()
+            o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                   parameters=m.parameters())
+            s = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                       wire_dtype="bf16", chunk=8)
+            _run(s, x, y, 1)
+        finally:
+            zero_mod.tracer = saved_mod
+            tracer.disable()                   # close -> flush the file
+        import json
+        with open(str(tmp_path / "trace_zero_test.jsonl")) as fh:
+            recs = [json.loads(line) for line in fh if line.strip()]
+        spans = [r for r in recs if r.get("kind") == "span"]
+        names = {s["name"] for s in spans}
+        assert {"zero.step", "zero.reduce_scatter", "zero.update",
+                "zero.all_gather"} <= names
+        rs = [s for s in spans if s["name"] == "zero.reduce_scatter"][0]
+        assert rs["attrs"]["wire"] == "bf16" and rs["attrs"]["bytes"] > 0
+        # the leg markers parent under the step span
+        step = [s for s in spans if s["name"] == "zero.step"][0]
+        assert rs["parent"] == step["span"]
+
+    def test_memory_tracker_tag_attribution(self):
+        from paddle_tpu.framework import flags, health
+        mesh = _mesh(2)
+        x, y = _data()
+        old = flags.get_flags("health_mem_sample_every")[
+            "health_mem_sample_every"]
+        flags.set_flags({"health_mem_sample_every": 1})
+        try:
+            m = _mlp()
+            o = optimizer.Adam(learning_rate=0.05,
+                               parameters=m.parameters())
+            s = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                       wire_dtype="f32", chunk=8)
+            _run(s, x, y, 1)
+        finally:
+            flags.set_flags({"health_mem_sample_every": old})
+        snap = health.memory.snapshot()
+        assert snap["tags"].get("opt_state") == \
+            s.opt_state_bytes_per_replica()
+
+    def test_trajectory_unaffected_by_observability(self):
+        # gauges/spans must not perturb training: two identical runs
+        mesh = _mesh(2)
+        x, y = _data()
+        out = []
+        for _ in range(2):
+            m = _mlp()
+            o = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                   parameters=m.parameters())
+            s = ShardedUpdateTrainStep(m, _loss_fn, o, mesh=mesh,
+                                       wire_dtype="f32", chunk=8)
+            out.append(_run(s, x, y, 3))
+        assert out[0] == out[1]

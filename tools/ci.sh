@@ -67,11 +67,20 @@ echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # promote with --strict once the corpus has been warning-clean a while)
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
-    --zoo ps_transport --zoo ingest --zoo health \
+    --zoo ps_transport --zoo ingest --zoo health --zoo zero_step \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="
 JAX_PLATFORMS=cpu python tools/print_signatures.py --check
+
+echo "== ZeRO collective byte gate (analytic wire MB per leg/dtype, dp=2) =="
+# deterministic per-replica reduce-scatter/all-gather byte counts per
+# wire dtype on the sharded-update step — a change that silently
+# fattens a collective (or breaks the bf16=0.5x / int8~0.25x encodings)
+# fails here; the fused-step wall clock is reported but NOT gated
+JAX_PLATFORMS=cpu python tools/op_bench.py --zero-collectives \
+    --compare tools/op_bench_baseline.json \
+    --thresholds tools/op_bench_thresholds.json
 
 echo "== PS transport byte gate (measured wire MB per op, host-side) =="
 # deterministic byte counts per wire dtype — holds the line on

@@ -121,6 +121,87 @@ def ps_transport_bench(repeats=3):
     return results
 
 
+# ZeRO collective byte suite (--zero-collectives): the sharded-update
+# train step's reduce-scatter / all-gather legs per wire dtype, on a
+# fixed ~1M-param MLP at dp=2.  ``wire_mb`` is ANALYTIC (ShardedUpdate-
+# TrainStep.collective_wire_bytes — exact payload accounting per leg,
+# deterministic across hosts), so the compare gate holds the line on
+# collective bytes with a tight threshold; ``ms`` is the measured full
+# fused-step wall clock (identical for the rs/ag records of one wire —
+# the legs are not separable on the host) and stays informational.
+ZERO_COLLECTIVES_SUITE = [
+    {"name": "zero_rs_mlp1m_f32", "leg": "reduce_scatter", "wire": "f32"},
+    {"name": "zero_rs_mlp1m_bf16", "leg": "reduce_scatter",
+     "wire": "bf16"},
+    {"name": "zero_rs_mlp1m_int8", "leg": "reduce_scatter",
+     "wire": "int8"},
+    {"name": "zero_ag_mlp1m_f32", "leg": "all_gather", "wire": "f32"},
+    {"name": "zero_ag_mlp1m_bf16", "leg": "all_gather", "wire": "bf16"},
+    {"name": "zero_ag_mlp1m_int8", "leg": "all_gather", "wire": "int8"},
+]
+
+
+def zero_collectives_bench(repeats=3):
+    """One sharded-update step per wire dtype on a dp=2 CPU/accelerator
+    mesh; emits a record per (leg, wire) with the analytic per-replica
+    wire MB (gated) and the measured step ms (informational)."""
+    # dp=2 needs >= 2 devices; on a CPU host force a virtual mesh
+    # BEFORE jax initializes (a no-op for non-CPU backends)
+    if "jax" not in sys.modules:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in xf:
+            os.environ["XLA_FLAGS"] = (
+                xf + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "--zero-collectives needs >= 2 devices for a dp=2 mesh "
+            "(CPU hosts get a virtual mesh automatically unless jax "
+            "was already initialized single-device)")
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 512)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 512)).astype(np.float32))
+    results = []
+    by_wire = {}
+    for wire in ("f32", "bf16", "int8"):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(512, 1024), nn.ReLU(),
+                              nn.Linear(1024, 512))
+        opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                 parameters=model.parameters())
+        step = ShardedUpdateTrainStep(model, loss_fn, opt, mesh=mesh,
+                                      wire_dtype=wire)
+        step(x, y)                       # warm (compile)
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            loss = step(x, y)
+            np.asarray(loss._data)       # execution fence
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        by_wire[wire] = (best, step.collective_wire_bytes())
+    for cfg in ZERO_COLLECTIVES_SUITE:
+        best, bytes_ = by_wire[cfg["wire"]]
+        r = {"name": cfg["name"], "op": f"zero.{cfg['leg']}",
+             "ms": round(best * 1e3, 3),
+             "wire_mb": round(bytes_[cfg["leg"]] / 1e6, 5),
+             "device": "host"}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    return results
+
+
 def _resolve(path: str):
     mod, _, attr = path.rpartition(".")
     obj = importlib.import_module(mod)
@@ -555,6 +636,11 @@ def main(argv=None):
                     help="PS wire microbench (pull/push/push_pull per "
                          "wire dtype); gates on measured wire_mb, which "
                          "is deterministic — ms is informational")
+    ap.add_argument("--zero-collectives", action="store_true",
+                    help="ZeRO sharded-update collective bytes "
+                         "(reduce-scatter/all-gather per wire dtype at "
+                         "dp=2); gates on analytic wire_mb, which is "
+                         "deterministic — ms is informational")
     ap.add_argument("--config", help="JSON list of op configs")
     ap.add_argument("--save", help="write results JSON here")
     ap.add_argument("--compare", help="baseline JSON to gate against")
@@ -595,6 +681,9 @@ def main(argv=None):
     if a.ps_transport:
         suite = PS_TRANSPORT_SUITE
         results = ps_transport_bench(repeats=a.repeats)
+    elif a.zero_collectives:
+        suite = ZERO_COLLECTIVES_SUITE
+        results = zero_collectives_bench(repeats=a.repeats)
     else:
         suite = BUILTIN_SUITE
         if a.config:
@@ -634,7 +723,8 @@ def main(argv=None):
         # op this run gates.
         suite_names = {c.get("name", c.get("op")) for c in suite}
         known = suite_names | {c["name"] for c in BUILTIN_SUITE} \
-            | {c["name"] for c in PS_TRANSPORT_SUITE}
+            | {c["name"] for c in PS_TRANSPORT_SUITE} \
+            | {c["name"] for c in ZERO_COLLECTIVES_SUITE}
         missing_base = sorted(suite_names - set(base))
         if missing_base:
             print(f"baseline {a.compare} has no entry for suite op(s): "

@@ -53,6 +53,7 @@ MODULES = [
     "paddle_tpu.distribution",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.elastic",
+    "paddle_tpu.distributed.wire",
     "paddle_tpu.distributed.ps",
     "paddle_tpu.distributed.ps.service",
     "paddle_tpu.distributed.fleet",
@@ -67,6 +68,7 @@ MODULES = [
     "paddle_tpu.profiler",
     "paddle_tpu.onnx",
     "paddle_tpu.regularizer",
+    "paddle_tpu.parallel.zero",
     "paddle_tpu.framework.flags",
     "paddle_tpu.framework.crypto",
     "paddle_tpu.framework.monitor",

@@ -57,6 +57,10 @@ ZOO = {
     # hygiene + the jit compile-observability hooks) — Report, like
     # elastic_step
     "health": lambda: _zoo_health(),
+    # lints the ZeRO sharded-update tier (zero.collective fault-point
+    # hygiene + the shared wire-quantization helpers and the dp meta
+    # strategies folded onto them) — Report, like elastic_step
+    "zero_step": lambda: _zoo_zero_step(),
 }
 
 
@@ -183,6 +187,27 @@ def _zoo_health():
     report = Report()
     for rel in (os.path.join("paddle_tpu", "framework", "health.py"),
                 os.path.join("paddle_tpu", "jit", "__init__.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_zero_step():
+    """AST-lint the sharded weight-update tier — ``parallel/zero.py``
+    (which threads the ``zero.collective`` chaos fault point through the
+    dispatch head), the shared wire-quantization helpers both the PS
+    transport and the collective legs encode with, and the dp meta
+    strategies folded onto them — so PTA301/302 validate the new
+    fault-point site against the registry and its bounded-retry
+    ownership pragma."""
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for rel in (os.path.join("paddle_tpu", "parallel", "zero.py"),
+                os.path.join("paddle_tpu", "parallel", "dp_meta.py"),
+                os.path.join("paddle_tpu", "distributed", "wire.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:
