@@ -156,6 +156,12 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # numerics telemetry: consecutive scale DECREASES with no good
+        # step in between — K of them is a loss-scale collapse
+        # (numerics.scale_collapse flight event), the systematic-
+        # overflow signal the GradScaler/ResilientTrainStep coop
+        # previously had no observability for
+        self._consecutive_downscales = 0
 
     def is_enable(self):
         return self._enable
@@ -213,18 +219,36 @@ class GradScaler:
     def update(self):
         if not self._enable or not self._dynamic:
             return
+        # local import: amp loads with the core tensor tier, before the
+        # framework observability planes need to exist
+        from paddle_tpu.framework import monitor
+        from paddle_tpu.framework.flags import flag
+        from paddle_tpu.framework.observability import flight
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
+                self._consecutive_downscales += 1
+                k = int(flag("numerics_scale_collapse_k"))
+                if k > 0 and self._consecutive_downscales >= k and \
+                        self._consecutive_downscales % k == 0:
+                    # K downscales with no good step between them: the
+                    # overflow is systematic, not a transient batch
+                    cd = self._consecutive_downscales
+                    flight.record("numerics.scale_collapse",
+                                  severity="warn", scale=self._scale,
+                                  consecutive_downscales=cd)
+                    monitor.stat_add("amp_scale_collapses_total")
         else:
             self._good_steps += 1
             self._bad_steps = 0
+            self._consecutive_downscales = 0
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        monitor.stat_set("amp_loss_scale", self._scale)
         self._found_inf = False
 
     def state_dict(self):
@@ -232,9 +256,14 @@ class GradScaler:
                 "decr_ratio": self._decr_ratio,
                 "incr_every_n_steps": self._incr_every,
                 "decr_every_n_nan_or_inf": self._decr_every,
-                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+                "consecutive_downscales": self._consecutive_downscales}
 
     def set_state_dict(self, sd):
         self._scale = sd.get("scale", self._scale)
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)
+        # restore (or, for a pre-telemetry checkpoint, reset) the
+        # collapse streak — a stale streak from this object's past life
+        # must not fire a spurious numerics.scale_collapse
+        self._consecutive_downscales = sd.get("consecutive_downscales", 0)

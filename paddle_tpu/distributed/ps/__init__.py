@@ -694,7 +694,7 @@ class PSTrainStep:
         health.observe("ps_prefetch_miss", 0.0)
         return got
 
-    def _make_step(self, ids_shape):
+    def _make_step(self, ids_shape, numerics_aux: bool = False):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
 
         def step(params, opt_states, buffers, key, lr, rows_u, inv,
@@ -715,6 +715,22 @@ class PSTrainStep:
                 lf, argnums=(0, 1), has_aux=True)(params, rows_u)
             new_params, new_states = apply_functional_update(
                 opt, grads, params, opt_states, lr)
+            if numerics_aux:
+                from paddle_tpu.framework import numerics
+                # the pulled-row gradient is a first-class leaf of the
+                # numerics view ("embedding.rows"): a NaN entering
+                # through the sparse tier attributes there, not to a
+                # dense leaf.  Its update happens host-side on the PS,
+                # so its update term is an exact zero
+                g2 = dict(grads)
+                g2["embedding.rows"] = drows_u
+                p2 = dict(params)
+                p2["embedding.rows"] = rows_u
+                np2 = dict(new_params)
+                np2["embedding.rows"] = rows_u
+                aux = numerics.compute_aux(g2, p2, np2, loss)
+                return (new_params, new_states, new_buffers, loss,
+                        drows_u, aux)
             return new_params, new_states, new_buffers, loss, drows_u
 
         donate = (0, 1) if self.donate else ()
@@ -768,24 +784,36 @@ class PSTrainStep:
             self._opt_states = self.optimizer.functional_init_states(params)
         arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
                 for i in inputs]
+        from paddle_tpu.framework import numerics
+        armed = numerics.enabled()
+        # marker only when armed: the disarmed signature (and jaxpr)
+        # stays byte-identical to the plane-less seed
         sig = (rows_u.shape, str(rows_u.dtype), ids_np.shape,
-               tuple((a.shape, str(a.dtype)) for a in arrs))
+               tuple((a.shape, str(a.dtype)) for a in arrs)) \
+            + (("numerics",) if armed else ())
         fn = self._cache.get(sig)
         compile_cause = None
         if fn is None:
             compile_cause = health.classify_recompile(
                 sig, list(self._cache))
-            fn = self._cache[sig] = self._make_step(ids_np.shape)
+            fn = self._cache[sig] = self._make_step(
+                ids_np.shape, numerics_aux=armed)
         else:
             health.note_cache_hit("PSTrainStep")
         from paddle_tpu.tensor.random import default_generator
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())
         with health.timed_compile("PSTrainStep", compile_cause):
-            new_params, self._opt_states, new_buffers, loss, drows_u = fn(
+            out = fn(
                 params, self._opt_states, buffers, key, lr,
                 jnp.asarray(rows_u), jnp.asarray(inv.astype(_np.int32)),
                 *arrs)
+        aux = None
+        if armed:
+            (new_params, self._opt_states, new_buffers, loss, drows_u,
+             aux) = out
+        else:
+            new_params, self._opt_states, new_buffers, loss, drows_u = out
         # the chip is busy from here until the grad fetch below: issue
         # the announced next batch's shard fan-out NOW so its pull (and
         # the previous step's coalesced push) hides behind the device
@@ -796,6 +824,15 @@ class PSTrainStep:
         for n, b in model.named_buffers():
             if b is not None and n in new_buffers:
                 b._data = new_buffers[n]
+        if aux is not None:
+            # publish after the prefetch issue: the aux fetch is the
+            # step's one host sync, and the next pull already rides the
+            # background executor by now
+            rec = numerics.NumericsRecord(
+                list(params) + ["embedding.rows"], aux,
+                step=int(getattr(self.optimizer, "_global_step", 0)))
+            numerics.publish(rec)
+            self.last_numerics = rec
         grads_host = _np.asarray(drows_u)[:len(uniq)].astype(_np.float32)
         if self.prefetch_depth > 0 and (pipelined or self._inflight
                                         or self._announced):

@@ -34,6 +34,11 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         checkpoint.py)
 ``download.fetch``      each fetch attempt (utils/download.py)
 ``train.step_grads``    per-step input poisoning (framework/resilient.py)
+                        — ``mode="nan"`` with ``payload_index=i``
+                        poisons only the i-th step input, so the NaN
+                        reaches exactly the parameter leaves that input
+                        feeds (the numerics plane's per-leaf provenance
+                        fault)
 ``elastic.lease``       every lease renewal (distributed/elastic.py
                         RendezvousStore.renew) — ``mode="error"`` is a
                         lost renewal: the lease runs out, a peer's sweep
@@ -58,6 +63,14 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         the retried trajectory is bit-identical),
                         ``mode="latency"`` a slow interconnect the
                         dispatch simply absorbs
+``numerics.observe``    head of every model-numerics publish
+                        (framework/numerics.py publish) —
+                        ``mode="error"`` is a broken stats exporter the
+                        publish path must swallow and count
+                        (``numerics_observe_errors_total``): the
+                        watcher must never crash the watched train
+                        step; ``mode="latency"`` a slow one the step
+                        simply absorbs
 =====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
@@ -97,7 +110,8 @@ __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
 FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "ckpt.save", "download.fetch", "train.step_grads",
                 "elastic.lease", "elastic.worker_hang",
-                "health.detector", "zero.collective")
+                "health.detector", "zero.collective",
+                "numerics.observe")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)
@@ -148,7 +162,7 @@ class FaultSpec:
     def __init__(self, mode: str = "error", nth: Optional[int] = None,
                  every: Optional[int] = None, p: float = 0.0,
                  latency: float = 0.0, n_times: Optional[int] = None,
-                 message: str = ""):
+                 message: str = "", payload_index: Optional[int] = None):
         if mode not in ("error", "latency", "nan"):
             raise ValueError(f"unknown chaos mode {mode!r}")
         self.mode = mode
@@ -158,6 +172,12 @@ class FaultSpec:
         self.latency = float(latency)
         self.n_times = n_times
         self.message = message
+        # mode="nan" targeting: poison only the payload_index-th element
+        # of a tuple/list payload (e.g. ONE input of a train step, so a
+        # NaN reaches exactly the parameter leaves that input feeds —
+        # the numerics plane's per-leaf provenance is provable only
+        # with a fault this surgical); None poisons every float array
+        self.payload_index = payload_index
         self.calls = 0
         self.trips = 0
 
@@ -231,7 +251,7 @@ class ChaosRegistry:
             time.sleep(spec.latency)
             return payload
         if spec.mode == "nan":
-            return _poison(payload)
+            return _poison(payload, index=spec.payload_index)
         raise InjectedFault(
             f"chaos[{name}] injected fault (call {spec.calls}"
             + (f", {meta}" if meta else "") + ")"
@@ -243,13 +263,24 @@ class ChaosRegistry:
                     for n, s in self._specs.items()}
 
 
-def _poison(payload):
+def _poison(payload, index=None):
     """NaN-poison every float array in ``payload`` (first element of each
     array, enough for any finiteness sweep to trip); non-float leaves and
-    non-array values pass through untouched."""
+    non-array values pass through untouched.  ``index`` (FaultSpec
+    ``payload_index``) restricts the poison to one element of a
+    tuple/list payload — the targeted-gradient fault the numerics
+    plane's leaf attribution is tested with."""
     if payload is None:
         return None
     if isinstance(payload, (list, tuple)):
+        if index is not None:
+            out = list(payload)
+            if not -len(out) <= index < len(out):
+                raise IndexError(
+                    f"chaos payload_index {index} out of range for a "
+                    f"{len(out)}-element payload")
+            out[index] = _poison(out[index])
+            return type(payload)(out)
         return type(payload)(_poison(p) for p in payload)
     data = getattr(payload, "_data", None)       # paddle Tensor
     if data is not None:

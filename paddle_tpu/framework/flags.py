@@ -215,6 +215,33 @@ define_flag("health_mem_sample_every", 0,
             "sample jax.live_arrays() into device_mem_* gauges every "
             "N train steps (health.MemoryTracker); 0 disables the "
             "per-step hook (sample() stays callable directly)")
+# model-numerics tier (framework/numerics.py in-jit tensor stats):
+define_flag("numerics", False,
+            "arm the model-numerics plane: TrainStep/PSTrainStep/"
+            "ShardedUpdateTrainStep compute per-leaf + global grad/param "
+            "norms, update ratios, max-abs and non-finite counts INSIDE "
+            "the jitted step and publish them as monitor gauges/"
+            "histograms + health-detector signals; ResilientTrainStep "
+            "switches its finite check to the in-jit aux and stamps "
+            "first_bad_leaf into train.nan_skip.  Off (default): the "
+            "step traces exactly the disarmed computation — no extra "
+            "outputs, no recompile")
+define_flag("numerics_sample_every", 10,
+            "per-leaf numerics export cadence: the numerics_*[<leaf>] "
+            "attribution gauges refresh every Nth published step, and "
+            "(when the cadence is > 0) on every non-finite step — the "
+            "post-mortem wants the leaf split exactly then.  0 is a "
+            "HARD off for the per-leaf export (the metric-cardinality "
+            "cap on huge models; NaN provenance still reaches the "
+            "flight event), global gauges/histograms still publish "
+            "every step")
+define_flag("numerics_scale_collapse_k", 4,
+            "consecutive GradScaler downscales that constitute a loss-"
+            "scale collapse: the amp.GradScaler update path exports its "
+            "current scale as the amp_loss_scale gauge and records a "
+            "numerics.scale_collapse flight event every K consecutive "
+            "decreases (a scale halving K times without an intervening "
+            "good streak is a systematic overflow, not a transient)")
 define_flag("profiler_max_spans", 100000,
             "cap on retained chrome-trace spans per profiling session; "
             "beyond it spans are dropped (counted — the Profiling "

@@ -172,16 +172,42 @@ class Detector:
     def update(self, value) -> Optional[Anomaly]:
         """Score one observation; returns the :class:`Anomaly` when it
         trips, else None.  Thread-safe: concurrent feeders (the PS
-        client's RPC fan-out threads) serialize on the detector."""
+        client's RPC fan-out threads) serialize on the detector.
+
+        A NON-FINITE observation (a NaN grad norm on a blown-up step)
+        is an anomaly by definition — flagged immediately, even during
+        warmup, with ``z=inf`` — and never folds into the EWMA or the
+        baseline window (one NaN would otherwise poison both
+        forever)."""
         v = float(value)
         with self._lock:
             self.n += 1
             self.last = v
+            if not np.isfinite(v):
+                self.anomalies += 1
+                self.consecutive += 1
+                # median BEFORE any rebaseline clear: the anomaly must
+                # report the baseline it was judged against
+                med = float(np.median(np.asarray(self._values,
+                                                 np.float64))) \
+                    if self._values else 0.0
+                if self.consecutive >= self.max_consecutive:
+                    self._values.clear()
+                    self._warm_left = self.warmup
+                    self.consecutive = 0
+                    self.rebaselines += 1
+                self.last_z = float("inf")
+                return Anomaly(self.signal, v, float("inf"), med, 0.0,
+                               self.n, self.clock())
             self.ewma = v if self.ewma is None else \
                 self.ewma_alpha * v + (1.0 - self.ewma_alpha) * self.ewma
             if self._warm_left > 0:
                 self._warm_left -= 1
                 self._values.append(v)
+                # a clean sample breaks any non-finite anomaly streak
+                # even during warmup (the z=inf rule can flag here):
+                # isolated NaNs must not ratchet toward a rebaseline
+                self.consecutive = 0
                 return None
             vals = np.asarray(self._values, np.float64)
             med = float(np.median(vals))
@@ -239,6 +265,15 @@ DEFAULT_SIGNALS: Dict[str, dict] = {
     # the floors make a single post-warmup miss a detectable event on
     # an all-hit baseline without alarming a mixed one
     "ps_prefetch_miss": {"min_mad": 0.05, "z_threshold": 10.0},
+    # model-numerics drift signals (framework/numerics.py publish, fed
+    # only when FLAGS_numerics arms the in-jit stats; a non-finite
+    # value flags instantly via the z=inf rule, and provenance names
+    # the leaf).  The wide relative floor absorbs the natural decay of
+    # grad norms over a healthy run; a multiple-of-baseline spike (10x
+    # grad blow-up, lr accident, loss-scale overflow) trips the step
+    # it lands
+    "grad_norm": {"rel_floor": 0.5, "min_mad": 1e-9},
+    "update_ratio": {"rel_floor": 0.5, "min_mad": 1e-9},
 }
 
 

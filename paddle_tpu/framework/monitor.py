@@ -264,7 +264,29 @@ def _prom_num(v: Number) -> str:
         return str(int(v))
     if isinstance(v, int):
         return str(v)
-    return repr(float(v))
+    f = float(v)
+    if f != f:
+        return "NaN"                  # a NaN gauge (numerics on a bad
+    if f in (float("inf"), float("-inf")):  # step) must still scrape
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def _split_leaf(name: str):
+    """Split a per-leaf stat name — ``base[leaf.path]`` (the numerics
+    plane's attribution gauges carry dotted/bracketed pytree paths) —
+    into ``(base, leaf)``; ``(name, None)`` for a plain stat."""
+    if name.endswith("]") and "[" in name:
+        base, leaf = name.split("[", 1)
+        return base, leaf[:-1]
+    return name, None
+
+
+def _prom_label_value(v: str) -> str:
+    """Escape a label value per the Prometheus exposition grammar
+    (backslash, double quote, newline)."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def export_prometheus() -> str:
@@ -272,17 +294,31 @@ def export_prometheus() -> str:
     values may go down) and every histogram (cumulative ``_bucket``
     series + ``_sum``/``_count``) in the Prometheus exposition text
     format, ready for a textfile collector or HTTP scrape handler.
+
+    Names are sanitized into the metric-name charset; a per-leaf stat
+    named ``base[leaf.path]`` exports as ``base{leaf="leaf.path"}`` —
+    the pytree path survives verbatim in the (escaped) label value
+    instead of being mangled into the metric name.
     ``observability.validate_prometheus`` checks the grammar; the CI
     observability lane round-trips this output through it."""
     lines = []
     seen = set()
+    groups: Dict[str, list] = {}
     for name, v in sorted(all_stats().items()):
-        n = _prom_name(name)
-        if n in seen:
+        base, leaf = _split_leaf(name)
+        n = _prom_name(base)
+        label = None if leaf is None else \
+            f'leaf="{_prom_label_value(leaf)}"'
+        pairs = groups.setdefault(n, [])
+        if any(lab == label for lab, _ in pairs):
             continue                      # sanitization collision: first wins
+        pairs.append((label, v))
+    for n in sorted(groups):
         seen.add(n)
         lines.append(f"# TYPE {n} gauge")
-        lines.append(f"{n} {_prom_num(v)}")
+        for label, v in groups[n]:
+            lines.append(f"{n} {_prom_num(v)}" if label is None
+                         else f"{n}{{{label}}} {_prom_num(v)}")
     with _hist_lock:
         hs = sorted(_hists.items())
     for name, h in hs:

@@ -1,0 +1,413 @@
+"""Model-numerics observability plane: in-jit tensor stats, NaN
+provenance, and gradient-drift signals.
+
+The reference ships a per-op NaN/Inf watcher (``FLAGS_check_nan_inf``,
+framework/details/nan_inf_utils.h) that names *which op* blew up; this
+repo's rollback tier (framework/resilient.py) only knew "the loss went
+non-finite" after a host sync, and nothing watched gradient magnitudes
+at all.  This module is the model-signal twin of the PR-7 system-health
+plane (framework/health.py): cheap reductions computed **inside the
+jitted step** — per-leaf and global grad norms, param norms,
+update/param ratios, max-abs, and non-finite counts — returned as
+auxiliary outputs of ``TrainStep`` / ``PSTrainStep`` /
+``ShardedUpdateTrainStep`` and published into the existing planes
+(monitor gauges + histograms, health detectors, flight recorder).
+
+Design center, same as the health plane:
+
+* **cheap when off** — arming is ``FLAGS_numerics``; disarmed, the step
+  classes build exactly the seed computation (no extra outputs, no
+  recompile: the signature-cache key only grows a marker when armed),
+  and the per-step cost is one flag read;
+* **no host callbacks, no extra device syncs** — the stats are O(#leaf)
+  scalar reductions fused into the step's own XLA computation and ride
+  back with its outputs; the host reads them where it already
+  synchronizes (the loss / finite check);
+* **shard-map aware** — under ``ShardedUpdateTrainStep`` each leaf is a
+  1/dp chunk: sum-of-squares and non-finite counts are computed
+  shard-locally and ``psum``-ed, max-abs ``pmax``-ed (the global-norm
+  clip idiom in parallel/zero.py), so the exported global grad norm is
+  the replicated step's norm bit-for-bit-comparable;
+* **NaN provenance** — the per-leaf non-finite counts name the first
+  offending parameter leaf (sorted leaf-name order);
+  ``ResilientTrainStep`` stamps it into the ``train.nan_skip`` flight
+  event as ``first_bad_leaf`` and uses the same aux as its in-jit
+  finite check (the previous per-step host ``np.isfinite`` param sweep
+  disappears);
+* **the watcher never crashes the watched** — host-side publishing runs
+  behind the ``numerics.observe`` chaos fault point: an injected error
+  is swallowed and counted (``numerics_observe_errors_total``).
+
+Exported metrics (monitor):
+
+==============================  ============================================
+``grad_norm`` (histogram)        global L2 grad norm per step
+``param_norm`` (histogram)       global L2 param norm per step
+``update_ratio`` (histogram)     global update-norm / param-norm per step
+``numerics_grad_norm`` …         gauges: the latest global values
+``numerics_grad_norm[<leaf>]``…  per-leaf gauges at the sampled cadence
+                                 (``FLAGS_numerics_sample_every``); the
+                                 bracketed suffix exports as a
+                                 Prometheus ``leaf`` label
+``numerics_nonfinite_steps_total``  steps with any non-finite stat
+``numerics_observe_errors_total``   swallowed publish faults
+==============================  ============================================
+
+Detector feed: every global value is offered to ``health.observe``
+under the signals ``grad_norm`` / ``update_ratio`` (both in
+``health.DEFAULT_SIGNALS``) — a 10× grad spike trips the default
+detector the step it lands, and a NON-finite value is an anomaly by
+definition (``Detector``'s z=inf rule: flagged immediately, never
+folded into the EWMA or baseline window), so the detector fires AT
+the blown-up step and the provenance record names the leaf.
+Histograms only ever record finite values.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework.flags import flag
+
+__all__ = ["AUX_KEYS", "DRIFT_SIGNALS", "enabled", "sample_every",
+           "compute_aux", "NumericsRecord", "publish", "watch_defaults",
+           "reset"]
+
+#: the aux pytree every armed step returns — per-leaf f32/int32 vectors
+#: of length L (#parameter leaves) plus one scalar for the loss
+AUX_KEYS = ("grad_sq", "param_sq", "update_sq", "grad_maxabs",
+            "grad_nonfinite", "param_nonfinite", "loss_nonfinite")
+
+#: the drift signals this plane feeds — the per-signal detector kwargs
+#: live in ``health.DEFAULT_SIGNALS`` (one source of truth; the grad
+#: norm entries there document the floor rationale), so
+#: ``FLAGS_health_detectors=default`` arms them too
+DRIFT_SIGNALS = ("grad_norm", "update_ratio")
+
+
+def enabled() -> bool:
+    """True when the in-jit stats are armed (``FLAGS_numerics``)."""
+    return bool(flag("numerics"))
+
+
+def sample_every() -> int:
+    """Per-leaf export cadence (``FLAGS_numerics_sample_every``): the
+    per-leaf gauges refresh every Nth published step; 0 disables the
+    per-leaf export (global gauges/histograms still publish every
+    step)."""
+    return int(flag("numerics_sample_every"))
+
+
+# ---------------------------------------------------------------------------
+# in-jit computation (traced inside the step)
+# ---------------------------------------------------------------------------
+
+def compute_aux(grads: dict, params: dict, new_params: dict, loss,
+                axis_name: Optional[str] = None) -> dict:
+    """Build the numerics aux pytree INSIDE a traced step.
+
+    ``grads`` / ``params`` / ``new_params`` are same-keyed dicts of
+    (possibly shard-local) arrays; ``loss`` the step's scalar loss.
+    Leaf order is SORTED key order — jax's pytree flattening sorts
+    dict keys, so a dict that crossed a jit boundary iterates sorted
+    while one built inside the trace iterates in insertion order;
+    sorting here pins one canonical order for both, and the step
+    classes build their :class:`NumericsRecord` with
+    ``sorted(names)`` to match.
+
+    Under ``shard_map`` pass ``axis_name``: sum-of-squares and
+    non-finite counts reduce shard-locally then ``psum`` (padding
+    chunks contribute exact zeros), max-abs ``pmax``-es — every replica
+    leaves with the identical global vectors, so the aux satisfies a
+    replicated out_spec.  The loss must already be replicated (the
+    steps ``pmean`` it first).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    names = sorted(grads)
+    f32 = jnp.float32
+
+    def _stack(vals, dtype):
+        if not names:
+            return jnp.zeros((0,), dtype)
+        return jnp.stack(vals).astype(dtype)
+
+    def _nonfinite(a):
+        if not jnp.issubdtype(a.dtype, jnp.inexact):
+            return jnp.zeros((), jnp.int32)
+        return jnp.sum(~jnp.isfinite(a)).astype(jnp.int32)
+
+    gsq = _stack([jnp.sum(grads[n].astype(f32) ** 2) for n in names], f32)
+    psq = _stack([jnp.sum(params[n].astype(f32) ** 2) for n in names], f32)
+    usq = _stack([jnp.sum((new_params[n].astype(f32)
+                           - params[n].astype(f32)) ** 2)
+                  for n in names], f32)
+    gmax = _stack([(jnp.max(jnp.abs(grads[n].astype(f32)))
+                    if grads[n].size else jnp.zeros((), f32))
+                   for n in names], f32)
+    gnf = _stack([_nonfinite(grads[n]) for n in names], jnp.int32)
+    pnf = _stack([_nonfinite(new_params[n]) for n in names], jnp.int32)
+    loss_arr = jnp.asarray(loss)
+    lnf = jnp.sum(~jnp.isfinite(loss_arr.astype(f32))).astype(jnp.int32)
+    if axis_name is not None:
+        gsq = jax.lax.psum(gsq, axis_name)
+        psq = jax.lax.psum(psq, axis_name)
+        usq = jax.lax.psum(usq, axis_name)
+        gmax = jax.lax.pmax(gmax, axis_name)
+        gnf = jax.lax.psum(gnf, axis_name)
+        pnf = jax.lax.psum(pnf, axis_name)
+        # loss is pmean-ed by the sharded steps before it gets here, so
+        # lnf is already identical on every replica — no reduce needed
+    return {"grad_sq": gsq, "param_sq": psq, "update_sq": usq,
+            "grad_maxabs": gmax, "grad_nonfinite": gnf,
+            "param_nonfinite": pnf, "loss_nonfinite": lnf}
+
+
+# ---------------------------------------------------------------------------
+# host-side record
+# ---------------------------------------------------------------------------
+
+class NumericsRecord:
+    """One step's numerics aux, host side.
+
+    Holds the device arrays and converts them to numpy LAZILY on first
+    read (one fetch for all keys — by then the step's computation has
+    completed anyway, so this is the same sync reading the loss pays).
+    Global norms derive from the per-leaf sum-of-squares; update_ratio
+    is update-norm / param-norm (0 when the param norm is 0).
+
+    ``names`` is canonicalized to sorted order — the order
+    :func:`compute_aux` stacked the per-leaf vectors in.
+    """
+
+    __slots__ = ("names", "step", "_aux", "_np")
+
+    def __init__(self, names: List[str], aux: dict,
+                 step: Optional[int] = None):
+        self.names = sorted(names)
+        self.step = step
+        self._aux = aux
+        self._np: Optional[Dict[str, np.ndarray]] = None
+
+    def _fetch(self) -> Dict[str, np.ndarray]:
+        if self._np is None:
+            self._np = {k: np.asarray(v) for k, v in self._aux.items()}
+            self._aux = None          # drop the device refs once read
+        return self._np
+
+    # -- global scalars ------------------------------------------------------
+    @staticmethod
+    def _norm(sq) -> float:
+        """sqrt of a sum-of-squares, NaN/Inf-PROPAGATING: ``max(0.0,
+        nan)`` is 0.0 in Python, so a naive clamp would silently report
+        a blown-up step as a zero norm — exactly the value that would
+        poison a drift detector's baseline while hiding the blow-up."""
+        s = float(sq)
+        if math.isnan(s):
+            return s
+        return math.sqrt(max(0.0, s))
+
+    @property
+    def grad_norm(self) -> float:
+        return self._norm(self._fetch()["grad_sq"].sum())
+
+    @property
+    def param_norm(self) -> float:
+        return self._norm(self._fetch()["param_sq"].sum())
+
+    @property
+    def update_norm(self) -> float:
+        return self._norm(self._fetch()["update_sq"].sum())
+
+    @property
+    def update_ratio(self) -> float:
+        p = self.param_norm
+        if math.isnan(p):
+            return p
+        return self.update_norm / p if p > 0.0 else 0.0
+
+    @property
+    def max_abs_grad(self) -> float:
+        a = self._fetch()["grad_maxabs"]
+        return float(a.max()) if a.size else 0.0
+
+    @property
+    def nonfinite_grads(self) -> int:
+        return int(self._fetch()["grad_nonfinite"].sum())
+
+    @property
+    def nonfinite_params(self) -> int:
+        return int(self._fetch()["param_nonfinite"].sum())
+
+    @property
+    def nonfinite_loss(self) -> int:
+        return int(self._fetch()["loss_nonfinite"])
+
+    # -- provenance ----------------------------------------------------------
+    def finite(self, check_params: bool = True) -> bool:
+        """The in-jit finite verdict: loss and every grad leaf finite
+        (and every post-update param leaf when ``check_params`` — the
+        ``check_state=True`` sweep of ResilientTrainStep, now free)."""
+        if self.nonfinite_loss or self.nonfinite_grads:
+            return False
+        if check_params and self.nonfinite_params:
+            return False
+        return True
+
+    def first_bad_leaf(self) -> Optional[str]:
+        """The first parameter leaf (sorted leaf-name order) with a
+        non-finite gradient — falling back to the first leaf with a
+        non-finite post-update param, then None (loss-only blow-up)."""
+        a = self._fetch()
+        for key in ("grad_nonfinite", "param_nonfinite"):
+            bad = np.nonzero(a[key])[0]
+            if bad.size:
+                return self.names[int(bad[0])]
+        return None
+
+    def bad_leaves(self) -> List[str]:
+        """Every leaf with a non-finite grad or post-update param."""
+        a = self._fetch()
+        mask = (a["grad_nonfinite"] > 0) | (a["param_nonfinite"] > 0)
+        return [n for n, m in zip(self.names, mask) if m]
+
+    # -- per-leaf view -------------------------------------------------------
+    def per_leaf(self) -> Dict[str, dict]:
+        a = self._fetch()
+        out = {}
+        for i, n in enumerate(self.names):
+            pn = self._norm(a["param_sq"][i])
+            un = self._norm(a["update_sq"][i])
+            # NaN-propagating like the global property: `nan > 0.0` is
+            # False, and 0.0 would read as a healthy leaf
+            ratio = pn if math.isnan(pn) else (
+                un / pn if pn > 0.0 else 0.0)
+            out[n] = {
+                "grad_norm": self._norm(a["grad_sq"][i]),
+                "param_norm": pn,
+                "update_ratio": ratio,
+                "max_abs_grad": float(a["grad_maxabs"][i]),
+                "nonfinite": int(a["grad_nonfinite"][i]
+                                 + a["param_nonfinite"][i]),
+            }
+        return out
+
+    def to_dict(self) -> dict:
+        return {"step": self.step, "grad_norm": self.grad_norm,
+                "param_norm": self.param_norm,
+                "update_ratio": self.update_ratio,
+                "max_abs_grad": self.max_abs_grad,
+                "nonfinite": {"loss": self.nonfinite_loss,
+                              "grads": self.nonfinite_grads,
+                              "params": self.nonfinite_params},
+                "first_bad_leaf": self.first_bad_leaf()}
+
+    def __repr__(self):
+        return (f"NumericsRecord(step={self.step} "
+                f"grad_norm={self.grad_norm:.4g} "
+                f"update_ratio={self.update_ratio:.4g} "
+                f"nonfinite={self.nonfinite_grads + self.nonfinite_params + self.nonfinite_loss})")
+
+
+# ---------------------------------------------------------------------------
+# publishing (gauges, histograms, detectors, per-leaf sampling)
+# ---------------------------------------------------------------------------
+
+_publish_calls = 0
+_publish_lock = threading.Lock()
+
+
+def publish(record: NumericsRecord) -> Optional[NumericsRecord]:
+    """Fold one step's record into the monitor/health planes.
+
+    Global gauges + histograms every call; per-leaf gauges at the
+    ``FLAGS_numerics_sample_every`` cadence.  Global values feed the
+    ``grad_norm`` / ``update_ratio`` health detectors — a non-finite
+    value flags immediately (Detector z=inf rule) while staying out of
+    the baselines and histograms, and is counted
+    (``numerics_nonfinite_steps_total``).  The ``numerics.observe``
+    chaos fault point fires at the
+    head: an injected error is swallowed and counted — the watcher must
+    never crash the watched train step.  Returns the record (None when
+    a fault swallowed the publish).
+    """
+    from paddle_tpu.framework import health
+    try:
+        chaos.fault_point("numerics.observe",
+                          meta={"step": record.step})
+    except chaos.InjectedFault:
+        # the watcher must never crash the watched: swallow, count
+        monitor.stat_add("numerics_observe_errors_total")
+        return None
+    g, p, r, mx = (record.grad_norm, record.param_norm,
+                   record.update_ratio, record.max_abs_grad)
+    monitor.stat_set("numerics_grad_norm", g)
+    monitor.stat_set("numerics_param_norm", p)
+    monitor.stat_set("numerics_update_ratio", r)
+    monitor.stat_set("numerics_max_abs_grad", mx)
+    nonfinite = (record.nonfinite_loss or record.nonfinite_grads
+                 or record.nonfinite_params)
+    if nonfinite:
+        monitor.stat_add("numerics_nonfinite_steps_total")
+    for name, v in (("grad_norm", g), ("param_norm", p),
+                    ("update_ratio", r)):
+        if np.isfinite(v):
+            monitor.observe(name, v)
+        if name != "param_norm":
+            # drift detectors see every value: a non-finite one flags
+            # immediately (Detector's z=inf rule) without ever entering
+            # the baseline — the detector fires AT the blown-up step,
+            # provenance then names the leaf
+            health.observe(name, v)
+    global _publish_calls
+    every = sample_every()
+    due = False
+    if every > 0:
+        with _publish_lock:
+            _publish_calls += 1
+            due = _publish_calls % every == 0
+        due = due or bool(nonfinite)
+    # per-leaf attribution: sampled on the healthy path (L gauges per
+    # refresh is the whole cost), always on a non-finite step — the
+    # post-mortem wants the leaf split exactly then.  every=0 is a HARD
+    # off (the operator's metric-cardinality cap; NaN provenance still
+    # reaches the flight event via first_bad_leaf, not these gauges)
+    if due:
+        for leaf, d in record.per_leaf().items():
+            monitor.stat_set(f"numerics_grad_norm[{leaf}]",
+                             d["grad_norm"])
+            monitor.stat_set(f"numerics_update_ratio[{leaf}]",
+                             d["update_ratio"])
+            monitor.stat_set(f"numerics_max_abs_grad[{leaf}]",
+                             d["max_abs_grad"])
+            if d["nonfinite"]:
+                monitor.stat_add(f"numerics_nonfinite[{leaf}]",
+                                 d["nonfinite"])
+    return record
+
+
+def watch_defaults(**overrides):
+    """Arm the plane's default drift detectors (:data:`DRIFT_SIGNALS`)
+    on the process health monitor — idempotent, like every
+    ``health.watch``.  ``overrides`` update the per-signal kwargs
+    (e.g. ``warmup=8`` for short test runs)."""
+    from paddle_tpu.framework import health
+    dets = {}
+    for signal in DRIFT_SIGNALS:
+        kw = dict(health.DEFAULT_SIGNALS.get(signal, {}))
+        kw.update(overrides)
+        dets[signal] = health.watch(signal, **kw)
+    return dets
+
+
+def reset():
+    """Per-test clean slate for the publish cadence counter (gauges and
+    detectors are owned by monitor/health reset as usual)."""
+    global _publish_calls
+    with _publish_lock:
+        _publish_calls = 0

@@ -28,6 +28,14 @@ dispatch: arming it with ``mode="nan"`` NaN-poisons the batch, the real
 forward/backward propagates the poison into loss and grads, and the
 rollback path is exercised end-to-end (tests/test_chaos.py proves a
 poisoned run still reaches the un-poisoned final loss).
+
+Model numerics (FLAGS_numerics): when the wrapped step computes the
+in-jit numerics aux (framework/numerics.py), the finite check reads
+that record instead of running a host ``np.isfinite`` sweep — loss,
+every gradient leaf, and (``check_state``) every post-update parameter
+leaf in one fetch — and a skipped step's ``train.nan_skip`` flight
+event names the first offending leaf (``first_bad_leaf``), the step-
+granularity analogue of the reference watcher naming the offending op.
 """
 from __future__ import annotations
 
@@ -83,6 +91,11 @@ class ResilientTrainStep:
         self.skipped_steps = 0
         self.rollbacks = 0
         self.last_step_skipped = False
+        # NaN provenance (FLAGS_numerics armed on the wrapped step):
+        # the first parameter leaf with a non-finite grad/param on the
+        # most recently skipped step — also stamped into the
+        # train.nan_skip flight event as first_bad_leaf
+        self.last_bad_leaf: Optional[str] = None
         self.membership_epoch: Optional[int] = None
         self.membership_events = 0
 
@@ -137,7 +150,17 @@ class ResilientTrainStep:
         self.snapshot()
 
     # -- detection -----------------------------------------------------------
-    def _finite(self, loss) -> bool:
+    def _finite(self, loss, numerics_rec=None) -> bool:
+        """The per-step finite verdict.  With a fresh model-numerics
+        record (FLAGS_numerics armed on the wrapped step) the verdict
+        comes from the in-jit aux — loss, every gradient leaf, and
+        (``check_state``) every post-update parameter leaf in ONE
+        reduction that rode back with the step outputs, replacing both
+        the host ``np.isfinite`` sweep and the per-leaf device reduces
+        of the legacy path.  Disarmed, the host path below is the
+        fallback and behaves exactly as before."""
+        if numerics_rec is not None:
+            return numerics_rec.finite(check_params=self.check_state)
         arr = loss._data if hasattr(loss, "_data") else loss
         if not bool(np.all(np.isfinite(np.asarray(arr)))):
             return False
@@ -155,15 +178,26 @@ class ResilientTrainStep:
             self.snapshot()
         inputs = chaos.fault_point("train.step_grads", payload=inputs)  # pta: disable=PTA301 (ResilientTrainStep IS the recovery wrapper)
         self.last_step_skipped = False
+        # a FRESH numerics record (stashed by the wrapped step during
+        # THIS call, when FLAGS_numerics is armed) carries the in-jit
+        # finite verdict + per-leaf NaN provenance; a stale one from an
+        # earlier step must not be trusted — compare identity around
+        # the call
+        rec_before = getattr(self.step, "last_numerics", None)
+        rec = None
         try:
             loss = self.step(*inputs)
-            finite = self._finite(loss)
+            rec = getattr(self.step, "last_numerics", None)
+            rec = rec if rec is not rec_before else None
+            finite = self._finite(loss, rec)
         except FloatingPointError:
             # FLAGS_check_nan_inf armed inside the wrapped step: same
             # recovery path as our own detection.  Stand in a NaN scalar
             # for the loss the step never returned, so the skipped-step
             # return is always float()-able (see the docstring note).
             from paddle_tpu.core import Tensor
+            rec = getattr(self.step, "last_numerics", None)
+            rec = rec if rec is not rec_before else None
             loss = Tensor(jnp.asarray(jnp.nan, dtype=jnp.float32))
             finite = False
         if self.scaler is not None:
@@ -179,10 +213,13 @@ class ResilientTrainStep:
         self.skipped_steps += 1
         self.rollbacks += 1
         self.last_step_skipped = True
+        self.last_bad_leaf = rec.first_bad_leaf() if rec is not None \
+            else None
         monitor.stat_add("train_nan_skips_total")
         flight.record("train.nan_skip", severity="warn",
                       consecutive=self.consecutive_bad,
-                      skipped_total=self.skipped_steps)
+                      skipped_total=self.skipped_steps,
+                      first_bad_leaf=self.last_bad_leaf)
         self.restore()
         if self.consecutive_bad >= self.max_consecutive_bad:
             flight.record("train.abort", severity="error",

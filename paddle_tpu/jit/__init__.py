@@ -340,11 +340,19 @@ class TrainStep:
         self._opt_states: Optional[dict] = None
 
     # -- pure step ----------------------------------------------------------
-    def _build_one_step(self):
+    def _build_one_step(self, numerics_aux: bool = False):
         """The shared step body: forward + grad (with optional micro-batch
         gradient-merge) + optimizer update.  Both the per-call jit
         (_make_step) and the device-resident loop (_make_multi_step) wrap
-        exactly this function, so their training semantics cannot drift."""
+        exactly this function, so their training semantics cannot drift.
+
+        ``numerics_aux=True`` (FLAGS_numerics armed at dispatch) appends
+        the model-numerics aux pytree (framework/numerics.py: per-leaf
+        grad/param/update sum-of-squares, max-abs, non-finite counts) as
+        a fifth output — pure extra reductions over values the step
+        already computes, so the loss/param trajectory is bitwise
+        unchanged; disarmed, the traced computation is exactly the
+        legacy one (no extra outputs)."""
         model = self.model
         loss_fn = self.loss_fn
         opt = self.optimizer
@@ -388,6 +396,11 @@ class TrainStep:
                     has_aux=True)(params)
             new_params, new_states = apply_functional_update(
                 opt, grads, params, opt_states, lr)
+            if numerics_aux:
+                from paddle_tpu.framework import numerics
+                aux = numerics.compute_aux(grads, params, new_params,
+                                           loss)
+                return new_params, new_states, new_buffers, loss, aux
             return new_params, new_states, new_buffers, loss
 
         return one_step
@@ -442,8 +455,8 @@ class TrainStep:
         if check and self.donate and not finite:
             raise FloatingPointError(msg)
 
-    def _make_step(self):
-        one_step = self._build_one_step()
+    def _make_step(self, numerics_aux: bool = False):
+        one_step = self._build_one_step(numerics_aux=numerics_aux)
 
         def step(params, opt_states, buffers, key, lr, *inputs):
             return one_step(params, opt_states, buffers, key, lr,
@@ -514,6 +527,11 @@ class TrainStep:
         from it inside the loop, so stochastic layers (dropout) see
         different — equally independent — randomness than K sequential
         ``__call__``s, and the host generator advances once, not K times.
+
+        The model-numerics plane (FLAGS_numerics) instruments only the
+        per-call ``__call__`` path: a K-step device-resident loop has no
+        per-step host boundary to publish at, so the loop body stays
+        the disarmed computation.
         """
         from paddle_tpu.framework import health
         named_params, named_buffers, params, buffers, arrs, key, lr = \
@@ -551,12 +569,17 @@ class TrainStep:
     def __call__(self, *inputs):
         import time as _time
 
-        from paddle_tpu.framework import health, monitor
+        from paddle_tpu.framework import health, monitor, numerics
         from paddle_tpu.framework.observability import tracer
         t_start = _time.perf_counter()
         named_params, named_buffers, params, buffers, arrs, key, lr = \
             self._prepare_dispatch(inputs)
-        sig = _sig_of(list(named_params.values())) + _sig_of(arrs)
+        armed = numerics.enabled()
+        # the marker is only appended when ARMED, so the disarmed
+        # signature — and the traced jaxpr behind it — is byte-identical
+        # to the plane-less seed (no extra outputs, no recompile)
+        sig = _sig_of(list(named_params.values())) + _sig_of(arrs) \
+            + (("numerics",) if armed else ())
         fn = self._cache.get(sig)
         compile_cause = None
         if fn is None:
@@ -565,7 +588,7 @@ class TrainStep:
             compile_cause = health.classify_recompile(
                 sig, [s for s in self._cache
                       if not (s and s[0] == "multi")])
-            fn = self._make_step()
+            fn = self._make_step(numerics_aux=armed)
             self._cache[sig] = fn
         else:
             health.note_cache_hit("TrainStep")
@@ -576,8 +599,20 @@ class TrainStep:
                 attrs={"step": int(self.optimizer._global_step)}):
             with RecordEvent("TrainStep"):
                 with health.timed_compile("TrainStep", compile_cause):
-                    new_params, new_states, new_buffers, loss = fn(
-                        params, self._opt_states, buffers, key, lr, *arrs)
+                    out = fn(params, self._opt_states, buffers, key, lr,
+                             *arrs)
+        if armed:
+            new_params, new_states, new_buffers, loss, aux = out
+            # stash + publish BEFORE the commit guard below: a
+            # check_nan_inf raise must leave the provenance record
+            # readable by the rollback tier (ResilientTrainStep)
+            rec = numerics.NumericsRecord(
+                list(named_params), aux,
+                step=int(self.optimizer._global_step))
+            numerics.publish(rec)
+            self.last_numerics = rec
+        else:
+            new_params, new_states, new_buffers, loss = out
         # per-step sweep of the jitted tier (the eager per-op guard in
         # core.apply cannot see inside the fused step) — nan_inf_utils
         # role at step granularity; one scalar device->host sync.
@@ -609,10 +644,13 @@ class TrainStep:
         runs under."""
         import jax.tree_util as jtu
 
+        from paddle_tpu.framework import numerics
         from paddle_tpu.framework.analysis import analyze_jaxpr
         _, _, params, buffers, arrs, key, lr = \
             self._prepare_dispatch(example_inputs)
-        one_step = self._build_one_step()
+        # analyze what would actually dispatch: with FLAGS_numerics
+        # armed the traced step carries the aux reductions too
+        one_step = self._build_one_step(numerics_aux=numerics.enabled())
 
         def step(params, opt_states, buffers, key, lr, *inputs):
             return one_step(params, opt_states, buffers, key, lr,

@@ -158,8 +158,8 @@ class ShardedTrainStep(TrainStep):
         return p_shard, opt_shard, buf_shard, in_shard
 
     # -- step build ---------------------------------------------------------
-    def _make_step(self):
-        base = super()._make_step()
+    def _make_step(self, numerics_aux: bool = False):
+        base = super()._make_step(numerics_aux=numerics_aux)
         # Pull the un-jitted python callable back out: TrainStep returns
         # jax.jit(step); we re-jit with shardings, so call its wrapped fn.
         inner = base.__wrapped__
@@ -168,11 +168,17 @@ class ShardedTrainStep(TrainStep):
         p_shard, opt_shard, buf_shard, in_shard = layouts
         repl = _replicated(self.mesh)
         donate = (0, 1, 2) if self.donate else ()
+        out_shardings = (p_shard, opt_shard, buf_shard, repl)
+        if numerics_aux:
+            # the aux vectors are full reductions — replicated, like
+            # the loss
+            from paddle_tpu.framework import numerics
+            out_shardings += ({k: repl for k in numerics.AUX_KEYS},)
         return jax.jit(
             inner,
             in_shardings=(p_shard, opt_shard, buf_shard, repl, repl,
                           *in_shard),
-            out_shardings=(p_shard, opt_shard, buf_shard, repl),
+            out_shardings=out_shardings,
             donate_argnums=donate)
 
     def _make_multi_step(self):

@@ -77,7 +77,7 @@ from paddle_tpu.distributed.wire import (COLLECTIVE_WIRE_DTYPES,
                                          dequantize_rows_traced,
                                          normalize_wire,
                                          quantize_rows_traced, wire_nbytes)
-from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework import chaos, monitor, numerics
 from paddle_tpu.framework.observability import flight, tracer
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.parallel.dp_meta import _loss_closure, _require_pure_dp
@@ -161,7 +161,7 @@ class ShardedUpdateTrainStep:
         self.collective_retries = int(collective_retries)
         self._specs: Optional[Dict[str, ShardSpec]] = None
         self._opt_shards: Optional[dict] = None
-        self._fn = None
+        self._fns: Dict[bool, Callable] = {}   # keyed by numerics armed
 
     # -- sharded optimizer state --------------------------------------------
     def _sharding(self):
@@ -260,7 +260,7 @@ class ShardedUpdateTrainStep:
         return {"reduce_scatter": rs, "all_gather": ag}
 
     # -- compiled step ------------------------------------------------------
-    def _build(self, n_inputs):
+    def _build(self, n_inputs, numerics_aux: bool = False):
         mesh, dp, chunk, wire = self.mesh, self.dp, self.chunk, self.wire
         specs = self._specs
         opt = self.optimizer
@@ -307,6 +307,10 @@ class ShardedUpdateTrainStep:
                                 (0, spec.padded - spec.size))
                 pshards[n] = jax.lax.dynamic_slice(
                     pflat, (idx * spec.shard_len,), (spec.shard_len,))
+            # numerics view over the PRE-clip grads (same point in the
+            # update the replicated TrainStep samples at, so the
+            # exported global grad norm is parity-comparable)
+            gshards_preclip = dict(gshards) if numerics_aux else None
             if grad_clip is not None and hasattr(grad_clip,
                                                  "functional_clip"):
                 if hasattr(grad_clip, "clip_norm"):
@@ -337,13 +341,26 @@ class ShardedUpdateTrainStep:
                                   "dp").astype(b.dtype)
                     if jnp.issubdtype(b.dtype, jnp.floating) else b)
                 for n, b in new_buffers.items()}
-            return (new_params, new_states, new_buffers,
-                    jax.lax.pmean(loss, "dp"))
+            loss_rep = jax.lax.pmean(loss, "dp")
+            if numerics_aux:
+                # shard-local sum-of-squares / non-finite counts psum-ed
+                # over dp, max-abs pmax-ed (the global-norm clip idiom
+                # above): every replica leaves with the GLOBAL per-leaf
+                # vectors, so the aux is replicated (P() out spec)
+                aux = numerics.compute_aux(
+                    gshards_preclip, pshards, new_pshards, loss_rep,
+                    axis_name="dp")
+                return (new_params, new_states, new_buffers, loss_rep,
+                        aux)
+            return (new_params, new_states, new_buffers, loss_rep)
 
         opt_spec = jax.tree_util.tree_map(
             lambda v: P("dp") if v.ndim == 1 else P(), self._opt_shards)
         in_specs = (P(), opt_spec, P(), P(), P()) + (P("dp"),) * n_inputs
         out_specs = (P(), opt_spec, P(), P())
+        if numerics_aux:
+            out_specs = out_specs + (
+                {k: P() for k in numerics.AUX_KEYS},)
         mapped = shard_map_compat(local, mesh=mesh, in_specs=in_specs,
                                   out_specs=out_specs)
         donate = (0, 1, 2) if self.donate else ()
@@ -384,8 +401,11 @@ class ShardedUpdateTrainStep:
         self._ensure_state()
         arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
                 for i in inputs]
-        if self._fn is None:
-            self._fn = self._build(len(arrs))
+        armed = numerics.enabled()
+        fn = self._fns.get(armed)
+        if fn is None:
+            fn = self._fns[armed] = self._build(len(arrs),
+                                                numerics_aux=armed)
         key = default_generator.split()
         lr = jnp.float32(self.optimizer.get_lr())
         bytes_ = self.collective_wire_bytes()
@@ -396,9 +416,17 @@ class ShardedUpdateTrainStep:
                        "wire": self.wire, "dp": self.dp}):
             self._collective_guard()
             with manual_region():    # model-internal constrain() no-ops
-                new_params, self._opt_shards, new_buffers, loss = \
-                    self._fn(params, self._opt_shards, buffers, key, lr,
-                             *arrs)
+                out = fn(params, self._opt_shards, buffers, key, lr,
+                         *arrs)
+            if armed:
+                new_params, self._opt_shards, new_buffers, loss, aux = out
+                rec = numerics.NumericsRecord(
+                    list(self._specs), aux,
+                    step=int(self.optimizer._global_step))
+                numerics.publish(rec)
+                self.last_numerics = rec
+            else:
+                new_params, self._opt_shards, new_buffers, loss = out
             # leg marker spans: exact byte accounting for the fused
             # step's collectives (device timing is not separable)
             with tracer.start_span("zero.reduce_scatter",

@@ -1,0 +1,641 @@
+"""Model-numerics plane: in-jit tensor stats, NaN provenance, and
+gradient-drift detection (framework/numerics.py).
+
+Acceptance (deterministic, CPU-only): with chaos NaN-poisoning ONE
+layer's gradients at step K (``train.step_grads`` + ``payload_index``),
+the ``train.nan_skip`` flight event names that leaf as
+``first_bad_leaf`` and the run recovers; the grad-norm detector flags
+an injected 10× spike within 3 steps on a clean baseline; arming the
+plane leaves the loss trajectory bitwise unchanged and the DISARMED
+step's signature (and compiled executable) identical to the seed's —
+no extra outputs, no recompile.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework import chaos, health, monitor, numerics
+from paddle_tpu.framework.flags import get_flags, set_flags
+from paddle_tpu.framework.observability import flight, validate_prometheus
+from paddle_tpu.framework.resilient import ResilientTrainStep
+from paddle_tpu.jit import TrainStep
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    saved = get_flags(["numerics", "numerics_sample_every"])
+    chaos.reset(0)
+    health.reset()
+    numerics.reset()
+    flight.clear()
+    for s in ("numerics_nonfinite_steps_total",
+              "numerics_observe_errors_total",
+              "numerics_grad_norm", "numerics_param_norm",
+              "numerics_update_ratio", "numerics_max_abs_grad",
+              "numerics_grad_norm[weight]", "numerics_nonfinite[w]",
+              "numerics_grad_norm[aux_w]",
+              "health_anomalies_total", "train_nan_skips_total",
+              "jit_compiles_total", "jit_cache_hits_total",
+              "health_anomaly_grad_norm_total",
+              "health_anomaly_update_ratio_total",
+              "amp_scale_collapses_total"):
+        monitor.reset_stat(s)
+    yield
+    set_flags(saved)
+    chaos.reset(0)
+    health.reset()
+    numerics.reset()
+
+
+def _mse_parts():
+    rng = np.random.default_rng(7)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    return x, y
+
+
+def _linear_step(seed=0, **kw):
+    paddle.seed(seed)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    return TrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt,
+                     **kw)
+
+
+class TwoBranch(nn.Layer):
+    """A dense head plus an INDEPENDENT ``aux_w * z`` branch: poisoning
+    ``z`` NaNs exactly ``aux_w``'s gradient (the additive branch
+    contributes a zero cotangent to the dense leaves), so per-leaf
+    provenance has a unique right answer."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+        self.aux_w = self.create_parameter(
+            [4],
+            default_initializer=paddle.nn.initializer.Constant(0.1))
+
+    def forward(self, x, z):
+        return self.fc(x), (self.aux_w * z).sum()
+
+
+def _two_branch_step(seed=0):
+    paddle.seed(seed)
+    net = TwoBranch()
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+
+    def loss_fn(m, x, z, y):
+        out, aux = m(x, z)
+        return ((out - y) ** 2).mean() + 1e-3 * aux
+
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    z = paddle.to_tensor(rng.standard_normal((4,)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    return TrainStep(net, loss_fn, opt), (x, z, y)
+
+
+# ---------------------------------------------------------------------------
+# arming is free: bitwise trajectory parity + no recompile when off
+# ---------------------------------------------------------------------------
+
+class TestArmingIsFree:
+    def test_loss_trajectory_bitwise_unchanged(self):
+        x, y = _mse_parts()
+        step_off = _linear_step(seed=0)
+        losses_off = [float(step_off(x, y)) for _ in range(8)]
+        set_flags({"numerics": True})
+        step_on = _linear_step(seed=0)
+        losses_on = [float(step_on(x, y)) for _ in range(8)]
+        # the aux is pure extra reductions over values the step already
+        # computes: bit-for-bit identical losses, not just close
+        assert [np.float32(a).tobytes() for a in losses_off] == \
+               [np.float32(a).tobytes() for a in losses_on]
+        p_off = {n: np.asarray(p._data)
+                 for n, p in step_off.model.named_parameters()}
+        p_on = {n: np.asarray(p._data)
+                for n, p in step_on.model.named_parameters()}
+        for n in p_off:
+            assert p_off[n].tobytes() == p_on[n].tobytes(), n
+
+    def test_disarmed_signature_identical_no_recompile(self):
+        """The e2e acceptance's compile half: disarmed calls reuse ONE
+        cache entry across an arm/disarm cycle — the disarmed signature
+        (hence traced jaxpr) never changes, and arming adds exactly one
+        new entry instead of churning the cache."""
+        x, y = _mse_parts()
+        step = _linear_step(seed=0)
+        step(x, y)
+        step(x, y)
+        assert monitor.get_stat("jit_compiles_total") == 1
+        assert len(step._cache) == 1
+        set_flags({"numerics": True})
+        step(x, y)                      # armed: one new entry
+        assert monitor.get_stat("jit_compiles_total") == 2
+        assert len(step._cache) == 2
+        assert hasattr(step, "last_numerics")
+        set_flags({"numerics": False})
+        hits = monitor.get_stat("jit_cache_hits_total")
+        step(x, y)                      # disarmed again: cache HIT
+        assert monitor.get_stat("jit_compiles_total") == 2
+        assert monitor.get_stat("jit_cache_hits_total") == hits + 1
+        assert len(step._cache) == 2
+
+    def test_disarmed_step_has_no_aux_outputs(self):
+        x, y = _mse_parts()
+        step = _linear_step(seed=0)
+        step(x, y)
+        assert not hasattr(step, "last_numerics")
+        assert monitor.get_stat("numerics_nonfinite_steps_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# the record: norms, ratios, NaN propagation
+# ---------------------------------------------------------------------------
+
+class TestRecord:
+    def test_global_and_per_leaf_values(self):
+        set_flags({"numerics": True})
+        x, y = _mse_parts()
+        step = _linear_step(seed=0)
+        step(x, y)
+        rec = step.last_numerics
+        per = rec.per_leaf()
+        assert set(per) == {"weight", "bias"}
+        # global = sqrt of summed per-leaf squares
+        g = math.sqrt(sum(d["grad_norm"] ** 2 for d in per.values()))
+        assert rec.grad_norm == pytest.approx(g, rel=1e-6)
+        assert rec.update_ratio > 0.0
+        assert rec.max_abs_grad >= max(d["max_abs_grad"]
+                                       for d in per.values()) - 1e-9
+        assert rec.finite() and rec.first_bad_leaf() is None
+
+    def test_nan_propagates_not_clamped(self):
+        """max(0.0, nan) is 0.0 in Python — a NaN sum-of-squares must
+        surface as a NaN norm, not a silent zero that would feed the
+        drift detector a fake healthy sample."""
+        aux = {"grad_sq": np.array([np.nan], np.float32),
+               "param_sq": np.array([1.0], np.float32),
+               "update_sq": np.array([np.nan], np.float32),
+               "grad_maxabs": np.array([np.nan], np.float32),
+               "grad_nonfinite": np.array([1], np.int32),
+               "param_nonfinite": np.array([0], np.int32),
+               "loss_nonfinite": np.int32(0)}
+        rec = numerics.NumericsRecord(["w"], aux)
+        assert math.isnan(rec.grad_norm)
+        assert math.isnan(rec.update_ratio)
+        assert not rec.finite()
+        assert rec.first_bad_leaf() == "w"
+
+    def test_publish_keeps_nonfinite_out_of_histograms(self):
+        aux = {"grad_sq": np.array([np.nan], np.float32),
+               "param_sq": np.array([1.0], np.float32),
+               "update_sq": np.array([0.0], np.float32),
+               "grad_maxabs": np.array([np.nan], np.float32),
+               "grad_nonfinite": np.array([1], np.int32),
+               "param_nonfinite": np.array([0], np.int32),
+               "loss_nonfinite": np.int32(1)}
+        before = monitor.get_histogram("grad_norm").count
+        numerics.publish(numerics.NumericsRecord(["w"], aux))
+        assert monitor.get_histogram("grad_norm").count == before
+        assert monitor.get_stat("numerics_nonfinite_steps_total") == 1
+        # the per-leaf attribution refreshes on EVERY non-finite step
+        assert monitor.get_stat("numerics_nonfinite[w]") == 1
+
+
+# ---------------------------------------------------------------------------
+# NaN provenance: one poisoned layer -> the right leaf, end to end
+# ---------------------------------------------------------------------------
+
+class TestProvenance:
+    def test_poisoned_leaf_named_in_flight_event(self):
+        """The e2e acceptance: a NaN seeded into ONE layer's gradients
+        at step K is (a) attributed to that leaf in train.nan_skip, and
+        (b) flagged by the grad-norm drift detector AT step K."""
+        set_flags({"numerics": True})
+        numerics.watch_defaults()
+        step, (x, z, y) = _two_branch_step()
+        resilient = ResilientTrainStep(step)
+        # poison ONLY the z input (payload index 1): the NaN reaches
+        # exactly aux_w's gradient
+        chaos.arm("train.step_grads", mode="nan", nth=4, n_times=1,
+                  payload_index=1)
+        losses, bad_rec, fired_at = [], None, None
+        for k in range(7):
+            losses.append(float(resilient(x, z, y)))
+            if resilient.last_step_skipped:
+                bad_rec = step.last_numerics
+            if fired_at is None and monitor.get_stat(
+                    "health_anomaly_grad_norm_total") >= 1:
+                fired_at = k
+        assert resilient.skipped_steps == 1
+        assert resilient.last_bad_leaf == "aux_w"
+        assert np.isfinite(losses[-1])
+        ev = flight.recent(20, kind="train.nan_skip")
+        assert len(ev) == 1
+        assert ev[0]["attrs"]["first_bad_leaf"] == "aux_w"
+        # the poisoned step's record: aux_w non-finite, dense leaves
+        # clean — the attribution is unique, not first-in-traversal
+        assert bad_rec is not None and bad_rec.bad_leaves() == ["aux_w"]
+        # the detector fired AT the poisoned step (index 3), and the
+        # NaN never taught the baseline anything (no later anomalies)
+        assert fired_at == 3
+        assert monitor.get_stat("health_anomaly_grad_norm_total") == 1
+
+    def test_whole_batch_poison_still_recovers_and_attributes(self):
+        set_flags({"numerics": True})
+        step, (x, z, y) = _two_branch_step()
+        resilient = ResilientTrainStep(step)
+        chaos.arm("train.step_grads", mode="nan", nth=3, n_times=1)
+        losses = [float(resilient(x, z, y)) for _ in range(6)]
+        assert resilient.skipped_steps == 1
+        assert np.isfinite(losses[-1])
+        ev = flight.recent(20, kind="train.nan_skip")
+        assert ev[0]["attrs"]["first_bad_leaf"] is not None
+
+    def test_armed_rollback_matches_host_path(self):
+        """The in-jit finite check is a drop-in for the host sweep:
+        identical skip/restore behavior and final state on the same
+        poisoned run (the satellite's no-behavior-change contract)."""
+        def run(armed):
+            chaos.reset(0)
+            set_flags({"numerics": armed})
+            step, (x, z, y) = _two_branch_step(seed=1)
+            res = ResilientTrainStep(step)
+            chaos.arm("train.step_grads", mode="nan", nth=3, n_times=1,
+                      payload_index=1)
+            losses = []
+            for _ in range(6):
+                losses.append(float(res(x, z, y)))
+            return ([l for l in losses if np.isfinite(l)],  # noqa: E741
+                    res.skipped_steps, res.rollbacks,
+                    {n: np.asarray(p._data).tobytes()
+                     for n, p in step.model.named_parameters()})
+        l_off, s_off, r_off, p_off = run(False)
+        l_on, s_on, r_on, p_on = run(True)
+        assert s_off == s_on == 1 and r_off == r_on
+        assert l_off == l_on
+        assert p_off == p_on
+
+    def test_host_fallback_when_disarmed(self):
+        step, (x, z, y) = _two_branch_step()
+        resilient = ResilientTrainStep(step)
+        chaos.arm("train.step_grads", mode="nan", nth=2, n_times=1)
+        for _ in range(4):
+            resilient(x, z, y)
+        assert resilient.skipped_steps == 1
+        assert resilient.last_bad_leaf is None     # no aux disarmed
+        ev = flight.recent(20, kind="train.nan_skip")
+        assert ev[0]["attrs"]["first_bad_leaf"] is None
+
+
+# ---------------------------------------------------------------------------
+# drift detection: a 10x grad spike trips within 3 steps
+# ---------------------------------------------------------------------------
+
+class TestDriftDetection:
+    def test_grad_spike_flagged_within_3_steps(self):
+        set_flags({"numerics": True})
+        numerics.watch_defaults(warmup=8)
+        x, y = _mse_parts()
+        step = _linear_step(seed=0)
+        for _ in range(12):              # clean baseline past warmup
+            step(x, y)
+        assert monitor.get_stat("health_anomaly_grad_norm_total") == 0
+        base = step.last_numerics.grad_norm
+        x10 = paddle.to_tensor(np.asarray(x.numpy()) * 10.0)
+        spike_step = None
+        for k in range(3):
+            step(x10, y)
+            if monitor.get_stat("health_anomaly_grad_norm_total") >= 1:
+                spike_step = k
+                break
+        assert spike_step == 0, "10x spike not flagged within 3 steps"
+        assert step.last_numerics.grad_norm > 5 * base
+        ev = flight.recent(20, kind="health.anomaly")
+        assert any(e["attrs"]["signal"] == "grad_norm" for e in ev)
+
+    def test_detector_nonfinite_rule(self):
+        """A non-finite observation is an anomaly by definition: z=inf,
+        flagged even during warmup, and never folded into the EWMA or
+        the baseline window (one NaN must not poison either)."""
+        d = health.Detector("t", warmup=8)
+        a = d.update(float("nan"))
+        assert a is not None and a.z == float("inf")
+        assert d.ewma is None                 # EWMA untouched
+        for _ in range(8):                    # warmup continues cleanly
+            assert d.update(1.0) is None
+        assert d.update(1.0) is None          # scored, clean
+        assert d.ewma == pytest.approx(1.0)
+        assert d.update(float("inf")) is not None
+        assert d.update(1.0) is None          # baseline survived
+
+    def test_isolated_warmup_nans_do_not_ratchet_rebaseline(self):
+        """A clean warmup sample breaks the anomaly streak: isolated
+        NaNs scattered through warmup must not accumulate to
+        max_consecutive and wipe the forming baseline."""
+        d = health.Detector("t", warmup=16, max_consecutive=4)
+        for _ in range(4):                    # 4 isolated NaN episodes
+            assert d.update(float("nan")) is not None
+            for _ in range(3):
+                d.update(1.0)
+        assert d.rebaselines == 0
+        assert d.consecutive == 0
+
+    def test_watch_defaults_idempotent_and_in_default_signals(self):
+        dets = numerics.watch_defaults()
+        assert set(dets) == set(numerics.DRIFT_SIGNALS)
+        # one source of truth: the kwargs live in health.DEFAULT_SIGNALS
+        for s in numerics.DRIFT_SIGNALS:
+            assert s in health.DEFAULT_SIGNALS
+        again = numerics.watch_defaults(warmup=99)
+        assert again["grad_norm"] is dets["grad_norm"]   # not re-armed
+
+
+# ---------------------------------------------------------------------------
+# the watcher never crashes the watched (numerics.observe chaos point)
+# ---------------------------------------------------------------------------
+
+class TestChaosContract:
+    def test_injected_publish_fault_swallowed(self):
+        set_flags({"numerics": True})
+        x, y = _mse_parts()
+        step = _linear_step(seed=0)
+        with chaos.inject("numerics.observe", mode="error", every=1):
+            losses = [float(step(x, y)) for _ in range(4)]
+        assert all(np.isfinite(losses))
+        assert monitor.get_stat("numerics_observe_errors_total") == 4
+        # faulted publishes left no gauges behind
+        assert monitor.get_stat("numerics_grad_norm") == 0
+        # recovery: the next publish lands normally
+        step(x, y)
+        assert monitor.get_stat("numerics_grad_norm") > 0
+
+    def test_latency_fault_absorbed(self):
+        set_flags({"numerics": True})
+        x, y = _mse_parts()
+        step = _linear_step(seed=0)
+        with chaos.inject("numerics.observe", mode="latency",
+                          latency=0.01, every=2):
+            losses = [float(step(x, y)) for _ in range(4)]
+        assert all(np.isfinite(losses))
+        assert monitor.get_stat("numerics_observe_errors_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# sharded parity: dp=2 sum-of-squares + psum == single-replica norms
+# ---------------------------------------------------------------------------
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _mlp_loss(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+class TestShardedParity:
+    def test_sharded_train_step_armed_aux(self):
+        """ShardedTrainStep (pjit/GSPMD) is the fourth instrumented
+        class: the armed out_shardings branch must build, run, and
+        stash a sane record."""
+        import jax
+
+        from paddle_tpu.parallel import make_mesh, set_mesh
+        from paddle_tpu.parallel.sharded import ShardedTrainStep
+        set_flags({"numerics": True})
+        paddle.seed(2)
+        mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+        set_mesh(mesh)
+        net = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        step = ShardedTrainStep(
+            net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt,
+            mesh=mesh, sharding_stage=1)
+        x, y = _mse_parts()
+        for _ in range(2):
+            loss = step(x, y)
+        assert np.isfinite(float(loss))
+        rec = step.last_numerics
+        assert set(rec.per_leaf()) == {"weight", "bias"}
+        assert rec.grad_norm > 0 and rec.finite()
+
+    def test_global_grad_norm_dp2_matches_single_replica(self):
+        import jax
+
+        from paddle_tpu.parallel import make_mesh, set_mesh
+        from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+        set_flags({"numerics": True})
+        rng = np.random.default_rng(11)
+        xb = rng.standard_normal((8, 8)).astype(np.float32)
+        yb = rng.standard_normal((8, 4)).astype(np.float32)
+
+        paddle.seed(5)
+        m1 = _MLP()
+        o1 = paddle.optimizer.SGD(learning_rate=0.05,
+                                  parameters=m1.parameters())
+        ref = TrainStep(m1, _mlp_loss, o1, donate=False)
+        ref(paddle.to_tensor(xb), paddle.to_tensor(yb))
+        r_ref = ref.last_numerics
+
+        paddle.seed(5)
+        m2 = _MLP()
+        o2 = paddle.optimizer.SGD(learning_rate=0.05,
+                                  parameters=m2.parameters())
+        mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+        set_mesh(mesh)
+        z = ShardedUpdateTrainStep(m2, _mlp_loss, o2, mesh=mesh,
+                                   wire_dtype="f32", donate=False)
+        z(paddle.to_tensor(xb), paddle.to_tensor(yb))
+        r_z = z.last_numerics
+
+        assert r_z.grad_norm == pytest.approx(r_ref.grad_norm, rel=1e-5)
+        assert r_z.param_norm == pytest.approx(r_ref.param_norm,
+                                               rel=1e-5)
+        assert r_z.update_ratio == pytest.approx(r_ref.update_ratio,
+                                                 rel=1e-4)
+        assert r_z.nonfinite_grads == 0 and r_z.first_bad_leaf() is None
+        # leaf set matches the shard-spec bookkeeping, per-leaf norms
+        # agree with the replicated reference
+        per_ref, per_z = r_ref.per_leaf(), r_z.per_leaf()
+        assert set(per_ref) == set(per_z)
+        for n in per_ref:
+            assert per_z[n]["grad_norm"] == pytest.approx(
+                per_ref[n]["grad_norm"], rel=1e-5, abs=1e-8), n
+
+
+# ---------------------------------------------------------------------------
+# PSTrainStep: the pulled-row gradient is a first-class numerics leaf
+# ---------------------------------------------------------------------------
+
+class TestPSTrainStep:
+    def test_embedding_rows_leaf(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import optimizer
+        from paddle_tpu.distributed.ps import (DistributedEmbedding,
+                                               PSTrainStep)
+        from paddle_tpu.models import WideDeepHost
+        set_flags({"numerics": True})
+        V, E, fields, dd = 100, 8, 4, 3
+        emb = DistributedEmbedding(V, E + 1, optimizer="sgd",
+                                   learning_rate=0.05, seed=0)
+        model = WideDeepHost(embedding_dim=E, num_fields=fields,
+                             dense_dim=dd, hidden=(16,))
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+
+        def loss_fn(m, rows, x, y):
+            return F.binary_cross_entropy_with_logits(
+                m(rows, x), y).mean()
+
+        step = PSTrainStep(model, loss_fn, opt, emb,
+                           transfer_dtype="float32")
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, V, size=(16, fields)).astype(np.int64)
+        x = paddle.to_tensor(rng.standard_normal((16, dd))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 2, (16, 1))
+                             .astype(np.float32))
+        for _ in range(3):
+            step(ids, x, y)
+        step.flush()
+        rec = step.last_numerics
+        per = rec.per_leaf()
+        assert "embedding.rows" in per
+        assert per["embedding.rows"]["grad_norm"] > 0
+        # the sparse update happens host-side on the PS: zero by design
+        assert per["embedding.rows"]["update_ratio"] == 0.0
+        assert rec.finite() and rec.first_bad_leaf() is None
+        assert monitor.get_stat("numerics_grad_norm") > 0
+
+
+# ---------------------------------------------------------------------------
+# prometheus export: dotted/bracketed names stay grammatical
+# ---------------------------------------------------------------------------
+
+class TestPrometheusSanitize:
+    def test_per_leaf_gauge_exports_as_label(self):
+        monitor.stat_set("numerics_grad_norm[fc.sub.weight]", 1.25)
+        text = monitor.export_prometheus()
+        assert 'numerics_grad_norm{leaf="fc.sub.weight"} 1.25' in text
+        validate_prometheus(text)
+
+    def test_dotted_name_gauge_regression(self):
+        # the regression the satellite pins: a dotted-name gauge (and a
+        # bracketed per-leaf path with quotes/backslashes in it) must
+        # render valid exposition lines, not malformed samples
+        monitor.stat_set("layer.norm.scale", 2.0)
+        monitor.stat_set('numerics_max_abs_grad[w["a\\b"].0]', 3.0)
+        text = monitor.export_prometheus()
+        n = validate_prometheus(text)
+        assert n > 0
+        assert "layer_norm_scale 2.0" in text
+        assert 'leaf="w[\\"a\\\\b\\"].0"' in text
+
+    def test_nonfinite_gauge_value_renders_valid(self):
+        monitor.stat_set("numerics_grad_norm", float("nan"))
+        monitor.stat_set("some_inf_gauge", float("inf"))
+        text = monitor.export_prometheus()
+        validate_prometheus(text)
+        assert "numerics_grad_norm NaN" in text
+        assert "some_inf_gauge +Inf" in text
+
+
+# ---------------------------------------------------------------------------
+# GradScaler telemetry (satellite)
+# ---------------------------------------------------------------------------
+
+class TestGradScalerTelemetry:
+    def test_scale_gauge_and_collapse_event(self):
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu.framework.flags import flag
+        scaler = GradScaler(enable=True, init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1)
+        k = int(flag("numerics_scale_collapse_k"))
+        for i in range(k):
+            scaler._found_inf = True
+            scaler.update()
+        assert monitor.get_stat("amp_loss_scale") == scaler._scale
+        assert scaler._scale == 1024.0 * (0.5 ** k)
+        ev = flight.recent(10, kind="numerics.scale_collapse")
+        assert len(ev) == 1
+        assert ev[0]["attrs"]["consecutive_downscales"] == k
+        assert monitor.get_stat("amp_scale_collapses_total") == 1
+
+    def test_good_step_resets_collapse_streak(self):
+        from paddle_tpu.amp import GradScaler
+        scaler = GradScaler(enable=True, init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1)
+        for _ in range(3):
+            scaler._found_inf = True
+            scaler.update()
+            scaler._found_inf = False
+            scaler.update()              # good step between downscales
+        assert flight.recent(10, kind="numerics.scale_collapse") == []
+
+    def test_resilient_scaler_coop_emits_collapse(self):
+        from paddle_tpu.amp import GradScaler
+        set_flags({"numerics": True})
+        step, (x, z, y) = _two_branch_step()
+        scaler = GradScaler(enable=True, init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1)
+        resilient = ResilientTrainStep(step, scaler=scaler,
+                                       max_consecutive_bad=8)
+        chaos.arm("train.step_grads", mode="nan", every=1, n_times=4,
+                  payload_index=1)
+        for _ in range(5):
+            resilient(x, z, y)
+        assert len(flight.recent(10,
+                                 kind="numerics.scale_collapse")) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-leaf sampling cadence
+# ---------------------------------------------------------------------------
+
+class TestSampling:
+    def test_per_leaf_gauges_follow_cadence(self):
+        set_flags({"numerics": True, "numerics_sample_every": 3})
+        x, y = _mse_parts()
+        step = _linear_step(seed=0)
+        step(x, y)
+        step(x, y)
+        assert monitor.get_stat("numerics_grad_norm[weight]") == 0
+        step(x, y)                      # 3rd publish: due
+        assert monitor.get_stat("numerics_grad_norm[weight]") > 0
+
+    def test_per_leaf_disabled_at_zero(self):
+        set_flags({"numerics": True, "numerics_sample_every": 0})
+        x, y = _mse_parts()
+        step = _linear_step(seed=0)
+        for _ in range(4):
+            step(x, y)
+        assert monitor.get_stat("numerics_grad_norm[weight]") == 0
+        assert monitor.get_stat("numerics_grad_norm") > 0
+
+    def test_per_leaf_zero_is_hard_off_even_on_nonfinite(self):
+        """every=0 is the operator's metric-cardinality cap: even a
+        non-finite step must not fan out per-leaf gauges (provenance
+        still reaches the flight event via first_bad_leaf)."""
+        set_flags({"numerics": True, "numerics_sample_every": 0})
+        step, (x, z, y) = _two_branch_step()
+        resilient = ResilientTrainStep(step)
+        chaos.arm("train.step_grads", mode="nan", nth=2, n_times=1,
+                  payload_index=1)
+        for _ in range(3):
+            resilient(x, z, y)
+        assert resilient.last_bad_leaf == "aux_w"
+        assert monitor.get_stat("numerics_grad_norm[aux_w]") == 0
+        assert monitor.get_stat("numerics_nonfinite_steps_total") == 1

@@ -58,6 +58,20 @@ echo "== perf health lane (traced mini train -> health_check; zero anomalies, ze
 JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 30 \
     --max-anomalies 0 --max-steady-recompiles 0
 
+echo "== model numerics lane (in-jit stats; zero grad anomalies, NaN provenance) =="
+# the model-signal twin of the health lane: (1) a clean mini train with
+# the numerics plane armed must trip zero grad-norm anomalies and zero
+# steady recompiles (arming must not churn the jit cache); (2) a run
+# with ONE layer's gradient NaN-poisoned at step 20 must skip-and-
+# restore, name that leaf as first_bad_leaf in the train.nan_skip
+# flight event AND fire the grad-norm detector at the poisoned step
+# (both gated by the implicit --nan-step provenance verdict), with
+# exactly that one anomaly per drift signal and a clean baseline after
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 30 --numerics \
+    --max-anomalies 0 --max-grad-anomalies 0 --max-steady-recompiles 0
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 30 \
+    --nan-step 20 --max-anomalies 3 --max-grad-anomalies 1
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and
@@ -68,6 +82,7 @@ echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
     --zoo ps_transport --zoo ingest --zoo health --zoo zero_step \
+    --zoo numerics_step \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="

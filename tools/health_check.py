@@ -14,6 +14,11 @@ verdict the detectors produce:
   steady-state recompile count the compile-storm detector feeds;
 * **memory** — ``device_mem_live_bytes`` / ``device_mem_peak_bytes``
   and the per-tag attribution gauges;
+* **numerics** — the model-numerics plane (framework/numerics.py):
+  global grad/param norms, update ratio, max-abs grad, non-finite
+  step + NaN-skip counts, grad-norm detector anomalies, the sampled
+  per-leaf grad norms, and (mini-train ``--nan-step``) the NaN
+  provenance verdict;
 * **spans** — the per-span-name aggregate table
   (``tools/trace_merge.py summarize``) over ``--trace-dir``.
 
@@ -30,11 +35,17 @@ Inputs:
 
 Gates (any trip → exit 1): ``--max-anomalies`` (default 0),
 ``--max-steady-recompiles`` (default 0), ``--max-input-stall``
-(percent; off by default).
+(percent; off by default), ``--max-grad-anomalies`` (grad-norm
+detector trips; off by default), and — implicit with ``--nan-step`` —
+the NaN-provenance verdict (the seeded fault must be attributed to
+the poisoned leaf).
 
 Usage::
 
     python tools/health_check.py --mini-train 30
+    python tools/health_check.py --mini-train 30 --numerics \\
+        --max-grad-anomalies 0
+    python tools/health_check.py --mini-train 30 --nan-step 20
     python tools/health_check.py --metrics snap.json --trace-dir /tmp/tr
     python tools/health_check.py --metrics metrics.prom --format json
 """
@@ -133,6 +144,29 @@ def build_report(snap: dict, trace_dir: Optional[str] = None,
                  and k not in ("device_mem_live_bytes",
                                "device_mem_peak_bytes")},
     }
+    def _leaf_split(k, prefix):
+        # per-leaf numerics gauges: "numerics_grad_norm[fc.weight]"
+        return k[len(prefix) + 1:-1] if k.startswith(prefix + "[") \
+            and k.endswith("]") else None
+
+    numerics = {
+        "grad_norm": stats.get("numerics_grad_norm"),
+        "param_norm": stats.get("numerics_param_norm"),
+        "update_ratio": stats.get("numerics_update_ratio"),
+        "max_abs_grad": stats.get("numerics_max_abs_grad"),
+        "nonfinite_steps": int(
+            stats.get("numerics_nonfinite_steps_total", 0)),
+        "nan_skips": int(stats.get("train_nan_skips_total", 0)),
+        "observe_errors": int(
+            stats.get("numerics_observe_errors_total", 0)),
+        "grad_anomalies": int(
+            stats.get("health_anomaly_grad_norm_total", 0)),
+        "grad_norm_hist": hists.get("grad_norm"),
+        "per_leaf_grad_norm": {
+            leaf: v for k, v in stats.items()
+            if (leaf := _leaf_split(k, "numerics_grad_norm"))
+            is not None},
+    }
     report = {
         "anomalies": {
             "total": int(stats.get("health_anomalies_total", 0)),
@@ -142,6 +176,7 @@ def build_report(snap: dict, trace_dir: Optional[str] = None,
         },
         "compiles": compiles,
         "memory": memory,
+        "numerics": numerics,
         "steps": {
             "train_steps_total": int(stats.get("train_steps_total", 0)),
             "train_step_ms": hists.get("train_step_ms"),
@@ -165,7 +200,8 @@ def build_report(snap: dict, trace_dir: Optional[str] = None,
 
 def evaluate_gates(report: dict, max_anomalies: int = 0,
                    max_steady_recompiles: int = 0,
-                   max_input_stall: Optional[float] = None) -> list:
+                   max_input_stall: Optional[float] = None,
+                   max_grad_anomalies: Optional[int] = None) -> list:
     """Returns the list of tripped-gate descriptions (empty = healthy)."""
     tripped = []
     n_anom = report["anomalies"]["total"]
@@ -181,6 +217,20 @@ def evaluate_gates(report: dict, max_anomalies: int = 0,
     if max_input_stall is not None and stall is not None and \
             stall > max_input_stall:
         tripped.append(f"input stall: {stall:.2f}% > {max_input_stall}%")
+    num = report.get("numerics") or {}
+    if max_grad_anomalies is not None:
+        n_g = int(num.get("grad_anomalies", 0))
+        if n_g > max_grad_anomalies:
+            tripped.append(f"grad-norm anomalies: {n_g} > "
+                           f"{max_grad_anomalies}")
+    prov = num.get("provenance")
+    if prov is not None and not prov.get("ok"):
+        # the seeded-NaN mini train gates itself: the nan_skip flight
+        # event must name the poisoned leaf
+        tripped.append(
+            f"NaN provenance: expected first_bad_leaf="
+            f"{prov.get('expected')!r}, got {prov.get('got')!r} "
+            f"(nan_skips: {prov.get('nan_skips')})")
     return tripped
 
 
@@ -218,6 +268,31 @@ def format_report(report: dict, tripped: list) -> str:
     if s.get("input_stall_pct") is not None:
         step_txt += f"  input_stall: {s['input_stall_pct']:.2f}%"
     lines.append(step_txt)
+    n = report.get("numerics") or {}
+    if n.get("grad_norm") is not None:
+        num_txt = (f"numerics: grad_norm={n['grad_norm']:.4g} "
+                   f"param_norm={n['param_norm']:.4g} "
+                   f"update_ratio={n['update_ratio']:.4g} "
+                   f"max_abs_grad={n['max_abs_grad']:.4g}")
+        if n.get("nonfinite_steps") or n.get("nan_skips"):
+            num_txt += (f"  nonfinite_steps={n['nonfinite_steps']} "
+                        f"nan_skips={n['nan_skips']}")
+        if n.get("grad_anomalies"):
+            num_txt += f"  grad_anomalies={n['grad_anomalies']}"
+        if n.get("observe_errors"):
+            num_txt += f"  (observe errors: {n['observe_errors']})"
+        lines.append(num_txt)
+        prov = n.get("provenance")
+        if prov is not None:
+            lines.append(f"  provenance: expected={prov.get('expected')} "
+                         f"got={prov.get('got')} "
+                         f"ok={bool(prov.get('ok'))}")
+        leaves = n.get("per_leaf_grad_norm") or {}
+        if leaves:
+            top = sorted(leaves.items(), key=lambda kv: -abs(kv[1]
+                         if kv[1] == kv[1] else float("inf")))[:5]
+            lines.append("  top leaf grad norms: "
+                         + "  ".join(f"{k}={v:.4g}" for k, v in top))
     if report.get("spans"):
         import trace_merge
         lines.append("-- span summary --")
@@ -234,43 +309,120 @@ def format_report(report: dict, tripped: list) -> str:
 # self-contained mini-train mode (the CI health lane)
 # ---------------------------------------------------------------------------
 
-def mini_train(n_steps: int, trace_dir: str) -> dict:
+def mini_train(n_steps: int, trace_dir: str, numerics: bool = False,
+               nan_step: Optional[int] = None):
     """Run a traced, health-armed N-step mini train and return
-    ``monitor.snapshot()``.  Fixed seeds and shapes: a healthy run
-    compiles exactly once per jit site and trips zero detectors —
-    which is precisely what the CI gate asserts."""
+    ``(monitor.snapshot(), provenance-or-None)``.  Fixed seeds and
+    shapes: a healthy run compiles exactly once per jit site and trips
+    zero detectors — which is precisely what the CI gate asserts.
+
+    ``numerics=True`` arms the model-numerics plane (FLAGS_numerics +
+    the grad-norm drift detectors) on a two-branch model — a dense
+    head plus an independent ``aux_w * z`` branch — wrapped in
+    ``ResilientTrainStep``.  ``nan_step=K`` additionally NaN-poisons
+    ONLY the aux branch's input at step K (chaos ``train.step_grads``
+    with ``payload_index``), so exactly one leaf's gradient goes
+    non-finite: the returned provenance dict records whether the
+    ``train.nan_skip`` flight event named that leaf (``aux_w``), the
+    run must still finish on finite losses (skip-and-restore), and the
+    grad-norm detector's baseline stays clean — the CI numerics lane's
+    seeded-NaN leg."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
-    from paddle_tpu.framework import health, monitor
-    from paddle_tpu.framework.observability import tracer
+    from paddle_tpu.framework import chaos, health, monitor
+    from paddle_tpu.framework import numerics as numerics_mod
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    from paddle_tpu.framework.observability import flight, tracer
+    from paddle_tpu.framework.resilient import ResilientTrainStep
     from paddle_tpu.jit import TrainStep
 
     for signal, kw in health.DEFAULT_SIGNALS.items():
         health.watch(signal, **dict(kw))
+    saved_flags = get_flags("numerics")
+    provenance = None
     tracer.enable(trace_dir, label="health_check")
     try:
         paddle.seed(0)
-        net = nn.Linear(8, 4)
-        opt = paddle.optimizer.SGD(learning_rate=0.05,
-                                   parameters=net.parameters())
-        step = TrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(),
-                         opt)
         rng = np.random.default_rng(0)
-        x = paddle.to_tensor(rng.standard_normal((16, 8))
-                             .astype(np.float32))
-        y = paddle.to_tensor(rng.standard_normal((16, 4))
-                             .astype(np.float32))
-        losses = [float(step(x, y)) for _ in range(n_steps)]
-        assert all(np.isfinite(losses)), f"mini train diverged: {losses}"
+        if not numerics:
+            net = nn.Linear(8, 4)
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=net.parameters())
+            step = TrainStep(net,
+                             lambda m, x, y: ((m(x) - y) ** 2).mean(),
+                             opt)
+            x = paddle.to_tensor(rng.standard_normal((16, 8))
+                                 .astype(np.float32))
+            y = paddle.to_tensor(rng.standard_normal((16, 4))
+                                 .astype(np.float32))
+            losses = [float(step(x, y)) for _ in range(n_steps)]
+            assert all(np.isfinite(losses)), \
+                f"mini train diverged: {losses}"
+            params = net.parameters()
+        else:
+            set_flags({"numerics": True})
+
+            class _TwoBranch(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.fc = nn.Linear(8, 4)
+                    self.aux_w = self.create_parameter(
+                        [4], default_initializer=paddle.nn.initializer
+                        .Constant(0.1))
+
+                def forward(self, x, z):
+                    return self.fc(x), (self.aux_w * z).sum()
+
+            def loss_fn(m, x, z, y):
+                out, aux = m(x, z)
+                return ((out - y) ** 2).mean() + 1e-3 * aux
+
+            net = _TwoBranch()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=net.parameters())
+            step = ResilientTrainStep(TrainStep(net, loss_fn, opt))
+            x = paddle.to_tensor(rng.standard_normal((16, 8))
+                                 .astype(np.float32))
+            z = paddle.to_tensor(rng.standard_normal((4,))
+                                 .astype(np.float32))
+            y = paddle.to_tensor(rng.standard_normal((16, 4))
+                                 .astype(np.float32))
+            if nan_step is not None:
+                # poison ONLY the aux branch's input (payload index 1 =
+                # z): the NaN reaches exactly aux_w's gradient
+                chaos.arm("train.step_grads", mode="nan",
+                          nth=int(nan_step), n_times=1, payload_index=1)
+            losses = [float(step(x, z, y)) for _ in range(n_steps)]
+            assert np.isfinite(losses[-1]), \
+                f"mini train did not recover: {losses[-5:]}"
+            if nan_step is not None:
+                skips = flight.recent(50, kind="train.nan_skip")
+                got = skips[-1]["attrs"].get("first_bad_leaf") \
+                    if skips else None
+                # the drift detector must fire AT the poisoned step
+                # too (a non-finite grad norm is an anomaly by
+                # definition — Detector's z=inf rule)
+                ga = int(monitor.get_stat(
+                    "health_anomaly_grad_norm_total"))
+                provenance = {"expected": "aux_w", "got": got,
+                              "nan_skips": len(skips),
+                              "grad_anomalies": ga,
+                              "ok": bool(skips) and got == "aux_w"
+                              and step.skipped_steps == 1 and ga >= 1}
+            params = net.parameters()
         health.memory.sample(tags={
-            "params": sum(int(p._data.nbytes) for p in net.parameters())})
+            "params": sum(int(p._data.nbytes) for p in params)})
     finally:
         tracer.disable()
-    return monitor.snapshot()
+        if numerics:
+            set_flags(saved_flags)
+            chaos.disarm("train.step_grads")
+            numerics_mod.reset()
+    return monitor.snapshot(), provenance
 
 
 def main(argv=None) -> int:
@@ -287,6 +439,17 @@ def main(argv=None) -> int:
                     help="self-contained mode: run a traced, "
                          "health-armed N-step mini train and evaluate "
                          "its own snapshot (the CI health lane)")
+    ap.add_argument("--numerics", action="store_true",
+                    help="mini-train option: arm the model-numerics "
+                         "plane (FLAGS_numerics + grad-norm drift "
+                         "detectors) on a two-branch model under "
+                         "ResilientTrainStep")
+    ap.add_argument("--nan-step", type=int, default=None, metavar="K",
+                    help="mini-train option (implies --numerics): NaN-"
+                         "poison only the aux branch's input at step K "
+                         "and gate that train.nan_skip names that "
+                         "branch's leaf as first_bad_leaf (the CI "
+                         "numerics lane's seeded-NaN leg)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--max-anomalies", type=int, default=0,
                     help="gate: tolerated health_anomalies_total "
@@ -297,19 +460,30 @@ def main(argv=None) -> int:
     ap.add_argument("--max-input-stall", type=float, default=None,
                     help="gate: tolerated input_stall_pct (off by "
                          "default)")
+    ap.add_argument("--max-grad-anomalies", type=int, default=None,
+                    help="gate: tolerated grad-norm detector anomalies "
+                         "(health_anomaly_grad_norm_total; off by "
+                         "default)")
     a = ap.parse_args(argv)
     if a.metrics is None and a.mini_train is None:
         ap.error("nothing to check: pass --metrics or --mini-train")
     if a.metrics is not None and a.mini_train is not None:
         ap.error("--metrics and --mini-train are mutually exclusive: "
                  "the mini train evaluates its own fresh snapshot")
+    if a.nan_step is not None:
+        a.numerics = True
+    if a.numerics and a.mini_train is None:
+        ap.error("--numerics/--nan-step are mini-train options")
 
     health_snapshot = None
+    provenance = None
     if a.mini_train is not None:
         if a.trace_dir is None:
             tmp = tempfile.TemporaryDirectory(prefix="health_check_")
             a.trace_dir = tmp.name          # kept alive by the local ref
-        snap = mini_train(a.mini_train, a.trace_dir)
+        snap, provenance = mini_train(a.mini_train, a.trace_dir,
+                                      numerics=a.numerics,
+                                      nan_step=a.nan_step)
         from paddle_tpu.framework import health
         health_snapshot = health.snapshot()
     else:
@@ -317,10 +491,13 @@ def main(argv=None) -> int:
 
     report = build_report(snap, trace_dir=a.trace_dir,
                           health_snapshot=health_snapshot)
+    if provenance is not None:
+        report["numerics"]["provenance"] = provenance
     tripped = evaluate_gates(
         report, max_anomalies=a.max_anomalies,
         max_steady_recompiles=a.max_steady_recompiles,
-        max_input_stall=a.max_input_stall)
+        max_input_stall=a.max_input_stall,
+        max_grad_anomalies=a.max_grad_anomalies)
     report["tripped"] = tripped
     if a.format == "json":
         print(json.dumps(report, indent=1, default=str))

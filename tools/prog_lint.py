@@ -61,6 +61,11 @@ ZOO = {
     # hygiene + the shared wire-quantization helpers and the dp meta
     # strategies folded onto them) — Report, like elastic_step
     "zero_step": lambda: _zoo_zero_step(),
+    # traces a numerics-ARMED resilient train step (the aux reductions
+    # are part of the jaxpr) and lints the model-numerics plane sources
+    # (numerics.observe fault-point hygiene + the GradScaler telemetry
+    # consumer) — Report, like elastic_step
+    "numerics_step": lambda: _zoo_numerics_step(),
 }
 
 
@@ -208,6 +213,60 @@ def _zoo_zero_step():
     for rel in (os.path.join("paddle_tpu", "parallel", "zero.py"),
                 os.path.join("paddle_tpu", "parallel", "dp_meta.py"),
                 os.path.join("paddle_tpu", "distributed", "wire.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_numerics_step():
+    """The model-numerics plane, both front ends: the jaxpr IR passes
+    trace the fused TrainStep WITH the in-jit numerics aux armed
+    (FLAGS_numerics — the aux reductions are real equations in the
+    traced step, so dead-code/cost passes see them), and the AST lint
+    covers the sources threading the ``numerics.observe`` fault point
+    (framework/numerics.py publish) plus its consumers — resilient's
+    provenance path and the GradScaler scale telemetry."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer
+    from paddle_tpu.framework.analysis import lint_file
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    from paddle_tpu.framework.resilient import ResilientTrainStep
+    from paddle_tpu.jit import TrainStep
+
+    class _MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(6, 12)
+            self.fc2 = nn.Linear(12, 3)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    def loss_fn(m, x, y):
+        return paddle.nn.functional.cross_entropy(m(x), y).mean()
+
+    paddle.seed(0)
+    model = _MLP()
+    opt = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                             parameters=model.parameters())
+    resilient = ResilientTrainStep(
+        TrainStep(model, loss_fn, opt, donate=False))
+    saved = get_flags("numerics")
+    set_flags({"numerics": True})
+    try:
+        report = resilient.step.analyze(
+            np.zeros((4, 6), np.float32), np.zeros((4,), np.int64))
+    finally:
+        set_flags(saved)
+    for rel in (os.path.join("paddle_tpu", "framework", "numerics.py"),
+                os.path.join("paddle_tpu", "framework", "resilient.py"),
+                os.path.join("paddle_tpu", "amp", "__init__.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:
