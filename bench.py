@@ -67,61 +67,74 @@ def _timeit(step_fn, warmup, iters):
 # file, rewritten atomically after each leg — a truncated driver tail
 # (stdout capture keeps only the last N bytes) can therefore never lose
 # legs again; the artifact always holds the complete run so far.
-# Override the location with BENCH_ARTIFACT=path.
+# Override the location with BENCH_ARTIFACT=path.  On top of the
+# artifact, every completed leg is appended to the persistent run
+# ledger (framework/runlog.py; BENCH_LEDGER overrides the default
+# runs/ledger.jsonl next to this file) so the bench trajectory is a
+# queryable perf history, not a pile of disconnected snapshots.
 _RECORDS = []
 _ARTIFACT = os.environ.get(
     "BENCH_ARTIFACT",
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  "BENCH_artifact.json"))
+_LEDGER = os.environ.get(
+    "BENCH_LEDGER",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "runs", "ledger.jsonl"))
+
+#: artifact/leg record schema: v2 adds schema_version + leg_s to every
+#: record.  leg_s is the MONOTONIC wall clock since the PREVIOUS
+#: record: a single-metric leg carries its full measurement time; a
+#: bench function that emits several metrics back-to-back attributes
+#: the shared measurement window to its FIRST record and ~0.0 to the
+#: co-emitted ones (the deltas always sum to the run's total)
+BENCH_SCHEMA_VERSION = 2
 
 
 _META = None
 
 
 def _run_meta():
-    """Run metadata stamped into the artifact, so a regression the
-    health plane flags is attributable to the change that caused it:
-    git sha (+dirty), host, active FLAGS overrides, versions.  Computed
-    once, every field best-effort — metadata must never fail a bench."""
+    """Run metadata stamped into the artifact (git sha+dirty, host,
+    FLAGS overrides, versions) — the shared implementation lives in
+    framework/runlog.py now.  The fallback covers the one path where
+    the package must NOT be imported (the device-unavailable emit: a
+    wedged accelerator lease can hang the import itself)."""
     global _META
     if _META is not None:
         return _META
+    if "paddle_tpu" in sys.modules:
+        try:
+            from paddle_tpu.framework.runlog import run_meta
+            _META = run_meta()
+            return _META
+        except Exception:          # noqa: BLE001
+            pass
     import platform
     import socket
     import subprocess
     import time as _t
-    meta = {"host": socket.gethostname(),
-            "platform": platform.platform(),
-            "python": platform.python_version(),
-            "time": _t.strftime("%Y-%m-%dT%H:%M:%S%z"),
-            "argv": sys.argv[1:]}
+    _META = {"host": socket.gethostname(),
+             "platform": platform.platform(),
+             "python": platform.python_version(),
+             "time": _t.strftime("%Y-%m-%dT%H:%M:%S%z"),
+             "argv": sys.argv[1:]}
+    # git attribution needs no package import — a device-unavailable
+    # artifact must still name the commit that produced it
     repo = os.path.dirname(os.path.abspath(__file__))
     try:
-        meta["git_sha"] = subprocess.run(
+        _META["git_sha"] = subprocess.run(
             ["git", "rev-parse", "HEAD"], cwd=repo, capture_output=True,
             text=True, timeout=10).stdout.strip() or None
     except Exception:              # noqa: BLE001 — no git, shallow, etc.
-        meta["git_sha"] = None
+        _META["git_sha"] = None
     try:
-        # independent of the sha: a slow/failed `git status` must not
-        # clobber an already-computed sha
-        meta["git_dirty"] = bool(subprocess.run(
+        _META["git_dirty"] = bool(subprocess.run(
             ["git", "status", "--porcelain"], cwd=repo,
             capture_output=True, text=True, timeout=10).stdout.strip())
     except Exception:              # noqa: BLE001
-        meta["git_dirty"] = None
-    try:
-        import jax
-        meta["jax"] = jax.__version__
-    except Exception:              # noqa: BLE001
-        pass
-    try:
-        from paddle_tpu.framework import flags as _flags
-        meta["flags_overrides"] = _flags.overrides()
-    except Exception:              # noqa: BLE001
-        meta["flags_overrides"] = {}
-    _META = meta
-    return meta
+        _META["git_dirty"] = None
+    return _META
 
 
 def _write_artifact(complete):
@@ -130,19 +143,58 @@ def _write_artifact(complete):
         with open(tmp, "w") as f:
             # default=str: a non-JSON-serializable flag override in the
             # meta must degrade to its repr, not raise mid-bench
-            json.dump({"meta": _run_meta(), "records": _RECORDS,
+            json.dump({"meta": _run_meta(),
+                       "schema_version": BENCH_SCHEMA_VERSION,
+                       "records": _RECORDS,
                        "complete": complete}, f, indent=1, default=str)
         os.replace(tmp, _ARTIFACT)
+    except Exception as e:         # noqa: BLE001
+        # the artifact must never fail a bench — but a silent loss is a
+        # post-mortem hole: degrade to a flight event when possible
+        try:
+            if "paddle_tpu" in sys.modules:
+                from paddle_tpu.framework.observability import flight
+                flight.record("bench.artifact_error", severity="warn",
+                              path=_ARTIFACT, error=repr(e))
+        except Exception:          # noqa: BLE001
+            pass
+
+
+def _append_ledger(rec):
+    """One run-ledger record per completed leg.  Skipped entirely on
+    the device-unavailable path (the package import could hang on a
+    wedged lease); RunLedger.append itself never raises — ledger I/O
+    faults degrade to a flight event + counter, never a crashed
+    bench."""
+    if "paddle_tpu" not in sys.modules:
+        return
+    try:
+        from paddle_tpu.framework import runlog
+        # per-leg records carry the leg only (no registry snapshot):
+        # process-cumulative counters ramp WITHIN a multi-leg bench
+        # run and would read as cross-run regressions; the cross-run
+        # series for bench is the leg metrics themselves
+        runlog.RunLedger(_LEDGER).append(
+            runlog.capture("bench", label="bench", legs=[rec],
+                           include_snapshot=False))
     except Exception:              # noqa: BLE001
-        pass                       # the artifact must never fail a bench
+        pass
+
+
+_LEG_T0 = [time.monotonic()]
 
 
 def _emit(metric, value, unit, vs_baseline):
+    now = time.monotonic()
     rec = {"metric": metric, "value": round(float(value), 3),
-           "unit": unit, "vs_baseline": round(float(vs_baseline), 3)}
+           "unit": unit, "vs_baseline": round(float(vs_baseline), 3),
+           "schema_version": BENCH_SCHEMA_VERSION,
+           "leg_s": round(now - _LEG_T0[0], 3)}
+    _LEG_T0[0] = now
     print(json.dumps(rec), flush=True)
     _RECORDS.append(rec)
     _write_artifact(complete=False)
+    _append_ledger(rec)
 
 
 def _finalize_artifact():

@@ -126,6 +126,9 @@ class Report:
     def __init__(self, diagnostics: Optional[List[Diagnostic]] = None):
         self.diagnostics: List[Diagnostic] = list(diagnostics or [])
         self.files_seen: List[str] = []
+        # structured PTA106 output ({total_flops, total_bytes, by_op})
+        # attached by the jaxpr cost pass; None for AST-only reports
+        self.cost: Optional[dict] = None
 
     def add(self, diag: Diagnostic):
         self.diagnostics.append(diag)
@@ -135,6 +138,9 @@ class Report:
             self.diagnostics.extend(other.diagnostics)
             self.files_seen.extend(
                 f for f in other.files_seen if f not in self.files_seen)
+            if self.cost is None and getattr(other, "cost", None) \
+                    is not None:
+                self.cost = other.cost
         else:
             self.diagnostics.extend(other)
 
@@ -145,6 +151,7 @@ class Report:
                       if d.severity >= min_severity
                       and d.rule not in set(disable)])
         out.files_seen = list(self.files_seen)
+        out.cost = self.cost
         return out
 
     def by_severity(self, severity: Severity) -> List[Diagnostic]:

@@ -371,11 +371,29 @@ def _pass_consts(jaxpr, consts, name, report: Report):
 def _pass_cost(jaxpr, name, top_k, report: Report):
     rows: List[Tuple[int, int, str]] = []
     total_f = total_b = 0
+    by_op: dict = {}
     for eqn, depth in iter_eqns(jaxpr):
         f, b = eqn_cost(eqn)
         total_f += f
         total_b += b
         rows.append((f, b, eqn.primitive.name))
+        agg = by_op.setdefault(eqn.primitive.name, [0, 0, 0])
+        agg[0] += f
+        agg[1] += b
+        agg[2] += 1
+    # structured twin of the PTA106 diagnostics: per-primitive
+    # aggregates the span<->cost join (tools/perf_report.py attribute)
+    # consumes without parsing message strings
+    report.cost = {
+        "name": name,
+        "total_flops": int(total_f),
+        "total_bytes": int(total_b),
+        "n_eqns": len(rows),
+        "by_op": [{"op": op, "flops": int(f), "bytes": int(b),
+                   "count": int(c)}
+                  for op, (f, b, c) in sorted(
+                      by_op.items(), key=lambda kv: -kv[1][0])],
+    }
     rows.sort(key=lambda r: -r[0])
     for rank, (f, b, pname) in enumerate(rows[:top_k], start=1):
         if f == 0:

@@ -126,6 +126,27 @@ class TrainEpochRange:
                     now - self._last_save >= self.save_checkpoint_inter or
                     epoch == self.max_epoch_num - 1):
                 self.save_checkpoint(epoch)
+        self._append_run_record(start)
+
+    def _append_run_record(self, start_epoch: int):
+        """A completed epoch range appends one ``train_epoch``
+        RunRecord to the persistent run ledger when FLAGS_runlog_dir
+        arms the observatory (empty flag = off, zero cost).
+        Best-effort by contract: the ledger must never fail the
+        training loop it records."""
+        try:
+            from paddle_tpu.framework import runlog
+            path = runlog.default_ledger_path()
+            if not path:
+                return
+            rec = runlog.capture(
+                "train_epoch", label=self.name,
+                extra={"epochs": {"start": start_epoch,
+                                  "end": self.max_epoch_num - 1,
+                                  "restored": self.restored_epoch}})
+            runlog.RunLedger(path).append(rec)
+        except Exception:          # noqa: BLE001 — recorder never crashes
+            pass
 
 
 def train_epoch_range(max_epoch_num: int, name: str = "default",

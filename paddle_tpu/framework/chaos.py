@@ -71,6 +71,15 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         watcher must never crash the watched train
                         step; ``mode="latency"`` a slow one the step
                         simply absorbs
+``runlog.observe``      head of every run-ledger append
+                        (framework/runlog.py RunLedger.append) —
+                        ``mode="error"`` is a broken/full ledger disk
+                        the append must swallow and count
+                        (``runlog_write_errors_total`` + a
+                        ``runlog.write_error`` flight event): the run
+                        being recorded must never crash on its
+                        recorder; ``mode="latency"`` a slow disk the
+                        append simply absorbs
 =====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
@@ -111,7 +120,7 @@ FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "ckpt.save", "download.fetch", "train.step_grads",
                 "elastic.lease", "elastic.worker_hang",
                 "health.detector", "zero.collective",
-                "numerics.observe")
+                "numerics.observe", "runlog.observe")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

@@ -242,6 +242,14 @@ define_flag("numerics_scale_collapse_k", 4,
             "numerics.scale_collapse flight event every K consecutive "
             "decreases (a scale halving K times without an intervening "
             "good streak is a systematic overflow, not a transient)")
+# continuous-perf observatory (framework/runlog.py + tools/perf_report.py):
+define_flag("runlog_dir", "",
+            "directory of the persistent run ledger "
+            "(<runlog_dir>/ledger.jsonl, append-only JSONL).  Non-empty "
+            "arms the implicit producers — TrainEpochRange appends a "
+            "RunRecord when an epoch range completes; bench.py and the "
+            "tool CLIs (--ledger) take an explicit path and work either "
+            "way.  Empty = implicit run recording off")
 define_flag("profiler_max_spans", 100000,
             "cap on retained chrome-trace spans per profiling session; "
             "beyond it spans are dropped (counted — the Profiling "

@@ -227,22 +227,51 @@ def reset_all_stats():
     StatRegistry.instance().reset_all()
 
 
-def snapshot() -> Dict[str, dict]:
+def snapshot(labels=None) -> Dict[str, dict]:
     """One JSON-able capture of the whole registry: every stat value
     plus every histogram's summary AND raw buckets — the metrics
     snapshot ``tools/health_check.py`` consumes (richer than the
     Prometheus rendering: percentiles come pre-interpolated and the
-    bucket arrays survive round-tripping)."""
+    bucket arrays survive round-tripping).
+
+    ``labels=`` (an iterable of name prefixes) keeps only stats and
+    histograms whose name starts with one of the prefixes — the run
+    ledger's capture narrows a huge registry to the series it records
+    without a second pass.  ``None`` and an EMPTY iterable both mean
+    "no filter" (an empty prefix tuple would otherwise silently drop
+    everything — a config that supplies no prefixes wants the default,
+    not a blank snapshot).  The ``flight_events`` section (lifetime
+    flight-recorder event counts by kind) always rides along, so one
+    snapshot call is a complete RunRecord capture."""
+    if isinstance(labels, str):
+        labels = (labels,)         # a bare string must not filter by
+    prefixes = tuple(str(p) for p in labels) if labels is not None \
+        else ()                    # its individual characters
+    if prefixes:
+        def keep(name: str) -> bool:
+            return name.startswith(prefixes)
+    else:
+        def keep(name: str) -> bool:
+            return True
     with _hist_lock:
         hs = sorted(_hists.items())
     hists = {}
     for name, h in hs:
+        if not keep(name):
+            continue
         bounds, counts, count, total = h.buckets()
         rec = h.summary()
         rec["bounds"] = bounds
         rec["bucket_counts"] = counts
         hists[name] = rec
-    return {"stats": all_stats(), "histograms": hists}
+    try:
+        # lazy: monitor must stay importable below observability
+        from paddle_tpu.framework.observability import flight
+        flight_events = flight.kind_totals()
+    except Exception:              # noqa: BLE001 — partial-import startup
+        flight_events = {}
+    return {"stats": {n: v for n, v in all_stats().items() if keep(n)},
+            "histograms": hists, "flight_events": flight_events}
 
 
 # ---------------------------------------------------------------------------

@@ -52,7 +52,7 @@ from paddle_tpu.framework.flags import flag
 
 __all__ = ["SpanContext", "Span", "Tracer", "tracer", "FlightRecorder",
            "flight", "MetricsReporter", "install_crash_handler",
-           "validate_prometheus"]
+           "validate_prometheus", "span_summary"]
 
 
 def _new_id() -> str:
@@ -351,6 +351,57 @@ class Tracer:
 tracer = Tracer()
 
 
+def span_summary(trace_dir: str) -> List[dict]:
+    """Per-span-name aggregates over every ``trace_*.jsonl`` file under
+    ``trace_dir`` — count, total/mean/p99/max ms, error count — sorted
+    heaviest-first.  This reads the Tracer's OWN span-file format (the
+    module that writes it owns the reader), so in-framework consumers
+    (the run ledger's RunRecord capture) need no dependency on
+    ``tools/trace_merge.py``; that tool renders the same shape from a
+    merged chrome-trace.  Durations need no clock correction — offsets
+    shift timestamps, not spans' lengths.  Malformed lines are skipped,
+    torn-trace tolerant."""
+    import glob
+
+    durs: Dict[str, List[float]] = {}
+    errors: Dict[str, int] = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir,
+                                              "trace_*.jsonl"))):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") != "span":
+                continue
+            name = str(rec.get("name", "?"))
+            durs.setdefault(name, []).append(
+                float(rec.get("dur", 0.0)) / 1e3)
+            if rec.get("status") == "error":
+                errors[name] = errors.get(name, 0) + 1
+    rows = []
+    for name, ms in durs.items():
+        ms.sort()
+        n = len(ms)
+        p99 = ms[min(n - 1, max(0, int(0.99 * n + 0.5) - 1))]
+        rows.append({"name": name, "count": n,
+                     "total_ms": round(sum(ms), 3),
+                     "mean_ms": round(sum(ms) / n, 3),
+                     "p99_ms": round(p99, 3),
+                     "max_ms": round(ms[-1], 3),
+                     "errors": errors.get(name, 0)})
+    rows.sort(key=lambda r: r["total_ms"], reverse=True)
+    return rows
+
+
 # ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------
@@ -375,6 +426,9 @@ class FlightRecorder:
         # kills a hung child
         self._lock = threading.RLock()
         self.dropped = 0
+        # per-kind lifetime totals (NOT ring-bounded): the run ledger's
+        # "flight events by kind" capture must survive ring eviction
+        self._kind_totals: Dict[str, int] = {}
 
     def _buf(self) -> "collections.deque":
         if self._ring is None:
@@ -393,7 +447,15 @@ class FlightRecorder:
             if len(buf) == buf.maxlen:
                 self.dropped += 1
             buf.append(ev)
+            self._kind_totals[kind] = self._kind_totals.get(kind, 0) + 1
         return ev
+
+    def kind_totals(self) -> Dict[str, int]:
+        """Lifetime event counts by kind (unbounded, unlike the ring) —
+        what ``monitor.snapshot()`` exposes as ``flight_events`` so a
+        RunRecord captures the whole run's event mix in one call."""
+        with self._lock:
+            return dict(self._kind_totals)
 
     def recent(self, n: int = 50, kind: Optional[str] = None,
                min_severity: Optional[str] = None) -> List[dict]:
@@ -421,6 +483,7 @@ class FlightRecorder:
         with self._lock:
             self._buf().clear()
             self.dropped = 0
+            self._kind_totals.clear()
 
     def dump(self, path: str, worker: Optional[str] = None) -> str:
         """Write the ring to ``path`` as JSON, atomically (tmp+rename

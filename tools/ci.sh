@@ -72,6 +72,53 @@ JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 30 --numerics \
 JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 30 \
     --nan-step 20 --max-anomalies 3 --max-grad-anomalies 1
 
+echo "== perf observatory lane (run ledger -> span/cost join -> cross-run regression gate) =="
+# (1) span<->cost attribution: a traced 3-step mini train joined with
+# the PTA106 analytic cost model must yield an op-profile where every
+# top-5 op has a measured ms and a finite achieved FLOP/s (--check).
+# (2) two seeded PS mini-train runs appended to a fresh ledger must
+# compare clean; a third run with ps.rpc latency injected from step 0
+# — a level shift the in-run detector's warmup absorbs, so that run's
+# own gates stay green — MUST be flagged by the cross-run compare
+# (named signal, nonzero exit).  (3) the historical BENCH_r01..r05
+# trajectory must import into a ledger and compare without error.
+OBSV=$(mktemp -d /tmp/pt_observatory.XXXXXX)
+JAX_PLATFORMS=cpu python tools/perf_report.py attribute --mini-train 3 \
+    --json "$OBSV/profile.json" --check
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 15 --ps \
+    --ledger "$OBSV/ledger.jsonl" --max-anomalies 0
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 15 --ps \
+    --ledger "$OBSV/ledger.jsonl" --max-anomalies 0
+JAX_PLATFORMS=cpu python tools/perf_report.py compare \
+    --ledger "$OBSV/ledger.jsonl"
+JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
+    FLAGS_chaos_spec='{"ps.rpc": {"mode": "latency", "latency": 0.1, "every": 1}}' \
+    python tools/health_check.py --mini-train 15 --ps \
+    --ledger "$OBSV/ledger.jsonl" --max-anomalies 0
+# the gate demands BOTH the nonzero exit AND a named REGRESSION line in
+# the verdict — a comparator that crashed (tracebacks also exit 1)
+# cannot fake a flag
+rc=0
+JAX_PLATFORMS=cpu python tools/perf_report.py compare \
+    --ledger "$OBSV/ledger.jsonl" | tee "$OBSV/verdict.txt" || rc=$?
+if [ "$rc" != 1 ] || ! grep -q "^REGRESSION .*ps_rpc" "$OBSV/verdict.txt"; then
+  echo "observatory lane FAILED: injected ps.rpc latency run not flagged (rc=$rc)" >&2
+  exit 1
+fi
+JAX_PLATFORMS=cpu python tools/perf_report.py import BENCH_r0*.json \
+    --ledger "$OBSV/hist.jsonl"
+# the historical trajectory is informational (real regressions may
+# exist in it — that is the point); the lane only demands that the
+# comparator RAN to a verdict — crash or parse failure fails here
+rc=0
+JAX_PLATFORMS=cpu python tools/perf_report.py compare \
+    --ledger "$OBSV/hist.jsonl" | tee "$OBSV/hist_verdict.txt" || rc=$?
+if [ "$rc" -gt 1 ] || ! grep -q "^verdict:" "$OBSV/hist_verdict.txt"; then
+  echo "observatory lane FAILED: history compare did not reach a verdict (rc=$rc)" >&2
+  exit 1
+fi
+rm -rf "$OBSV"
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and
@@ -82,7 +129,7 @@ echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
     --zoo ps_transport --zoo ingest --zoo health --zoo zero_step \
-    --zoo numerics_step \
+    --zoo numerics_step --zoo runlog \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="

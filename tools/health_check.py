@@ -63,7 +63,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 __all__ = ["load_metrics", "build_report", "evaluate_gates",
-           "format_report", "mini_train", "main"]
+           "format_report", "mini_train", "mini_train_ps", "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -425,6 +425,77 @@ def mini_train(n_steps: int, trace_dir: str, numerics: bool = False,
     return monitor.snapshot(), provenance
 
 
+def mini_train_ps(n_steps: int, trace_dir: str):
+    """PS-backed mini-train leg: the same decision surface as
+    :func:`mini_train`, but the embedding rows live on an in-process
+    ``PsServer`` reached over localhost TCP, so the run exercises (and
+    records) real ``ps.rpc`` traffic — the observatory lane injects
+    ``ps.rpc`` latency into this leg via ``FLAGS_chaos_spec``.  An
+    injection armed from step 0 is a LEVEL SHIFT: the in-run detector's
+    warmup adopts it (this run's gates stay green), and only the
+    cross-run ledger compare (``tools/perf_report.py compare``) can see
+    it — which is exactly what that lane proves.  Deterministic: fixed
+    seeds, fixed shapes, sync mode, no prefetch."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.ps import (DistributedEmbedding,
+                                           HostEmbeddingTable,
+                                           PSTrainStep)
+    from paddle_tpu.distributed.ps.service import (PsClient, PsServer,
+                                                   RemoteEmbeddingTable)
+    from paddle_tpu.framework import health, monitor
+    from paddle_tpu.framework.observability import tracer
+
+    from paddle_tpu.models import WideDeepHost
+
+    for signal, kw in health.DEFAULT_SIGNALS.items():
+        health.watch(signal, **dict(kw))
+    tracer.enable(trace_dir, label="health_check_ps")
+    table = HostEmbeddingTable(256, 9, optimizer="sgd",
+                               learning_rate=0.05, seed=0)
+    srv = PsServer({"emb": table}, port=0).start()
+    cli = PsClient([f"127.0.0.1:{srv.port}"], wire_dtype="f32",
+                   backoff_base=0.01)
+    try:
+        paddle.seed(0)
+        emb = DistributedEmbedding(
+            256, 9, mode="sync",
+            table=RemoteEmbeddingTable(cli, "emb", 9))
+        model = WideDeepHost(embedding_dim=8, num_fields=4, dense_dim=3,
+                             hidden=(16,))
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+
+        def loss_fn(m, rows, x, y):
+            return F.binary_cross_entropy_with_logits(
+                m(rows, x), y).mean()
+
+        step = PSTrainStep(model, loss_fn, opt, emb,
+                           transfer_dtype="float32", prefetch_depth=0)
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, 256,
+                           size=(n_steps, 8, 4)).astype(np.int64)
+        x = paddle.to_tensor(rng.standard_normal((8, 3))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.random((8, 1)).astype(np.float32))
+        losses = [float(step(ids[n], x, y)) for n in range(n_steps)]
+        assert all(np.isfinite(losses)), \
+            f"PS mini train diverged: {losses[-5:]}"
+        step.flush()
+    finally:
+        try:
+            cli.bye()
+        finally:
+            srv.shutdown()
+            tracer.disable()
+    return monitor.snapshot(), None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="health_check.py", description=__doc__,
@@ -450,6 +521,18 @@ def main(argv=None) -> int:
                          "and gate that train.nan_skip names that "
                          "branch's leaf as first_bad_leaf (the CI "
                          "numerics lane's seeded-NaN leg)")
+    ap.add_argument("--ps", action="store_true",
+                    help="mini-train option: run the PS-backed leg "
+                         "(in-process PsServer over localhost TCP) so "
+                         "real ps.rpc traffic feeds the detectors and "
+                         "the run record")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="append a RunRecord (runlog.capture) for this "
+                         "mini train to the run ledger at PATH — the "
+                         "perf observatory's producer hook")
+    ap.add_argument("--run-label", default=None,
+                    help="RunRecord label (default: 'ps' or 'dense' "
+                         "per the mini-train variant)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--max-anomalies", type=int, default=0,
                     help="gate: tolerated health_anomalies_total "
@@ -474,6 +557,13 @@ def main(argv=None) -> int:
         a.numerics = True
     if a.numerics and a.mini_train is None:
         ap.error("--numerics/--nan-step are mini-train options")
+    if a.ps and a.mini_train is None:
+        ap.error("--ps is a mini-train option")
+    if a.ps and a.numerics:
+        ap.error("--ps and --numerics/--nan-step are separate "
+                 "mini-train legs — run them as two invocations")
+    if a.ledger is not None and a.mini_train is None:
+        ap.error("--ledger records a mini train; pass --mini-train")
 
     health_snapshot = None
     provenance = None
@@ -481,9 +571,12 @@ def main(argv=None) -> int:
         if a.trace_dir is None:
             tmp = tempfile.TemporaryDirectory(prefix="health_check_")
             a.trace_dir = tmp.name          # kept alive by the local ref
-        snap, provenance = mini_train(a.mini_train, a.trace_dir,
-                                      numerics=a.numerics,
-                                      nan_step=a.nan_step)
+        if a.ps:
+            snap, provenance = mini_train_ps(a.mini_train, a.trace_dir)
+        else:
+            snap, provenance = mini_train(a.mini_train, a.trace_dir,
+                                          numerics=a.numerics,
+                                          nan_step=a.nan_step)
         from paddle_tpu.framework import health
         health_snapshot = health.snapshot()
     else:
@@ -499,6 +592,17 @@ def main(argv=None) -> int:
         max_input_stall=a.max_input_stall,
         max_grad_anomalies=a.max_grad_anomalies)
     report["tripped"] = tripped
+    if a.ledger is not None:
+        # one RunRecord per mini train, appended AFTER the gates ran so
+        # the verdict rides along; RunLedger.append never raises
+        from paddle_tpu.framework import runlog
+        label = a.run_label or ("ps" if a.ps else
+                                "numerics" if a.numerics else "dense")
+        rec = runlog.capture("health_check", label=label,
+                             trace_dir=a.trace_dir,
+                             extra={"steps": a.mini_train,
+                                    "tripped": tripped})
+        runlog.RunLedger(a.ledger).append(rec)
     if a.format == "json":
         print(json.dumps(report, indent=1, default=str))
     else:

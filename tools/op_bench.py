@@ -655,6 +655,12 @@ def main(argv=None):
     ap.add_argument("--repeats", type=int, default=3,
                     help="timing passes per op; the min is reported "
                          "(tunnel-spike robustness)")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="append an op_bench RunRecord (one leg per "
+                         "measured metric) to the run ledger at PATH "
+                         "— the perf observatory's producer hook "
+                         "(suite / --ps-transport / --zero-collectives "
+                         "runs)")
     a = ap.parse_args(argv)
 
     if a.eager:
@@ -702,6 +708,28 @@ def main(argv=None):
     if a.save:
         with open(a.save, "w") as f:
             json.dump(results, f, indent=1)
+    if a.ledger:
+        # ms per op plus wire_mb where measured; RunLedger.append never
+        # raises, so the gate below still runs on a broken ledger disk.
+        # Label per suite VARIANT and skip the registry snapshot (the
+        # bench.py discipline): the legs are the cross-run series, and
+        # a process-cumulative counter snapshot would differ wildly
+        # between variants sharing one ledger — a self-flagged
+        # "regression" on a healthy machine
+        from paddle_tpu.framework import runlog
+        variant = "ps_transport" if a.ps_transport else \
+            "zero_collectives" if a.zero_collectives else "suite"
+        legs = []
+        for r in results:
+            if "ms" in r:
+                legs.append({"metric": f"{r['name']}_ms",
+                             "value": r["ms"], "unit": "ms"})
+            if "wire_mb" in r:
+                legs.append({"metric": f"{r['name']}_wire_mb",
+                             "value": r["wire_mb"], "unit": "MB"})
+        runlog.RunLedger(a.ledger).append(
+            runlog.capture("op_bench", label=variant, legs=legs,
+                           include_snapshot=False))
     if a.compare:
         with open(a.compare) as f:
             base = {r["name"]: r for r in json.load(f) if "ms" in r}

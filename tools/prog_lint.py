@@ -66,6 +66,10 @@ ZOO = {
     # (numerics.observe fault-point hygiene + the GradScaler telemetry
     # consumer) — Report, like elastic_step
     "numerics_step": lambda: _zoo_numerics_step(),
+    # lints the continuous-perf observatory sources (runlog.observe
+    # fault-point hygiene in the run ledger + its TrainEpochRange
+    # producer hook) — Report, like elastic_step
+    "runlog": lambda: _zoo_runlog(),
 }
 
 
@@ -267,6 +271,26 @@ def _zoo_numerics_step():
     for rel in (os.path.join("paddle_tpu", "framework", "numerics.py"),
                 os.path.join("paddle_tpu", "framework", "resilient.py"),
                 os.path.join("paddle_tpu", "amp", "__init__.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_runlog():
+    """AST-lint the continuous-perf observatory — the run ledger
+    (framework/runlog.py, which threads the ``runlog.observe`` chaos
+    fault point through every append) plus its in-framework producer
+    hook (auto_checkpoint's TrainEpochRange) — so PTA301/302 validate
+    the new fault-point site against the registry and its
+    swallow-and-count guard."""
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for rel in (os.path.join("paddle_tpu", "framework", "runlog.py"),
+                os.path.join("paddle_tpu", "framework",
+                             "auto_checkpoint.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:

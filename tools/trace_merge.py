@@ -25,7 +25,12 @@ Usage::
 ``--summary`` prints a per-span-name aggregate table (count, total /
 mean / p99 / max ms, error count) from the merged trace — a trace is
 readable at the terminal without ever opening Chrome.  ``--out`` is
-optional with ``--summary``.
+optional with ``--summary``.  ``--summary-json PATH`` writes the same
+rows machine-readable (``{"schema_version", "rows"}``) so downstream
+consumers — ``tools/perf_report.py attribute``, the run ledger — join
+them instead of scraping the table.  ``--dir`` matching zero
+``trace_*.jsonl`` files is an error (clear message, nonzero exit), not
+an empty merged trace.
 """
 from __future__ import annotations
 
@@ -187,12 +192,28 @@ def main(argv=None) -> int:
     ap.add_argument("--summary", action="store_true",
                     help="print a per-span-name aggregate table "
                          "(count, total/mean/p99/max ms, errors)")
+    ap.add_argument("--summary-json", default=None, metavar="PATH",
+                    help="write the summary rows as JSON "
+                         "({schema_version, rows}) — the machine-"
+                         "readable twin of --summary")
     a = ap.parse_args(argv)
-    if a.out is None and not a.summary:
-        ap.error("nothing to do: pass --out and/or --summary")
+    if a.out is None and not a.summary and a.summary_json is None:
+        ap.error("nothing to do: pass --out, --summary and/or "
+                 "--summary-json")
     paths = list(a.inputs)
     if a.dir:
-        paths += sorted(glob.glob(os.path.join(a.dir, "trace_*.jsonl")))
+        dir_paths = sorted(glob.glob(os.path.join(a.dir,
+                                                  "trace_*.jsonl")))
+        if not dir_paths and not paths:
+            # an empty merged trace out of a typo'd/cold directory is a
+            # false green (a CI lane would "pass" on nothing): refuse —
+            # unless explicit input files were also given, which still
+            # merge on their own
+            print(f"trace_merge: --dir {a.dir}: no trace_*.jsonl span "
+                  "files found (tracing off, wrong directory, or the "
+                  "run wrote nothing)", file=sys.stderr)
+            return 1
+        paths += dir_paths
     if not paths:
         print("trace_merge: no input span files", file=sys.stderr)
         return 1
@@ -205,8 +226,14 @@ def main(argv=None) -> int:
                   if e["ph"] == "X"}
         print(f"trace_merge: {len(paths)} file(s) -> {a.out} "
               f"({n} spans, {len(traces)} trace ids)")
-    if a.summary:
-        print(format_summary(summarize(trace)))
+    if a.summary or a.summary_json is not None:
+        rows = summarize(trace)
+        if a.summary:
+            print(format_summary(rows))
+        if a.summary_json is not None:
+            with open(a.summary_json, "w") as f:
+                json.dump({"schema_version": 1, "files": len(paths),
+                           "rows": rows}, f, indent=1)
     return 0
 
 
