@@ -543,6 +543,11 @@ class ElasticAgent:
         self.clock = clock or time.monotonic
         self.log = log or (lambda m: None)
         self.events: List[tuple] = []
+        #: latest cluster-reported straggler scores (collector hook —
+        #: see note_stragglers); empty until a collector reports
+        self.straggler_scores: Dict[str, float] = {}
+        self._straggling: set = set()
+        self._straggler_lock = threading.Lock()
         self._restarts: Dict[str, int] = {}
         self._alive_since: Dict[str, float] = {}
         self._restart_at: Dict[str, float] = {}
@@ -685,6 +690,47 @@ class ElasticAgent:
             if h.name == name:
                 return h
         return None
+
+    def note_stragglers(self, scores: Dict[str, float],
+                        flagged: Optional[Sequence[str]] = None,
+                        threshold: Optional[float] = None):
+        """Adopt the cluster collector's straggler view — the agent
+        that today only sees HANGS (a worker whose progress beat went
+        silent) also learns about workers that are merely *slow*
+        (beating fine, dragging the cluster).  ``scores`` maps worker →
+        step-time skew vs its peers; ``flagged`` is the collector's
+        named-straggler list (recomputed from ``threshold``, default
+        ``FLAGS_collector_straggler_ratio``, when absent).  Newly
+        flagged / recovered workers record ``elastic.straggler`` flight
+        events; the agent does NOT kill a straggler — shrink policy
+        stays an operator decision — but ``straggler_scores`` is live
+        state an autotuner or a future evict-the-slow policy reads.
+        Thread-safe: the collector's handler threads call this while
+        ``run()`` polls."""
+        from paddle_tpu.framework.flags import flag as _flag
+        if flagged is None:
+            thr = float(_flag("collector_straggler_ratio")) \
+                if threshold is None else float(threshold)
+            flagged = [w for w, s in scores.items() if s >= thr]
+        with self._straggler_lock:
+            self.straggler_scores = dict(scores)
+            newly = set(flagged) - self._straggling
+            recovered = self._straggling - set(flagged)
+            self._straggling = set(flagged)
+        for w in sorted(newly):
+            self.log(f"elastic-agent: straggler {w} "
+                     f"(score {scores.get(w, 0.0):.2f})")
+            flight.record("elastic.straggler", severity="warn",
+                          worker=w, score=round(scores.get(w, 0.0), 3))
+        for w in sorted(recovered):
+            flight.record("elastic.straggler", severity="info",
+                          worker=w, score=round(scores.get(w, 0.0), 3),
+                          recovered=True)
+
+    def stragglers(self) -> List[str]:
+        """Currently flagged stragglers (collector-reported)."""
+        with self._straggler_lock:
+            return sorted(self._straggling)
 
     def arm_hang_deadline(self, histogram: str = "train_step_ms",
                           multiplier: float = 50.0, floor: float = 5.0,

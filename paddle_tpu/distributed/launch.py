@@ -70,6 +70,22 @@ def _parse():
                    help="elastic: kill a child whose progress beat is "
                         "older than this (hung/straggler detection; only "
                         "applies once the child has beaten at least once)")
+    p.add_argument("--collector", action="store_true",
+                   help="start a central telemetry collector "
+                        "(framework/collector.py) inside the launcher "
+                        "and export its endpoint to EVERY child — "
+                        "server and trainer roles alike — as "
+                        "PADDLE_COLLECTOR_ENDPOINT; straggler scores "
+                        "feed the elastic agent when --elastic_store "
+                        "is also set")
+    p.add_argument("--collector_endpoint", type=str, default="",
+                   help="push child telemetry to an EXTERNAL collector "
+                        "at host:port instead of starting one "
+                        "in-launcher")
+    p.add_argument("--collector_ledger", type=str, default="",
+                   help="in-launcher collector: append cluster-level "
+                        "RunRecords (straggler report included) to "
+                        "this run-ledger path on 'capture' ops")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -248,18 +264,26 @@ def _supervise(children: List[_Child], elastic_retries: int = 0,
 
 def _run_supervisor(args, children: List[_Child],
                     members: Optional[List[_Child]] = None,
-                    endpoints: Optional[Dict[str, str]] = None) -> int:
+                    endpoints: Optional[Dict[str, str]] = None,
+                    collector=None) -> int:
     """Route to the elastic agent (crash + hang + lease watchdogs) when a
     rendezvous store is configured, else classic watch_local_trainers.
     ``members`` is the subset that joins the rendezvous MEMBERSHIP (the
     trainers); PS servers are supervised but never appear in the world a
     refreshed role maker ranks against.  ``endpoints`` maps member name
     to its host:port so a refreshed role maker hands out real trainer
-    endpoints, not bare child names."""
+    endpoints, not bare child names.  ``collector`` is the in-launcher
+    CollectorServer (when --collector armed): its straggler reports
+    feed the elastic agent, so the supervisor that today only sees
+    hangs also sees slow-but-alive workers."""
     if not args.elastic_store:
-        return _supervise(children, args.elastic_retries,
-                          restart_backoff=args.restart_backoff,
-                          healthy_interval=args.healthy_interval)
+        try:
+            return _supervise(children, args.elastic_retries,
+                              restart_backoff=args.restart_backoff,
+                              healthy_interval=args.healthy_interval)
+        finally:
+            if collector is not None:
+                collector.shutdown()
     from paddle_tpu.distributed.elastic import (ElasticAgent, FileStore,
                                                 ProcHandle)
     store = FileStore(os.path.join(args.elastic_store, "rendezvous.json"),
@@ -275,6 +299,12 @@ def _run_supervisor(args, children: List[_Child],
                          log=lambda m: print(m, file=sys.stderr),
                          member_names=[c.name for c in members],
                          endpoints=endpoints)
+    if collector is not None:
+        # cluster straggler scores flow into the agent's view: the
+        # hang watchdog sees dead-silent workers, the collector sees
+        # merely-slow ones
+        collector.on_straggler = \
+            lambda scores, flagged: agent.note_stragglers(scores, flagged)
 
     def _sig(_s, _f):
         for c in children:
@@ -283,7 +313,11 @@ def _run_supervisor(args, children: List[_Child],
 
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
-    return agent.run()
+    try:
+        return agent.run()
+    finally:
+        if collector is not None:
+            collector.shutdown()
 
 
 def _elastic_env(args, name: str) -> Dict[str, str]:
@@ -298,6 +332,32 @@ def _elastic_env(args, name: str) -> Dict[str, str]:
         "PADDLE_ELASTIC_WORKER_ID": name,
         "PADDLE_ELASTIC_LEASE_TTL": str(args.lease_ttl),
     }
+
+
+def _start_collector(args):
+    """Start the in-launcher collector when ``--collector`` asks for
+    one; returns ``(collector_server_or_None, endpoint_or_None)``.
+    Lazy import: the plain launcher path must stay framework-free."""
+    if getattr(args, "collector", False):
+        from paddle_tpu.framework.collector import CollectorServer
+        srv = CollectorServer(
+            ledger_path=args.collector_ledger or None).start()
+        print(f"launch: telemetry collector on {srv.endpoint}",
+              file=sys.stderr)
+        return srv, srv.endpoint
+    ep = getattr(args, "collector_endpoint", "") or ""
+    return None, (ep or None)
+
+
+def _collector_env(endpoint: Optional[str], role: str) -> Dict[str, str]:
+    """Telemetry env every child gets — server AND trainer roles: the
+    collector endpoint (when armed) and the child's role, so pushed
+    snapshots and span files are labeled per role, not just per
+    worker."""
+    env = {"PADDLE_ROLE": role}
+    if endpoint:
+        env["PADDLE_COLLECTOR_ENDPOINT"] = endpoint
+    return env
 
 
 def _launch_collective(args, ips) -> int:
@@ -317,12 +377,15 @@ def _launch_collective(args, ips) -> int:
     name = f"trainer-{rank}"
     env["PADDLE_TRACE_LABEL"] = name   # per-process span file when
     env.update(_elastic_env(args, name))   # FLAGS_trace_dir is armed
+    collector, col_ep = _start_collector(args)
+    env.update(_collector_env(col_ep, "trainer"))
     os.makedirs(args.log_dir, exist_ok=True)
     cmd = [sys.executable, args.training_script] + args.training_script_args
     child = _Child(name, cmd, env,
                    os.path.join(args.log_dir, f"workerlog.{rank}"))
     return _run_supervisor(args, [child],
-                           endpoints={name: env["PADDLE_CURRENT_ENDPOINT"]})
+                           endpoints={name: env["PADDLE_CURRENT_ENDPOINT"]},
+                           collector=collector)
 
 
 def _launch_ps(args) -> int:
@@ -338,13 +401,18 @@ def _launch_ps(args) -> int:
         "PADDLE_TRAINER_ENDPOINTS": ",".join(worker_eps),
         "PADDLE_TRAINERS_NUM": str(n_w),
     }
+    collector, col_ep = _start_collector(args)
     children = []
     for i in range(n_s):
+        # server children get the SAME telemetry env as trainers: a
+        # per-role trace label AND the collector endpoint, so PS-shard
+        # span files and pushed snapshots are attributable per role
         env = dict(common, TRAINING_ROLE="PSERVER",
                    PADDLE_PSERVER_ID=str(i),
                    PADDLE_PORT=str(args.start_port + i),
                    POD_IP="127.0.0.1",
                    PADDLE_TRACE_LABEL=f"server-{i}")
+        env.update(_collector_env(col_ep, "server"))
         children.append(_Child(
             f"server-{i}", cmd, env,
             os.path.join(args.log_dir, f"serverlog.{i}")))
@@ -354,13 +422,15 @@ def _launch_ps(args) -> int:
                    PADDLE_CURRENT_ENDPOINT=worker_eps[i],
                    PADDLE_TRACE_LABEL=f"trainer-{i}")
         env.update(_elastic_env(args, f"trainer-{i}"))
+        env.update(_collector_env(col_ep, "trainer"))
         children.append(_Child(
             f"trainer-{i}", cmd, env,
             os.path.join(args.log_dir, f"workerlog.{i}")))
     return _run_supervisor(
         args, children,
         members=[c for c in children if c.name.startswith("trainer-")],
-        endpoints={f"trainer-{i}": worker_eps[i] for i in range(n_w)})
+        endpoints={f"trainer-{i}": worker_eps[i] for i in range(n_w)},
+        collector=collector)
 
 
 def main():

@@ -45,12 +45,13 @@ from paddle_tpu.core import Parameter, Tensor, apply1
 from paddle_tpu.framework import health, monitor
 from paddle_tpu.jit import not_to_static
 from paddle_tpu.distributed.ps.device_table import (
-    DeviceEmbeddingTrainStep, MeshShardedEmbedding, mesh_sharded_lookup)
+    DeviceEmbeddingTrainStep, HotRowSketch, MeshShardedEmbedding,
+    mesh_sharded_lookup)
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.parallel.mesh import DistAttr
 
 __all__ = ["HashEmbeddingTable", "MeshShardedEmbedding",
-           "DeviceEmbeddingTrainStep",
+           "DeviceEmbeddingTrainStep", "HotRowSketch",
            "ShardedEmbedding", "HostEmbeddingTable", "DistributedEmbedding",
            "AsyncCommunicator", "PSTrainStep", "mesh_sharded_lookup"]
 
@@ -108,9 +109,17 @@ class HostEmbeddingTable:
         elif optimizer != "sgd":
             raise ValueError(f"unsupported table optimizer {optimizer!r}")
         self._lock = threading.Lock()
+        # bounded hot-row telemetry (FLAGS_ps_hot_row_k; 0 = off): which
+        # rows this table actually serves — the signal a serving-side
+        # row cache / the cluster collector's hot-table view consumes
+        from paddle_tpu.framework.flags import flag
+        k = int(flag("ps_hot_row_k"))
+        self.hot_rows = HotRowSketch(k) if k > 0 else None
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         """PullSparse (fleet_wrapper.h:111): rows for this batch."""
+        if self.hot_rows is not None:
+            self.hot_rows.update(ids)
         with self._lock:
             return self._table[ids]
 
@@ -347,6 +356,9 @@ class HashEmbeddingTable:
         self._rows: Dict[int, np.ndarray] = {}
         self._g2: Dict[int, float] = {}
         self._lock = threading.Lock()
+        from paddle_tpu.framework.flags import flag
+        k = int(flag("ps_hot_row_k"))
+        self.hot_rows = HotRowSketch(k) if k > 0 else None
 
     def _row(self, i: int) -> np.ndarray:
         r = self._rows.get(i)
@@ -364,6 +376,8 @@ class HashEmbeddingTable:
     def pull(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, np.int64)
         flat = ids.reshape(-1)
+        if self.hot_rows is not None:
+            self.hot_rows.update(flat)
         with self._lock:
             out = np.stack([self._row(int(i)) for i in flat])
         return out.reshape(ids.shape + (self.embedding_dim,))

@@ -41,6 +41,7 @@ gradient (lossless default: capacity = local id count).
 from __future__ import annotations
 
 import math
+import threading
 from typing import Optional
 
 import jax
@@ -53,8 +54,8 @@ from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.parallel.mesh import DistAttr, get_mesh
 
 __all__ = ["MeshShardedEmbedding", "mesh_sharded_lookup",
-           "DeviceEmbeddingTrainStep", "WIRE_DTYPES", "normalize_wire",
-           "quantize_rows", "dequantize_rows"]
+           "DeviceEmbeddingTrainStep", "HotRowSketch", "WIRE_DTYPES",
+           "normalize_wire", "quantize_rows", "dequantize_rows"]
 
 
 # ---------------------------------------------------------------------------
@@ -68,6 +69,122 @@ __all__ = ["MeshShardedEmbedding", "mesh_sharded_lookup",
 
 from paddle_tpu.distributed.wire import (  # noqa: F401,E402
     WIRE_DTYPES, dequantize_rows, normalize_wire, quantize_rows)
+
+
+class HotRowSketch:
+    """Bounded top-k frequent-row sketch (space-saving / Misra–Gries).
+
+    The hot-row telemetry a serving or online-learning row cache needs:
+    which embedding rows does this table actually serve?  A host table
+    sees billions of pulls over a skewed id distribution; counting every
+    id exactly would grow without bound, so the sketch keeps at most
+    ``capacity`` counters (default ``8*k``) with the space-saving
+    eviction rule — an unseen id replaces the current minimum counter
+    and inherits its count — which guarantees every id with true
+    frequency above ``N/capacity`` is retained and over-counts by at
+    most the evicted minimum.  ``top(k)`` is what the PS ``stat`` op and
+    the cluster collector report.
+
+    Eviction runs as ONE heap sweep per batch — cold ids collect during
+    the counting pass and then run exact sequential space-saving
+    against a min-heap of the counters, O((batch + capacity)·log
+    capacity) per pull — instead of a full dict min-scan per cold id
+    (which would cost O(batch·capacity) on cold-id-heavy streams —
+    exactly the never-slow-the-observed-process violation this plane
+    forbids).
+
+    Thread-safe (the table's pull path updates it under its own lock is
+    NOT assumed — the sketch carries its own).
+    """
+
+    def __init__(self, k: int = 32, capacity: Optional[int] = None):
+        self.k = int(k)
+        self.capacity = int(capacity) if capacity is not None \
+            else max(self.k * 8, self.k)
+        self._counts: dict = {}
+        self.total = 0                 # ids observed (not distinct)
+        self._lock = threading.Lock()
+
+    def update(self, ids, counts=None):
+        """Fold one batch of row ids in; ``counts`` (aligned) weights
+        them (the collector-side merge path re-feeds top-k rows with
+        their counts)."""
+        flat = np.asarray(ids).reshape(-1)
+        if flat.size == 0:
+            return
+        if counts is None:
+            uniq, cnt = np.unique(flat, return_counts=True)
+        else:
+            # dedupe HERE too: a repeated id in an explicit-counts
+            # batch (e.g. a concatenated cross-source top-k) would
+            # otherwise take the cold path twice and overwrite its own
+            # eviction slot, losing counts and leaking capacity
+            w = np.asarray(counts).reshape(-1)
+            uniq, inv = np.unique(flat, return_inverse=True)
+            cnt = np.zeros(uniq.shape[0], w.dtype)
+            np.add.at(cnt, inv, w)
+        with self._lock:
+            c = self._counts
+            cold = []
+            for i, n in zip(uniq.tolist(), cnt.tolist()):
+                n = int(n)
+                self.total += n
+                if i in c:
+                    c[i] += n
+                elif len(c) < self.capacity:
+                    c[i] = n
+                else:
+                    cold.append((n, i))
+            if cold:
+                # one heap sweep per batch: exact sequential space-
+                # saving (each cold id evicts the CURRENT minimum and
+                # inherits its count — a heavy existing counter can
+                # never be displaced by a weight-1 newcomer), heaviest
+                # cold ids first so they claim the lowest floors
+                import heapq
+                heap = [(cnt, vid) for vid, cnt in c.items()]
+                heapq.heapify(heap)
+                cold.sort(reverse=True)
+                for n, i in cold:
+                    floor, vid = heapq.heappop(heap)
+                    while vid not in c or c[vid] != floor:
+                        # stale heap entry: vid was evicted (or its
+                        # slot re-minted) earlier in this sweep
+                        floor, vid = heapq.heappop(heap)
+                    del c[vid]
+                    c[i] = floor + n
+                    heapq.heappush(heap, (floor + n, i))
+
+    def merge(self, top_rows):
+        """Fold another sketch's ``top()`` rows in (the collector's
+        cross-shard merge): ``[(id, count), ...]``."""
+        if not top_rows:
+            return
+        ids = np.asarray([r[0] for r in top_rows], np.int64)
+        cnt = np.asarray([r[1] for r in top_rows], np.int64)
+        self.update(ids, counts=cnt)
+
+    def top(self, n: Optional[int] = None):
+        """The ``n`` (default ``k``) hottest rows as ``[(id, count),
+        ...]``, hottest first; count ties break on id for deterministic
+        output."""
+        n = self.k if n is None else int(n)
+        with self._lock:
+            items = sorted(self._counts.items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+        return [(int(i), int(c)) for i, c in items[:n]]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            tracked = len(self._counts)
+        return {"k": self.k, "capacity": self.capacity,
+                "total": self.total, "tracked": tracked,
+                "top": self.top()}
+
+    def reset(self):
+        with self._lock:
+            self._counts.clear()
+            self.total = 0
 
 
 def _sort_dedup(flat):

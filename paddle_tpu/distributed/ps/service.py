@@ -57,10 +57,8 @@ def _recvall(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _send_msg(sock: socket.socket, header: dict,
-              bufs: Sequence[np.ndarray] = ()) -> int:
-    """Frame + send; returns the bytes put on the wire (transport
-    accounting)."""
+def _frame_msg(header: dict, bufs: Sequence[np.ndarray] = ()) -> bytes:
+    """Serialize one wire frame (header json + raw buffers)."""
     meta = dict(header)
     meta["__bufs__"] = [{"shape": list(b.shape), "dtype": str(b.dtype)}
                         for b in bufs]
@@ -70,7 +68,14 @@ def _send_msg(sock: socket.socket, header: dict,
         data = np.ascontiguousarray(b).tobytes()
         out.append(struct.pack("<Q", len(data)))
         out.append(data)
-    msg = b"".join(out)
+    return b"".join(out)
+
+
+def _send_msg(sock: socket.socket, header: dict,
+              bufs: Sequence[np.ndarray] = ()) -> int:
+    """Frame + send; returns the bytes put on the wire (transport
+    accounting)."""
+    msg = _frame_msg(header, bufs)
     sock.sendall(msg)
     return len(msg)
 
@@ -278,12 +283,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 reply, rbufs, ok = {"ok": False, "error": repr(e)}, [], False
             if span is not None:
                 span.end(status="ok" if ok else "error")
+            # record BEFORE the reply bytes hit the wire: a client that
+            # snapshots the instant its reply arrives (tests, stat-op
+            # consumers) must find this request already counted — the
+            # old record-after-send ordering raced exactly that read
+            msg = _frame_msg(reply, rbufs)
+            srv.transport.record(header.get("op"), len(msg), n_in,
+                                 time.perf_counter() - t0, error=not ok)
             try:
-                n_out = _send_msg(sock, reply, rbufs)
+                sock.sendall(msg)
             except OSError:
                 return
-            srv.transport.record(header.get("op"), n_out, n_in,
-                                 time.perf_counter() - t0, error=not ok)
             if header.get("op") in ("bye", "shutdown"):
                 return
 
@@ -316,6 +326,12 @@ class PsServer:
         self._bye_count = 0
         self._lock = threading.Lock()
         self.transport = TransportStats(role="server")
+        # per-table request accounting (the PS-skew telemetry the
+        # cluster collector aggregates per shard): pulls/pushes served
+        # and row volume each way, plus the table's own bounded hot-row
+        # sketch — see HostEmbeddingTable.hot_rows
+        self._table_stats: Dict[str, Dict[str, int]] = {}
+        self._tstats_lock = threading.Lock()
         # push dedup: worker -> insertion-ordered {seq: True} window
         self._push_seen: Dict[str, "dict"] = {}
         self._seen_lock = threading.Lock()
@@ -363,6 +379,37 @@ class PsServer:
         worker, seq = header.get("worker"), header.get("seq")
         with self._seen_lock:
             self._push_seen.get(worker, {}).pop(seq, None)
+
+    def _note_table(self, table: str, pulls: int = 0, pushes: int = 0,
+                    rows_pulled: int = 0, rows_pushed: int = 0):
+        with self._tstats_lock:
+            t = self._table_stats.setdefault(
+                table, {"pulls": 0, "pushes": 0, "rows_pulled": 0,
+                        "rows_pushed": 0})
+            t["pulls"] += pulls
+            t["pushes"] += pushes
+            t["rows_pulled"] += rows_pulled
+            t["rows_pushed"] += rows_pushed
+        if pulls:
+            monitor.stat_add(f"ps_server_table_pulls[{table}]", pulls)
+        if pushes:
+            monitor.stat_add(f"ps_server_table_pushes[{table}]", pushes)
+
+    def table_telemetry(self) -> Dict[str, dict]:
+        """Per-table request counts + the bounded hot-row top-k — the
+        ``tables`` section of this shard's collector pushes and of the
+        ``stat`` op (the skew/hot-row telemetry a serving-side row
+        cache and the cluster view consume)."""
+        with self._tstats_lock:
+            out = {n: dict(t) for n, t in self._table_stats.items()}
+        for name, t in self.tables.items():
+            sketch = getattr(t, "hot_rows", None)
+            if sketch is not None:
+                out.setdefault(name, {"pulls": 0, "pushes": 0,
+                                      "rows_pulled": 0,
+                                      "rows_pushed": 0})
+                out[name]["hot_rows"] = sketch.top()
+        return out
 
     def _is_dup_push(self, header: dict) -> bool:
         """Peek: stamp already claimed? (Test/introspection surface —
@@ -444,7 +491,10 @@ class PsServer:
                     "time": time.time()}, []
         if op == "pull":
             t = self.tables[header["table"]]
-            rows = t.pull(bufs[0].astype(np.int64))
+            ids = bufs[0].astype(np.int64)
+            rows = t.pull(ids)
+            self._note_table(header["table"], pulls=1,
+                             rows_pulled=int(ids.size))
             # reply-driven negotiation: encode in the dtype the request
             # asked for and DECLARE it in the reply header; a client
             # talking to an old server sees no "wire" key and decodes
@@ -453,6 +503,8 @@ class PsServer:
             return {"ok": True, "wire": wire}, quantize_rows(rows, wire)
         if op == "push":
             dup = self._apply_push(header, bufs[0], bufs[1:])
+            self._note_table(header["table"], pushes=1,
+                             rows_pushed=int(np.asarray(bufs[0]).size))
             return {"ok": True, "dup": dup}, []
         if op == "push_pull":
             # one round-trip for the pipeline's coalesced cycle: apply
@@ -465,7 +517,13 @@ class PsServer:
             if n_push:
                 dup = self._apply_push(header, bufs[0], bufs[1:1 + n_push])
             t = self.tables[header["table"]]
-            rows = t.pull(bufs[1 + n_push].astype(np.int64))
+            pull_ids = bufs[1 + n_push].astype(np.int64)
+            rows = t.pull(pull_ids)
+            self._note_table(
+                header["table"], pulls=1, pushes=int(bool(n_push)),
+                rows_pulled=int(pull_ids.size),
+                rows_pushed=int(np.asarray(bufs[0]).size) if n_push
+                else 0)
             wire = normalize_wire(header.get("wire", "f32"))
             return {"ok": True, "wire": wire,
                     "dup": dup}, quantize_rows(rows, wire)
@@ -507,6 +565,9 @@ class PsServer:
                     # detector + compile-site state, so a worker set can
                     # spot its straggler from one stat() call
                     "health": health.snapshot(),
+                    # per-table request skew + hot-row top-k — what
+                    # cluster_top's collector-less fallback scrapes
+                    "table_stats": self.table_telemetry(),
                     "epoch": self.epoch}, []
         if op == "bye":
             # a fenced job counts only CURRENT-epoch byes toward the
@@ -1094,8 +1155,19 @@ def serve(port: int, table_specs: Sequence[str], host: str = "127.0.0.1",
         tables[name] = HostEmbeddingTable(rows, dim, optim, lr)
     srv = PsServer(tables, host=host, port=port,
                    heartbeat_timeout=heartbeat_timeout, n_workers=n_workers)
+    # push this shard's telemetry (incl. per-table request skew + hot
+    # rows) to the cluster collector when the launcher exported an
+    # endpoint; fire-and-forget — a dead collector costs nothing
+    from paddle_tpu.framework import collector
+    reporter = collector.auto_reporter(role="server",
+                                       payload_extra=lambda: {
+                                           "tables": srv.table_telemetry()})
     announce(f"PS_READY {srv.host}:{srv.port}", flush=True)
-    srv.serve_forever()
+    try:
+        srv.serve_forever()
+    finally:
+        if reporter is not None:
+            reporter.stop(final_write=True)
 
 
 # Spawn recipe for a server subprocess: the server is host-tier only

@@ -80,6 +80,15 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         being recorded must never crash on its
                         recorder; ``mode="latency"`` a slow disk the
                         append simply absorbs
+``collector.rpc``       head of every telemetry push the
+                        fire-and-forget sender thread attempts
+                        (framework/collector.py CollectorClient) —
+                        ``mode="error"`` is a dead/refusing collector:
+                        the payload is DROPPED and counted
+                        (``collector_dropped_total``), the pushing
+                        train loop is bit-identical to a collector-less
+                        run; ``mode="latency"`` a slow collector the
+                        sender thread absorbs off the training path
 =====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
@@ -120,7 +129,7 @@ FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "ckpt.save", "download.fetch", "train.step_grads",
                 "elastic.lease", "elastic.worker_hang",
                 "health.detector", "zero.collective",
-                "numerics.observe", "runlog.observe")
+                "numerics.observe", "runlog.observe", "collector.rpc")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

@@ -186,6 +186,45 @@ define_flag("metrics_export_interval", 30.0,
             "seconds between MetricsReporter writes of "
             "monitor.export_prometheus() to its textfile (atomic "
             "tmp+rename, scraper-safe)")
+# cluster telemetry tier (framework/collector.py central collector +
+# tools/cluster_top.py):
+define_flag("collector_endpoint", "",
+            "host:port of the central telemetry collector "
+            "(framework/collector.py CollectorServer).  Non-empty arms "
+            "collector.auto_reporter(): the process pushes periodic "
+            "monitor.snapshot() deltas + flight-event deltas over the "
+            "PS RPC framing, fire-and-forget (bounded queue, drop "
+            "counter, collector.rpc chaos point) — collector loss can "
+            "never slow or crash the pushing process.  The launcher "
+            "exports it to every child (server AND trainer roles) as "
+            "PADDLE_COLLECTOR_ENDPOINT, which takes precedence")
+define_flag("collector_interval", 5.0,
+            "seconds between telemetry pushes to the collector "
+            "(MetricsReporter push mode / collector.auto_reporter)")
+define_flag("collector_queue_capacity", 64,
+            "bound on the collector push queue: a payload enqueued "
+            "while the queue is full is DROPPED and counted "
+            "(collector_dropped_total) — the pushing process never "
+            "blocks on a slow or dead collector")
+define_flag("collector_timeout", 2.0,
+            "socket timeout (s) per collector push attempt; a timed-out "
+            "push is a drop, never a retry storm")
+define_flag("collector_straggler_ratio", 2.0,
+            "straggler flag threshold: a worker whose per-interval step "
+            "mean exceeds this multiple of the cluster median is named "
+            "a straggler in the collector's view / cluster ledger "
+            "record (and reported to ElasticAgent.note_stragglers)")
+define_flag("ps_hot_row_k", 0,
+            "bounded top-k hot-row sketch per host embedding table "
+            "(space-saving counters over pulled ids, "
+            "device_table.HotRowSketch): the PS stat op and the "
+            "collector's cluster view report the k hottest rows per "
+            "table — the telemetry a serving/online-learning row cache "
+            "needs.  0 (default) disables the sketch: it costs an "
+            "np.unique + bounded counter pass on EVERY pull, and "
+            "per-step observability work is opt-in in this repo "
+            "(FLAGS_numerics precedent); 32 is the recommended "
+            "serving-telemetry setting")
 # perf health tier (framework/health.py detectors + compile/memory
 # observability):
 define_flag("health_detectors", "",

@@ -14,7 +14,8 @@ from typing import Dict, Union
 __all__ = ["StatRegistry", "Histogram", "get_histogram", "observe",
            "all_histograms", "reset_all_histograms", "stat_add",
            "stat_sub", "stat_set", "get_stat", "reset_stat", "all_stats",
-           "reset_all_stats", "export_prometheus", "snapshot"]
+           "reset_all_stats", "describe", "export_prometheus",
+           "snapshot"]
 
 Number = Union[int, float]
 
@@ -318,24 +319,55 @@ def _prom_label_value(v: str) -> str:
             .replace("\n", "\\n"))
 
 
+# metric help texts (# HELP lines): registered by the subsystems that
+# own the metric, keyed by the RAW (pre-sanitization) name; metrics
+# nobody described get a generated placeholder so a real Prometheus
+# scraper (which expects HELP before TYPE) is always satisfied
+_help: Dict[str, str] = {}
+_help_lock = threading.Lock()
+
+
+def describe(name: str, help_text: str):
+    """Register the ``# HELP`` text for a metric (stat or histogram) —
+    one line, no newlines; later registrations win."""
+    with _help_lock:
+        _help[name] = " ".join(str(help_text).split())
+
+
+def _help_for(raw_name: str, sanitized: str) -> str:
+    with _help_lock:
+        text = _help.get(raw_name)
+    if text is None:
+        text = f"paddle_tpu metric {raw_name}"
+    # HELP text escaping per the exposition format: backslash + newline
+    return (f"# HELP {sanitized} "
+            + text.replace("\\", "\\\\").replace("\n", "\\n"))
+
+
 def export_prometheus() -> str:
     """Render every registered stat (as a gauge — ``stat_sub`` means
     values may go down) and every histogram (cumulative ``_bucket``
     series + ``_sum``/``_count``) in the Prometheus exposition text
     format, ready for a textfile collector or HTTP scrape handler.
 
-    Names are sanitized into the metric-name charset; a per-leaf stat
-    named ``base[leaf.path]`` exports as ``base{leaf="leaf.path"}`` —
-    the pytree path survives verbatim in the (escaped) label value
-    instead of being mangled into the metric name.
-    ``observability.validate_prometheus`` checks the grammar; the CI
+    Every metric gets a ``# HELP`` line before its ``# TYPE`` (text
+    from :func:`describe`, or a generated placeholder) — a real
+    Prometheus scraper expects the pair.  Names are sanitized into the
+    metric-name charset (dots and any other outsider become
+    underscores); a per-leaf stat named ``base[leaf.path]`` exports as
+    ``base{leaf="leaf.path"}`` — the pytree path survives verbatim in
+    the (escaped) label value instead of being mangled into the metric
+    name.  ``observability.validate_prometheus`` checks the grammar
+    (pass ``require_help=True`` for the full scraper contract); the CI
     observability lane round-trips this output through it."""
     lines = []
     seen = set()
     groups: Dict[str, list] = {}
+    raw_names: Dict[str, str] = {}
     for name, v in sorted(all_stats().items()):
         base, leaf = _split_leaf(name)
         n = _prom_name(base)
+        raw_names.setdefault(n, base)
         label = None if leaf is None else \
             f'leaf="{_prom_label_value(leaf)}"'
         pairs = groups.setdefault(n, [])
@@ -344,6 +376,7 @@ def export_prometheus() -> str:
         pairs.append((label, v))
     for n in sorted(groups):
         seen.add(n)
+        lines.append(_help_for(raw_names[n], n))
         lines.append(f"# TYPE {n} gauge")
         for label, v in groups[n]:
             lines.append(f"{n} {_prom_num(v)}" if label is None
@@ -356,6 +389,7 @@ def export_prometheus() -> str:
             continue
         seen.add(n)
         bounds, counts, count, total = h.buckets()
+        lines.append(_help_for(name, n))
         lines.append(f"# TYPE {n} histogram")
         cum = 0
         for b, c in zip(bounds, counts):
@@ -365,3 +399,16 @@ def export_prometheus() -> str:
         lines.append(f"{n}_sum {_prom_num(total)}")
         lines.append(f"{n}_count {count}")
     return "\n".join(lines) + "\n"
+
+
+# core train-loop metrics described where the registry lives; subsystem
+# metrics are described by their owning modules via describe()
+describe("train_step_ms", "per-step wall time (ms) histogram")
+describe("train_steps_total", "train steps completed")
+describe("input_stall_pct",
+         "share of step time spent waiting on input (gauge)")
+describe("collector_pushes_total",
+         "telemetry payloads handed to the collector push queue")
+describe("collector_dropped_total",
+         "telemetry payloads dropped (queue full, dead collector, "
+         "injected collector.rpc fault) — never blocks the pusher")

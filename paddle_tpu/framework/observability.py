@@ -351,10 +351,14 @@ class Tracer:
 tracer = Tracer()
 
 
-def span_summary(trace_dir: str) -> List[dict]:
+def span_summary(trace_dir: str, label: Optional[str] = None) -> List[dict]:
     """Per-span-name aggregates over every ``trace_*.jsonl`` file under
     ``trace_dir`` — count, total/mean/p99/max ms, error count — sorted
-    heaviest-first.  This reads the Tracer's OWN span-file format (the
+    heaviest-first.  ``label=`` restricts the summary to ONE process's
+    span file (``trace_<label>.jsonl``) — the single-process view of a
+    shared trace dir (the cluster collector's push path keeps its own
+    incremental reader, ``collector._own_span_rows``, for the same
+    file).  This reads the Tracer's OWN span-file format (the
     module that writes it owns the reader), so in-framework consumers
     (the run ledger's RunRecord capture) need no dependency on
     ``tools/trace_merge.py``; that tool renders the same shape from a
@@ -365,8 +369,8 @@ def span_summary(trace_dir: str) -> List[dict]:
 
     durs: Dict[str, List[float]] = {}
     errors: Dict[str, int] = {}
-    for path in sorted(glob.glob(os.path.join(trace_dir,
-                                              "trace_*.jsonl"))):
+    pattern = "trace_*.jsonl" if label is None else f"trace_{label}.jsonl"
+    for path in sorted(glob.glob(os.path.join(trace_dir, pattern))):
         try:
             with open(path, encoding="utf-8", errors="replace") as f:
                 lines = f.readlines()
@@ -429,6 +433,13 @@ class FlightRecorder:
         # per-kind lifetime totals (NOT ring-bounded): the run ledger's
         # "flight events by kind" capture must survive ring eviction
         self._kind_totals: Dict[str, int] = {}
+        # per-process monotonic event id: multi-process flight dumps
+        # merge in a stable order under clock skew (within one process
+        # seq order IS record order, whatever the wall clock says).
+        # Monotonic for the recorder's lifetime — clear() resets the
+        # ring, not the sequence, so a post-clear event still sorts
+        # after everything the collector already merged
+        self._seq = 0
 
     def _buf(self) -> "collections.deque":
         if self._ring is None:
@@ -446,9 +457,27 @@ class FlightRecorder:
             buf = self._buf()
             if len(buf) == buf.maxlen:
                 self.dropped += 1
+            self._seq += 1
+            ev["seq"] = self._seq
             buf.append(ev)
             self._kind_totals[kind] = self._kind_totals.get(kind, 0) + 1
         return ev
+
+    def last_seq(self) -> int:
+        """The newest event's per-process seq id (0 = nothing recorded)
+        — what a telemetry pusher remembers to ship only the delta."""
+        with self._lock:
+            return self._seq
+
+    def since(self, seq: int, limit: int = 256) -> List[dict]:
+        """Events with ``seq`` strictly greater than the given one,
+        oldest first, capped at ``limit`` (a pusher that fell far behind
+        ships the newest window rather than an unbounded backlog).
+        Events already evicted from the ring are simply gone — the
+        lifetime ``kind_totals`` still count them."""
+        with self._lock:
+            buf = [ev for ev in self._buf() if ev.get("seq", 0) > seq]
+        return buf[-int(limit):]
 
     def kind_totals(self) -> Dict[str, int]:
         """Lifetime event counts by kind (unbounded, unlike the ring) —
@@ -582,24 +611,74 @@ class MetricsReporter:
     ``path`` every ``interval`` seconds (``FLAGS_metrics_export_interval``
     default), atomically via tmp+rename — a scraper or node exporter
     textfile collector never sees a torn file.  ``write_once()`` is the
-    synchronous form (tests, final flush)."""
+    synchronous form (tests, final flush).
 
-    def __init__(self, path: str, interval: Optional[float] = None):
+    **Push mode** (``collector=``): additionally (or, with
+    ``path=None``, exclusively) ship each interval's telemetry to the
+    central cluster collector (``framework/collector.py``) —
+    ``monitor.snapshot()`` deltas, span summaries, and flight-event
+    deltas, stamped with a per-process monotonic push seq.  Pushes are
+    fire-and-forget through a bounded queue with a drop counter and the
+    ``collector.rpc`` chaos point: a slow, dead, or fault-injected
+    collector can never slow or crash the process being observed.
+    ``collector`` is a ``host:port`` string or a prebuilt
+    ``collector.CollectorClient``; ``role``/``worker`` label the pushed
+    payloads (defaulting to the launcher's ``PADDLE_ROLE`` /
+    ``PADDLE_TRACE_LABEL`` env)."""
+
+    def __init__(self, path: Optional[str], interval: Optional[float] = None,
+                 collector=None, worker: Optional[str] = None,
+                 role: Optional[str] = None, payload_extra=None):
+        if path is None and collector is None:
+            raise ValueError("MetricsReporter needs a path, a collector "
+                             "endpoint, or both")
         self.path = path
         self.interval = float(flag("metrics_export_interval")) \
             if interval is None else float(interval)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.writes = 0
+        self.pushes = 0
+        self._collector = None
+        self._payload_extra = payload_extra
+        if collector is not None:
+            from paddle_tpu.framework import collector as _collector_mod
+            if isinstance(collector, str):
+                self._collector = _collector_mod.CollectorClient(
+                    collector, worker=worker, role=role)
+            else:
+                self._collector = collector
+
+    @property
+    def collector(self):
+        """The push-mode CollectorClient (None in file-only mode)."""
+        return self._collector
 
     def write_once(self) -> str:
-        from paddle_tpu.distributed.fleet.utils.fs import LocalFS
-        text = monitor.export_prometheus()
-        d = os.path.dirname(self.path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        LocalFS().atomic_write(self.path, text)
-        self.writes += 1
+        text = ""
+        if self.path is not None:
+            # render only when there is a file to write: a push-only
+            # reporter ships monitor.snapshot()-based payloads, and
+            # serializing the whole exposition text to discard it
+            # would tax every pushing process each interval
+            text = monitor.export_prometheus()
+            from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            LocalFS().atomic_write(self.path, text)
+            self.writes += 1
+        if self._collector is not None:
+            from paddle_tpu.framework import collector as _collector_mod
+            extra = None
+            if self._payload_extra is not None:
+                try:
+                    extra = self._payload_extra()
+                except Exception:  # noqa: BLE001 — telemetry never crashes
+                    extra = None
+            self._collector.push(_collector_mod.local_payload(
+                since_seq=self._collector.flight_seq_sent, extra=extra))
+            self.pushes += 1
         return text
 
     def _loop(self):
@@ -626,6 +705,8 @@ class MetricsReporter:
                 self.write_once()
             except OSError:
                 pass
+        if self._collector is not None:
+            self._collector.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -647,15 +728,22 @@ _PROM_SAMPLE_RE = _re.compile(
 _PROM_LE_RE = _re.compile(r'le="([^"]+)"')
 
 
-def validate_prometheus(text: str) -> int:
+def validate_prometheus(text: str, require_help: bool = False) -> int:
     """Validate ``text`` against the Prometheus exposition text-format
     grammar (comment/sample line shapes) plus histogram invariants:
     cumulative non-decreasing buckets, a ``+Inf`` bucket equal to
     ``_count``, and ``_sum``/``_count`` present for every histogram.
-    Returns the number of sample lines; raises ``ValueError`` on the
-    first violation."""
+    A ``# HELP`` may appear at most once per metric and must precede
+    that metric's samples; ``require_help=True`` additionally demands a
+    HELP line for every ``# TYPE``-declared metric — the full contract
+    a real Prometheus scraper expects of ``export_prometheus()``
+    output.  Returns the number of sample lines; raises ``ValueError``
+    on the first violation."""
     samples = 0
     hist_names: List[str] = []
+    type_names: List[str] = []
+    help_names: set = set()
+    sampled_names: set = set()
     values: Dict[str, float] = {}
     buckets: Dict[str, List[tuple]] = {}
     for i, line in enumerate(text.splitlines(), 1):
@@ -664,14 +752,31 @@ def validate_prometheus(text: str) -> int:
         if line.startswith("#"):
             if not _PROM_COMMENT_RE.match(line):
                 raise ValueError(f"line {i}: malformed comment: {line!r}")
-            if line.startswith("# TYPE ") and line.endswith(" histogram"):
-                hist_names.append(line.split()[2])
+            if line.startswith("# TYPE "):
+                type_names.append(line.split()[2])
+                if line.endswith(" histogram"):
+                    hist_names.append(line.split()[2])
+            elif line.startswith("# HELP "):
+                h = line.split()[2]
+                if h in help_names:
+                    raise ValueError(f"line {i}: duplicate HELP for {h}")
+                if h in sampled_names:
+                    raise ValueError(
+                        f"line {i}: HELP for {h} after its samples")
+                help_names.add(h)
             continue
         m = _PROM_SAMPLE_RE.match(line)
         if not m:
             raise ValueError(f"line {i}: malformed sample: {line!r}")
         samples += 1
         name = m.group(1)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[:-len(suffix)]
+                break
+        sampled_names.add(name)
+        sampled_names.add(base)
         rest = line.split("} ", 1)[1] if "} " in line \
             else line.split(" ", 1)[1]
         val = float(rest.split(" ")[0])
@@ -700,4 +805,10 @@ def validate_prometheus(text: str) -> int:
         if counts[-1] != values[h + "_count"]:
             raise ValueError(f"histogram {h}: +Inf bucket "
                              f"{counts[-1]} != _count {values[h + '_count']}")
+    if require_help:
+        missing = [n for n in type_names if n not in help_names]
+        if missing:
+            raise ValueError(
+                f"metrics declared without a # HELP line: {missing[:5]}"
+                + ("..." if len(missing) > 5 else ""))
     return samples

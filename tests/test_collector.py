@@ -1,0 +1,899 @@
+"""Cluster telemetry plane (framework/collector.py + tools/cluster_top.py):
+central collector on the PS RPC framing, fire-and-forget push path with
+bounded queue + drop counter + the ``collector.rpc`` chaos point,
+cross-worker straggler detection, PS hot-row/table-skew telemetry, the
+cluster-level run-ledger record, and the flight-recorder per-process
+seq ids the collector merge relies on."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework.collector import (CollectorClient,
+                                            CollectorServer,
+                                            collector_endpoint,
+                                            local_payload,
+                                            merge_flight_events, request)
+from paddle_tpu.framework.flags import get_flags, set_flags
+from paddle_tpu.framework.observability import (FlightRecorder,
+                                                MetricsReporter, flight,
+                                                validate_prometheus)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+from tools import cluster_top  # noqa: E402
+
+
+def _dead_endpoint() -> str:
+    """A localhost port with nothing listening."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def _wait(cond, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _step_payload(state, ms):
+    """One worker-report payload: cumulative train_step_ms (count, sum)
+    the collector diffs — per-worker series without sharing the
+    process-global monitor registry across simulated workers."""
+    state["count"] += 1
+    state["sum"] += ms
+    return {"stats": dict(state.get("stats") or {}),
+            "hists": {"train_step_ms": {"count": state["count"],
+                                        "sum": state["sum"],
+                                        "p50": ms, "p99": ms,
+                                        "mean": ms, "max": ms}}}
+
+
+class TestHotRowSketch:
+    def test_exact_topk_small_stream(self):
+        from paddle_tpu.distributed.ps.device_table import HotRowSketch
+        sk = HotRowSketch(k=4)
+        sk.update(np.array([7, 7, 7, 3, 3, 5, 1, 7]))
+        top = sk.top()
+        assert top[0] == (7, 4) and top[1] == (3, 2)
+        assert sk.total == 8
+
+    def test_capacity_bounded_and_heavy_hitters_survive(self):
+        from paddle_tpu.distributed.ps.device_table import HotRowSketch
+        sk = HotRowSketch(k=4, capacity=16)
+        rng = np.random.default_rng(0)
+        # a heavy hitter (id 999) mixed into a wide uniform stream
+        for _ in range(50):
+            batch = rng.integers(0, 10000, size=32)
+            batch[:8] = 999
+            sk.update(batch)
+        assert len(sk._counts) <= 16
+        assert sk.top()[0][0] == 999   # space-saving retention guarantee
+
+    def test_merge_and_reset(self):
+        from paddle_tpu.distributed.ps.device_table import HotRowSketch
+        a = HotRowSketch(k=4)
+        a.update(np.array([1, 1, 2]))
+        b = HotRowSketch(k=4)
+        b.merge(a.top())
+        b.update(np.array([2, 2]))
+        assert dict(b.top()) == {1: 2, 2: 3}
+        b.reset()
+        assert b.top() == []
+
+    def test_deterministic_tie_order(self):
+        from paddle_tpu.distributed.ps.device_table import HotRowSketch
+        sk = HotRowSketch(k=4)
+        sk.update(np.array([9, 2, 5]))
+        assert sk.top() == [(2, 1), (5, 1), (9, 1)]  # ties: id order
+
+    def test_host_table_feeds_sketch_when_armed_default_off(self):
+        from paddle_tpu.distributed.ps import HostEmbeddingTable
+        # default is OFF (per-pull cost is opt-in observability)
+        t0 = HostEmbeddingTable(32, 4, optimizer="sgd", seed=0)
+        assert t0.hot_rows is None
+        t0.pull(np.array([1]))              # no sketch, no crash
+        saved = get_flags("ps_hot_row_k")
+        set_flags({"ps_hot_row_k": 32})
+        try:
+            t = HostEmbeddingTable(32, 4, optimizer="sgd", seed=0)
+            t.pull(np.array([3, 3, 7]))
+            assert dict(t.hot_rows.top())[3] == 2
+        finally:
+            set_flags(saved)
+
+    def test_hash_table_feeds_sketch(self):
+        from paddle_tpu.distributed.ps import HashEmbeddingTable
+        saved = get_flags("ps_hot_row_k")
+        set_flags({"ps_hot_row_k": 32})
+        try:
+            t = HashEmbeddingTable(4, optimizer="sgd")
+            t.pull(np.array([11, 11, 13]))
+            assert dict(t.hot_rows.top())[11] == 2
+        finally:
+            set_flags(saved)
+
+
+class TestFlightSeq:
+    def test_seq_monotonic_and_since(self):
+        fr = FlightRecorder(capacity=8)
+        e1 = fr.record("a.one")
+        e2 = fr.record("a.two")
+        assert e2["seq"] == e1["seq"] + 1
+        assert [e["kind"] for e in fr.since(e1["seq"])] == ["a.two"]
+        assert fr.last_seq() == e2["seq"]
+
+    def test_seq_survives_clear(self):
+        """The per-process counter never rewinds: a post-clear event
+        still sorts after everything a collector already merged."""
+        fr = FlightRecorder(capacity=8)
+        fr.record("a")
+        high = fr.last_seq()
+        fr.clear()
+        assert fr.record("b")["seq"] == high + 1
+
+    def test_since_caps_backlog(self):
+        fr = FlightRecorder(capacity=512)
+        for i in range(50):
+            fr.record("k", i=i)
+        got = fr.since(0, limit=10)
+        assert len(got) == 10
+        assert got[-1]["attrs"]["i"] == 49   # newest window, not oldest
+
+    def test_merge_stable_under_clock_skew(self):
+        """Within one worker, order follows seq even when the wall
+        clock ran backwards; cross-worker interleave is deterministic."""
+        merged = merge_flight_events({
+            "w1": [{"ts": 100.0, "seq": 1, "kind": "a"},
+                   {"ts": 99.0, "seq": 2, "kind": "b"}],   # clock skew
+            "w0": [{"ts": 99.5, "seq": 1, "kind": "c"}],
+        })
+        assert [(e["worker"], e["kind"]) for e in merged] == \
+            [("w0", "c"), ("w1", "a"), ("w1", "b")]
+        # input arrival order must not matter
+        merged2 = merge_flight_events({
+            "w0": [{"ts": 99.5, "seq": 1, "kind": "c"}],
+            "w1": [{"ts": 99.0, "seq": 2, "kind": "b"},
+                   {"ts": 100.0, "seq": 1, "kind": "a"}],
+        })
+        assert merged == merged2
+
+    def test_process_flight_carries_seq(self):
+        ev = flight.record("collector.test_seq")
+        assert isinstance(ev["seq"], int) and ev["seq"] > 0
+
+
+class TestPrometheusHelp:
+    def test_export_has_help_per_metric(self):
+        monitor.stat_add("help_check_total", 1)
+        monitor.observe("help_check_ms", 2.0)
+        text = monitor.export_prometheus()
+        n = validate_prometheus(text, require_help=True)
+        assert n > 0
+        assert "# HELP help_check_total " in text
+        assert "# HELP help_check_ms " in text
+        i_help = text.index("# HELP help_check_ms")
+        i_type = text.index("# TYPE help_check_ms")
+        assert i_help < i_type
+
+    def test_describe_text_used_and_sanitized_name(self):
+        monitor.describe("dotted.name.total", "my  described\nmetric")
+        monitor.stat_add("dotted.name.total", 1)
+        text = monitor.export_prometheus()
+        validate_prometheus(text, require_help=True)
+        # dots sanitized to underscores in name AND its HELP line
+        assert "# HELP dotted_name_total my described metric" in text
+        assert "dotted_name_total 1" in text
+
+    def test_require_help_rejects_missing(self):
+        with pytest.raises(ValueError, match="HELP"):
+            validate_prometheus("# TYPE x gauge\nx 1\n",
+                                require_help=True)
+        # without the flag the old contract stands
+        assert validate_prometheus("# TYPE x gauge\nx 1\n") == 1
+
+    def test_duplicate_and_late_help_rejected(self):
+        with pytest.raises(ValueError, match="duplicate HELP"):
+            validate_prometheus("# HELP x a\n# HELP x b\n"
+                                "# TYPE x gauge\nx 1\n")
+        with pytest.raises(ValueError, match="after its samples"):
+            validate_prometheus("# TYPE x gauge\nx 1\n# HELP x a\n")
+
+
+class TestCollectorClient:
+    def test_roundtrip_and_view(self):
+        srv = CollectorServer().start()
+        try:
+            cli = CollectorClient(srv.endpoint, worker="rt", role="trainer",
+                                  timeout=1.0)
+            st = {"count": 0, "sum": 0.0}
+            assert cli.push(_step_payload(st, 2.0))
+            assert _wait(lambda: cli.sent == 1)
+            view = srv.view()
+            assert view["workers"]["rt"]["role"] == "trainer"
+            assert view["workers"]["rt"]["steps_total"] == 1
+            cli.stop()
+        finally:
+            srv.shutdown()
+
+    def test_dead_collector_drops_never_blocks(self):
+        """The drop-counter-not-deadlock contract: 100 pushes at a dead
+        endpoint return immediately; every payload is dropped and
+        counted; stop() is bounded."""
+        cli = CollectorClient(_dead_endpoint(), worker="dead",
+                              capacity=4, timeout=0.2)
+        t0 = time.perf_counter()
+        for _ in range(100):
+            cli.push({"stats": {}})
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.5, f"push blocked for {elapsed:.3f}s"
+        assert _wait(lambda: cli.dropped + cli.sent >= 100
+                     and cli._q.empty(), timeout=10)
+        assert cli.sent == 0 and cli.dropped == 100
+        t0 = time.perf_counter()
+        cli.stop()
+        assert time.perf_counter() - t0 < 3.0
+
+    def test_chaos_error_deterministic(self):
+        """collector.rpc mode='error' every=2: exactly half the pushes
+        drop, deterministically, and the drop counter says so."""
+        srv = CollectorServer().start()
+        chaos.reset()
+        chaos.arm("collector.rpc", mode="error", every=2)
+        try:
+            cli = CollectorClient(srv.endpoint, worker="ch", timeout=1.0)
+            for _ in range(10):
+                cli.push({"stats": {}})
+            assert _wait(lambda: cli.sent + cli.dropped == 10)
+            assert (cli.sent, cli.dropped) == (5, 5)
+            assert srv.view()["workers"]["ch"]["reports"] == 5
+            # server-side gap accounting sees the client's losses
+            # without any ack protocol
+            assert srv.view()["workers"]["ch"]["gaps"] == 4
+            cli.stop()
+        finally:
+            chaos.disarm("collector.rpc")
+            srv.shutdown()
+
+    def test_chaos_latency_never_blocks_caller(self):
+        srv = CollectorServer().start()
+        chaos.reset()
+        chaos.arm("collector.rpc", mode="latency", latency=0.3, every=1)
+        try:
+            cli = CollectorClient(srv.endpoint, worker="lat", timeout=1.0)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                cli.push({"stats": {}})
+            assert time.perf_counter() - t0 < 0.1  # sender absorbs it
+            assert _wait(lambda: cli.sent == 3, timeout=5)
+            cli.stop()
+        finally:
+            chaos.disarm("collector.rpc")
+            srv.shutdown()
+
+    def test_queue_overflow_counts_drops(self):
+        cli = CollectorClient(_dead_endpoint(), worker="of",
+                              capacity=2, timeout=0.2)
+        before = monitor.get_stat("collector_dropped_total")
+        for _ in range(20):
+            cli.push({"stats": {}})
+        assert cli.dropped >= 17      # capacity 2 + one possibly inflight
+        assert monitor.get_stat("collector_dropped_total") - before == \
+            cli.dropped
+        cli.stop()
+
+    def test_span_summary_label_filters_one_process(self, tmp_path):
+        from paddle_tpu.framework.observability import (Tracer,
+                                                        span_summary)
+        tdir = str(tmp_path / "traces")
+        for label, name in (("w0", "a.span"), ("w1", "b.span")):
+            tr = Tracer(tdir, label=label)
+            tr.start_span(name, detached=True).end()
+        all_rows = {r["name"] for r in span_summary(tdir)}
+        assert all_rows == {"a.span", "b.span"}
+        only = span_summary(tdir, label="w0")
+        assert [r["name"] for r in only] == ["a.span"]
+
+    def test_sketch_counts_path_dedupes(self):
+        """A repeated id in an explicit-counts batch (concatenated
+        cross-source top-k) must accumulate, not overwrite its own
+        eviction slot."""
+        from paddle_tpu.distributed.ps.device_table import HotRowSketch
+        sk = HotRowSketch(k=2, capacity=4)
+        sk.update(np.arange(4))                       # fill capacity
+        sk.update(np.array([100, 100]), counts=np.array([5, 5]))
+        assert dict(sk.top())[100] == 11              # floor 1 + 5 + 5
+        assert len(sk._counts) == 4                   # no leaked slot
+
+    def test_watch_honors_fail_on_straggler(self):
+        srv = CollectorServer(straggler_ratio=2.0, window=4).start()
+        try:
+            states = {w: {"count": 0, "sum": 0.0} for w in ("w0", "w1")}
+            for i in range(5):
+                for name, ms in (("w0", 2.0), ("w1", 40.0)):
+                    srv._handle_report({
+                        "worker": name, "role": "trainer", "seq": i + 1,
+                        "payload": _step_payload(states[name], ms)})
+            rc = cluster_top.main(["--collector", srv.endpoint,
+                                   "--watch", "0.1",
+                                   "--fail-on-straggler"])
+            assert rc == 1        # the watch loop must exit, not spin
+        finally:
+            srv.shutdown()
+
+    def test_local_payload_shape_and_flight_delta(self):
+        mark = flight.last_seq()
+        flight.record("collector.payload_probe")
+        p = local_payload(since_seq=mark)
+        assert "stats" in p and "hists" in p
+        assert p["flight_last_seq"] >= mark + 1
+        kinds = [e["kind"] for e in p["flight"]]
+        assert "collector.payload_probe" in kinds
+        p2 = local_payload(since_seq=p["flight_last_seq"])
+        assert all(e["seq"] > p["flight_last_seq"] for e in p2["flight"])
+
+
+class TestCollectorServer:
+    def test_straggler_flagged_clean_rank_quiet(self):
+        """The acceptance shape: 2 workers, one with injected per-step
+        latency; that rank's straggler score must rise within K steps
+        while the clean rank stays quiet."""
+        srv = CollectorServer(straggler_ratio=2.0, window=4)
+        # drive _handle_report directly (deterministic, no sockets)
+        states = {"w0": {"count": 0, "sum": 0.0},
+                  "w1": {"count": 0, "sum": 0.0}}
+        K = 6
+        for i in range(K):
+            for name, ms in (("w0", 2.0), ("w1", 40.0)):
+                srv._handle_report({
+                    "worker": name, "role": "trainer", "seq": i + 1,
+                    "payload": _step_payload(states[name], ms)})
+        rep = srv.straggler_report()
+        assert rep["stragglers"] == ["w1"]
+        assert rep["scores"]["w1"] >= 2.0
+        assert rep["scores"]["w0"] < 2.0
+        view = srv.view()
+        assert view["workers"]["w1"]["straggler"] is True
+        assert view["workers"]["w0"]["straggler"] is False
+        srv.shutdown()
+
+    def test_leave_one_out_median_three_workers(self):
+        srv = CollectorServer(straggler_ratio=2.0, window=4)
+        states = {w: {"count": 0, "sum": 0.0} for w in
+                  ("w0", "w1", "w2")}
+        for i in range(5):
+            for name, ms in (("w0", 10.0), ("w1", 10.0), ("w2", 50.0)):
+                srv._handle_report({
+                    "worker": name, "role": "trainer", "seq": i + 1,
+                    "payload": _step_payload(states[name], ms)})
+        rep = srv.straggler_report()
+        assert rep["stragglers"] == ["w2"]
+        # clean peers score ~1.0 against each other, not against a
+        # median dragged up by the straggler
+        assert rep["scores"]["w0"] == pytest.approx(1.0, rel=0.05)
+        srv.shutdown()
+
+    def test_on_straggler_hook_and_elastic_agent(self):
+        from paddle_tpu.distributed.elastic import DictStore, ElasticAgent
+        agent = ElasticAgent(DictStore(), [])
+        srv = CollectorServer(
+            straggler_ratio=2.0, window=4,
+            on_straggler=lambda scores, flagged:
+                agent.note_stragglers(scores, flagged))
+        states = {"w0": {"count": 0, "sum": 0.0},
+                  "w1": {"count": 0, "sum": 0.0}}
+        for i in range(5):
+            for name, ms in (("w0", 2.0), ("w1", 40.0)):
+                srv._handle_report({
+                    "worker": name, "role": "trainer", "seq": i + 1,
+                    "payload": _step_payload(states[name], ms)})
+        assert agent.stragglers() == ["w1"]
+        assert agent.straggler_scores["w1"] >= 2.0
+        evs = flight.recent(50, kind="elastic.straggler")
+        assert any(e["attrs"].get("worker") == "w1" for e in evs)
+        srv.shutdown()
+
+    def test_mid_run_slowdown_trips_detector(self):
+        """A rank *becoming* slow (latency injected mid-run) trips the
+        per-worker cross-run Detector even before the ratio flag."""
+        srv = CollectorServer(straggler_ratio=1e9, window=32)  # ratio off
+        st = {"count": 0, "sum": 0.0}
+        for i in range(8):
+            srv._handle_report({"worker": "w", "role": "trainer",
+                                "seq": i + 1,
+                                "payload": _step_payload(st, 2.0)})
+        for i in range(3):
+            srv._handle_report({"worker": "w", "role": "trainer",
+                                "seq": 9 + i,
+                                "payload": _step_payload(st, 200.0)})
+        assert srv.view()["workers"]["w"]["detector_anomalies"] >= 1
+        srv.shutdown()
+
+    def test_restarted_worker_reports_immediately(self):
+        """An elastic-restarted worker reuses its name but rewinds its
+        push seq and cumulative counters; the per-incarnation ident
+        must reset the collector's cursors instead of reading the new
+        stream as stale until it overtakes the dead one."""
+        srv = CollectorServer(window=8)
+        st = {"count": 0, "sum": 0.0}
+        for i in range(5):
+            srv._handle_report({"worker": "w", "role": "trainer",
+                                "ident": "w~aaaa", "seq": i + 1,
+                                "payload": _step_payload(st, 2.0)})
+        # restart: fresh ident, seq back to 1, counters rewound
+        st2 = {"count": 0, "sum": 0.0}
+        reply = srv._handle_report({"worker": "w", "role": "trainer",
+                                    "ident": "w~bbbb", "seq": 1,
+                                    "payload": _step_payload(st2, 3.0)})
+        assert not reply.get("stale")
+        row = srv.view()["workers"]["w"]
+        assert row["reports"] == 6 and row["incarnations"] == 2
+        assert row["steps_total"] == 1          # the NEW stream's hist
+        # interval means kept flowing across the restart
+        assert row["step_interval_mean_ms"] is not None
+        srv.shutdown()
+
+    def test_expired_worker_leaves_peer_set(self):
+        """A worker silent past worker_ttl must drop out of the
+        leave-one-out median (its frozen mean would deflate a new
+        straggler's score) and lose any straggler flag."""
+        t = [0.0]
+        srv = CollectorServer(straggler_ratio=2.0, window=4,
+                              worker_ttl=10.0, clock=lambda: t[0])
+        states = {w: {"count": 0, "sum": 0.0}
+                  for w in ("w0", "w1", "slow")}
+        for i in range(5):
+            for name, ms in (("w0", 10.0), ("w1", 10.0), ("slow", 60.0)):
+                srv._handle_report({
+                    "worker": name, "role": "trainer", "seq": i + 1,
+                    "payload": _step_payload(states[name], ms)})
+        assert srv.straggler_report()["stragglers"] == ["slow"]
+        # 'slow' crashes; 30s later a NEW straggler emerges among the
+        # survivors — its score must be judged against live peers only
+        t[0] = 30.0
+        for i in range(5):
+            for name, ms in (("w0", 10.0), ("w1", 35.0)):
+                srv._handle_report({
+                    "worker": name, "role": "trainer", "seq": 6 + i,
+                    "payload": _step_payload(states[name], ms)})
+        rep = srv.straggler_report()
+        assert "w1" in rep["stragglers"]
+        assert "slow" not in rep["stragglers"]   # expired: flag cleared
+        view = srv.view()
+        assert view["workers"]["slow"]["expired"] is True
+        assert view["workers"]["w0"]["expired"] is False
+        srv.shutdown()
+
+    def test_silent_cluster_unflags_expired_straggler(self):
+        """Expiry is re-checked at READ time: a flagged straggler that
+        died along with every other reporter must not stay flagged in a
+        view or capture taken after worker_ttl."""
+        t = [0.0]
+        srv = CollectorServer(straggler_ratio=2.0, window=4,
+                              worker_ttl=10.0, clock=lambda: t[0])
+        states = {w: {"count": 0, "sum": 0.0} for w in ("w0", "w1")}
+        for i in range(5):
+            for name, ms in (("w0", 2.0), ("w1", 40.0)):
+                srv._handle_report({
+                    "worker": name, "role": "trainer", "seq": i + 1,
+                    "payload": _step_payload(states[name], ms)})
+        assert srv.straggler_report()["stragglers"] == ["w1"]
+        t[0] = 60.0                 # everyone silent past the ttl
+        assert srv.straggler_report()["stragglers"] == []
+        view = srv.view()
+        assert view["stragglers"] == []
+        assert view["workers"]["w1"]["straggler"] is False
+        rec, _ = srv.capture_record()
+        assert rec["summary"]["cluster_straggler_count"] == 0
+        srv.shutdown()
+
+    def test_flight_merge_keeps_incarnations_separate(self):
+        """A restarted worker's rewound flight seq stream must not
+        interleave into its dead predecessor's events."""
+        srv = CollectorServer()
+        old = [{"ts": 10.0 + i, "seq": i + 1, "kind": f"old{i}",
+                "severity": "info", "attrs": {}} for i in range(3)]
+        srv._handle_report({"worker": "w", "ident": "w~a", "seq": 1,
+                            "payload": {"flight": old}})
+        new = [{"ts": 20.0 + i, "seq": i + 1, "kind": f"new{i}",
+                "severity": "info", "attrs": {}} for i in range(2)]
+        srv._handle_report({"worker": "w", "ident": "w~b", "seq": 1,
+                            "payload": {"flight": new}})
+        kinds = [e["kind"] for e in srv.view()["flight"]]
+        assert kinds == ["old0", "old1", "old2", "new0", "new1"]
+        srv.shutdown()
+
+    def test_stale_and_gap_seq_accounting(self):
+        srv = CollectorServer()
+        st = {"count": 0, "sum": 0.0}
+        srv._handle_report({"worker": "w", "seq": 1,
+                            "payload": _step_payload(st, 1.0)})
+        srv._handle_report({"worker": "w", "seq": 5,
+                            "payload": _step_payload(st, 1.0)})
+        reply = srv._handle_report({"worker": "w", "seq": 3,
+                                    "payload": {}})
+        assert reply.get("stale")
+        row = srv.view()["workers"]["w"]
+        assert row["gaps"] == 3 and row["reports"] == 2
+        srv.shutdown()
+
+    def test_table_aggregation_no_double_count(self):
+        """Shards push CUMULATIVE table counters every interval; the
+        collector keeps the latest per shard — re-reports must not
+        inflate the totals."""
+        srv = CollectorServer()
+        for rep in range(3):
+            srv._handle_report({
+                "worker": "server-0", "role": "server", "seq": rep + 1,
+                "payload": {"tables": {"emb": {
+                    "pulls": 10 * (rep + 1),
+                    "rows_pulled": 80 * (rep + 1),
+                    "hot_rows": [[7, 5 * (rep + 1)], [3, 2]]}}}})
+        srv._handle_report({
+            "worker": "server-1", "role": "server", "seq": 1,
+            "payload": {"tables": {"emb": {
+                "pulls": 10, "rows_pulled": 80,
+                "hot_rows": [[11, 9]]}}}})
+        t = srv.view()["tables"]["emb"]
+        assert t["pulls"] == 40            # 30 (latest) + 10, not 60+10
+        assert t["by_shard"]["server-0"]["pulls"] == 30
+        assert tuple(t["hot_rows"][0]) == (7, 15)   # hottest first
+        hot = {int(r[0]): int(r[1]) for r in t["hot_rows"]}
+        assert hot == {7: 15, 3: 2, 11: 9}
+        assert t["shard_skew"] == pytest.approx(1.5)
+        srv.shutdown()
+
+    def test_view_schema_and_render(self):
+        srv = CollectorServer()
+        st = {"count": 0, "sum": 0.0,
+              "stats": {"input_stall_pct": 3.0,
+                        "health_anomalies_total": 2}}
+        srv._handle_report({"worker": "w0", "role": "trainer", "seq": 1,
+                            "payload": _step_payload(st, 5.0)})
+        view = srv.view()
+        assert cluster_top.validate_view(view) == 1
+        text = cluster_top.render(view)
+        assert "w0" in text and "trainer" in text
+        srv.shutdown()
+
+    def test_validate_view_rejects_bad(self):
+        with pytest.raises(ValueError):
+            cluster_top.validate_view({"workers": {}})
+        with pytest.raises(ValueError):
+            cluster_top.validate_view(
+                {"schema_version": 1, "ts": 0, "workers": {},
+                 "tables": {}, "stragglers": ["ghost"]})
+
+    def test_capture_record_ledger_and_compare_series(self, tmp_path):
+        ledger = str(tmp_path / "ledger.jsonl")
+        srv = CollectorServer(straggler_ratio=2.0, window=4,
+                              ledger_path=ledger)
+        states = {"w0": {"count": 0, "sum": 0.0},
+                  "w1": {"count": 0, "sum": 0.0}}
+        for i in range(5):
+            for name, ms in (("w0", 2.0), ("w1", 40.0)):
+                srv._handle_report({
+                    "worker": name, "role": "trainer", "seq": i + 1,
+                    "payload": _step_payload(states[name], ms)})
+        rec, committed = srv.capture_record(label="t")
+        assert committed
+        assert rec["kind"] == "cluster"
+        assert rec["cluster"]["stragglers"] == ["w1"]
+        assert rec["summary"]["cluster_straggler_count"] == 1
+        assert rec["summary"]["cluster_step_skew"] >= 2.0
+        assert rec["summary"]["cluster_step_p99_ms_max"] == 40.0
+        from paddle_tpu.framework import runlog
+        stored = runlog.RunLedger(ledger).records(kind="cluster")
+        assert len(stored) == 1
+        from tools import perf_report
+        series = perf_report.build_series(stored * 3)
+        assert "cluster_step_skew" in series
+        assert "cluster_straggler_count" in series
+        verdict = perf_report.compare_records(stored * 3)
+        assert isinstance(verdict["regressions"], list)  # ran to verdict
+        srv.shutdown()
+
+    def test_flight_merge_dedups_overlap(self):
+        """A re-shipped flight overlap (the pusher only advances its
+        cursor on success) lands exactly once, keyed on per-event seq."""
+        srv = CollectorServer()
+        evs = [{"ts": 1.0, "seq": 1, "kind": "a", "severity": "info",
+                "attrs": {}},
+               {"ts": 2.0, "seq": 2, "kind": "b", "severity": "info",
+                "attrs": {}}]
+        srv._handle_report({"worker": "w", "seq": 1,
+                            "payload": {"flight": evs}})
+        srv._handle_report({"worker": "w", "seq": 2,
+                            "payload": {"flight": evs + [
+                                {"ts": 3.0, "seq": 3, "kind": "c",
+                                 "severity": "info", "attrs": {}}]}})
+        kinds = [e["kind"] for e in srv.view()["flight"]]
+        assert kinds == ["a", "b", "c"]
+        srv.shutdown()
+
+    def test_rpc_ops_hello_view_capture_unknown(self, tmp_path):
+        srv = CollectorServer(
+            ledger_path=str(tmp_path / "l.jsonl")).start()
+        try:
+            hello = request(srv.endpoint, {"op": "hello"}, timeout=1.0)
+            assert hello["ok"] and hello["service"] == "collector"
+            view = request(srv.endpoint, {"op": "view"},
+                           timeout=1.0)["view"]
+            assert view["schema_version"] == 1
+            cap = request(srv.endpoint, {"op": "capture"}, timeout=1.0)
+            assert cap["ok"] and cap["committed"]
+            bad = request(srv.endpoint, {"op": "nope"}, timeout=1.0)
+            assert not bad["ok"]
+        finally:
+            srv.shutdown()
+
+
+class TestMetricsReporterPush:
+    def test_push_only_reporter(self):
+        srv = CollectorServer().start()
+        try:
+            rep = MetricsReporter(None, interval=30.0,
+                                  collector=srv.endpoint, worker="mr",
+                                  role="trainer")
+            rep.write_once()
+            assert rep.pushes == 1 and rep.writes == 0
+            assert _wait(lambda: "mr" in srv.view()["workers"])
+            rep.stop(final_write=False)
+        finally:
+            srv.shutdown()
+
+    def test_file_and_push_combined(self, tmp_path):
+        srv = CollectorServer().start()
+        try:
+            path = str(tmp_path / "m.prom")
+            monitor.stat_add("push_combined_check", 1)
+            rep = MetricsReporter(path, interval=30.0,
+                                  collector=srv.endpoint, worker="fc")
+            rep.write_once()
+            assert os.path.exists(path)
+            validate_prometheus(open(path).read(), require_help=True)
+            assert _wait(lambda: "fc" in srv.view()["workers"])
+            row = srv.view()["workers"]["fc"]
+            assert row["reports"] >= 1
+            rep.stop(final_write=False)
+        finally:
+            srv.shutdown()
+
+    def test_needs_path_or_collector(self):
+        with pytest.raises(ValueError):
+            MetricsReporter(None)
+
+    def test_payload_extra_rides_along(self):
+        srv = CollectorServer().start()
+        try:
+            rep = MetricsReporter(
+                None, interval=30.0, collector=srv.endpoint,
+                worker="px", role="server",
+                payload_extra=lambda: {"tables": {"emb": {"pulls": 3}}})
+            rep.write_once()
+            assert _wait(lambda: "emb" in srv.view()["tables"])
+            assert srv.view()["tables"]["emb"]["by_shard"]["px"][
+                "pulls"] == 3
+            rep.stop(final_write=False)
+        finally:
+            srv.shutdown()
+
+    def test_auto_reporter_env_roundtrip(self, monkeypatch):
+        from paddle_tpu.framework import collector as cmod
+        monkeypatch.delenv("PADDLE_COLLECTOR_ENDPOINT", raising=False)
+        assert cmod.auto_reporter() is None       # unset = off
+        srv = CollectorServer().start()
+        try:
+            monkeypatch.setenv("PADDLE_COLLECTOR_ENDPOINT", srv.endpoint)
+            assert collector_endpoint() == srv.endpoint
+            monkeypatch.setenv("PADDLE_TRACE_LABEL", "auto-w")
+            rep = cmod.auto_reporter(role="trainer", interval=30.0)
+            assert rep is not None
+            assert _wait(lambda: "auto-w" in srv.view()["workers"])
+            assert srv.view()["workers"]["auto-w"]["role"] == "trainer"
+            rep.stop(final_write=False)
+        finally:
+            srv.shutdown()
+
+
+class TestPsServerTelemetry:
+    def test_stat_carries_table_stats_and_hot_rows(self):
+        from paddle_tpu.distributed.ps import HostEmbeddingTable
+        from paddle_tpu.distributed.ps.service import PsClient, PsServer
+        set_flags({"ps_hot_row_k": 32})
+        table = HostEmbeddingTable(64, 8, optimizer="sgd", seed=0)
+        set_flags({"ps_hot_row_k": 0})
+        srv = PsServer({"emb": table}, port=0).start()
+        cli = PsClient([f"127.0.0.1:{srv.port}"], wire_dtype="f32",
+                       backoff_base=0.01)
+        try:
+            ids = np.array([5, 5, 9], np.int64)
+            cli.pull("emb", ids)
+            cli.push("emb", ids, np.zeros((3, 8), np.float32))
+            stat = cli.stat()
+            ts = stat["table_stats"]["emb"]
+            assert ts["pulls"] == 1 and ts["pushes"] == 1
+            assert ts["rows_pulled"] == 3 and ts["rows_pushed"] == 3
+            hot = {int(r[0]): int(r[1]) for r in ts["hot_rows"]}
+            assert hot[5] == 2 and hot[9] == 1
+        finally:
+            cli.bye()
+            srv.shutdown()
+
+    def test_push_pull_counts_both_and_gauges_export(self):
+        from paddle_tpu.distributed.ps import HostEmbeddingTable
+        from paddle_tpu.distributed.ps.service import PsClient, PsServer
+        table = HostEmbeddingTable(64, 8, optimizer="sgd", seed=0)
+        srv = PsServer({"emb2": table}, port=0).start()
+        cli = PsClient([f"127.0.0.1:{srv.port}"], wire_dtype="f32",
+                       backoff_base=0.01)
+        try:
+            ids = np.array([1, 2], np.int64)
+            cli.push_pull("emb2", ids, np.zeros((2, 8), np.float32), ids)
+            ts = srv.table_telemetry()["emb2"]
+            assert ts["pulls"] == 1 and ts["pushes"] == 1
+            # the per-table leaf gauge exports as a labeled sample
+            text = monitor.export_prometheus()
+            validate_prometheus(text, require_help=True)
+            assert 'ps_server_table_pulls{leaf="emb2"}' in text
+        finally:
+            cli.bye()
+            srv.shutdown()
+
+    def test_ps_scrape_fallback_view(self):
+        from paddle_tpu.distributed.ps import HostEmbeddingTable
+        from paddle_tpu.distributed.ps.service import PsClient, PsServer
+        set_flags({"ps_hot_row_k": 32})
+        table = HostEmbeddingTable(64, 8, optimizer="sgd", seed=0)
+        set_flags({"ps_hot_row_k": 0})
+        srv = PsServer({"emb3": table}, port=0).start()
+        cli = PsClient([f"127.0.0.1:{srv.port}"], wire_dtype="f32",
+                       backoff_base=0.01)
+        try:
+            cli.pull("emb3", np.array([4, 4, 4, 2], np.int64))
+            view = cluster_top.scrape_ps([f"127.0.0.1:{srv.port}"])
+            cluster_top.validate_view(view)
+            assert view["tables"]["emb3"]["pulls"] == 1
+            hot = {int(r[0]): int(r[1])
+                   for r in view["tables"]["emb3"]["hot_rows"]}
+            assert hot[4] == 3
+            text = cluster_top.render(view)
+            assert "emb3" in text
+        finally:
+            cli.bye()
+            srv.shutdown()
+
+
+class TestAcceptance:
+    def test_mini_cluster_straggler_named_within_k_steps(self):
+        """The satellite's acceptance: 2 workers + 1 PS server +
+        collector over real TCP; injected per-step latency at one rank
+        must raise that rank's straggler score within K steps while the
+        clean rank stays quiet — and the cluster ledger record names
+        it."""
+        from paddle_tpu.distributed.ps import HostEmbeddingTable
+        from paddle_tpu.distributed.ps.service import PsClient, PsServer
+        col = CollectorServer(straggler_ratio=2.0, window=4).start()
+        table = HostEmbeddingTable(64, 8, optimizer="sgd", seed=0)
+        ps = PsServer({"emb": table}, port=0).start()
+        cli = PsClient([f"127.0.0.1:{ps.port}"], wire_dtype="f32",
+                       backoff_base=0.01)
+        clients = {n: CollectorClient(col.endpoint, worker=n,
+                                      role="trainer", timeout=1.0)
+                   for n in ("trainer-0", "trainer-1")}
+        states = {n: {"count": 0, "sum": 0.0} for n in clients}
+        K = 8
+        rng = np.random.default_rng(0)
+        try:
+            for _ in range(K):
+                for name, c in clients.items():
+                    t0 = time.perf_counter()
+                    cli.pull("emb", rng.integers(0, 64, size=(4,)))
+                    if name == "trainer-1":
+                        time.sleep(0.03)       # the injected latency
+                    ms = (time.perf_counter() - t0) * 1e3
+                    c.push(_step_payload(states[name], ms))
+            assert _wait(lambda: col.straggler_report()["stragglers"]
+                         == ["trainer-1"], timeout=10)
+            rep = col.straggler_report()
+            assert rep["scores"]["trainer-0"] < 2.0, \
+                f"clean rank flagged: {rep}"
+            rec, _ = col.capture_record()
+            assert rec["cluster"]["stragglers"] == ["trainer-1"]
+        finally:
+            for c in clients.values():
+                c.stop()
+            cli.bye()
+            ps.shutdown()
+            col.shutdown()
+
+    def test_trajectory_bit_identical_under_collector_faults(self):
+        """Acceptance: with collector.rpc faults injected on every
+        push, training losses are bit-identical to a collector-less
+        run; drops counted, nothing blocks."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit import TrainStep
+
+        def run(client):
+            paddle.seed(0)
+            net = nn.Linear(4, 2)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+            step = TrainStep(net,
+                             lambda m, x, y: ((m(x) - y) ** 2).mean(),
+                             opt)
+            rng = np.random.default_rng(0)
+            x = paddle.to_tensor(rng.standard_normal((8, 4))
+                                 .astype(np.float32))
+            y = paddle.to_tensor(rng.standard_normal((8, 2))
+                                 .astype(np.float32))
+            out = []
+            for _ in range(5):
+                out.append(float(step(x, y)))
+                if client is not None:
+                    client.push(local_payload())
+            return out
+
+        baseline = run(None)
+        srv = CollectorServer().start()
+        chaos.reset()
+        chaos.arm("collector.rpc", mode="error", every=1)
+        try:
+            cli = CollectorClient(srv.endpoint, worker="gate",
+                                  timeout=1.0)
+            faulted = run(cli)
+            assert _wait(lambda: cli.sent + cli.dropped == 5)
+            cli.stop()
+        finally:
+            chaos.disarm("collector.rpc")
+            srv.shutdown()
+        assert faulted == baseline
+        assert cli.dropped == 5 and cli.sent == 0
+
+
+class TestLaunchPlumbing:
+    def test_collector_env_helper(self):
+        from paddle_tpu.distributed.launch import _collector_env
+        env = _collector_env("127.0.0.1:7070", "server")
+        assert env == {"PADDLE_ROLE": "server",
+                       "PADDLE_COLLECTOR_ENDPOINT": "127.0.0.1:7070"}
+        assert _collector_env(None, "trainer") == \
+            {"PADDLE_ROLE": "trainer"}
+
+    @pytest.mark.slow
+    def test_launch_exports_endpoint_to_server_children(self, tmp_path):
+        """launch --collector must export PADDLE_COLLECTOR_ENDPOINT and
+        PADDLE_ROLE to BOTH roles — PS server children included."""
+        script = tmp_path / "probe.py"
+        script.write_text(
+            "import os\n"
+            "print('ROLE', os.environ.get('PADDLE_ROLE'))\n"
+            "print('COL', os.environ.get('PADDLE_COLLECTOR_ENDPOINT'))\n"
+            "print('LABEL', os.environ.get('PADDLE_TRACE_LABEL'))\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--server_num", "1", "--worker_num", "1", "--collector",
+             "--log_dir", str(tmp_path / "log"), str(script)],
+            cwd=str(tmp_path), capture_output=True, text=True,
+            timeout=120, env=dict(os.environ, PYTHONPATH=REPO))
+        assert r.returncode == 0, r.stderr
+        slog = (tmp_path / "log" / "serverlog.0").read_text()
+        wlog = (tmp_path / "log" / "workerlog.0").read_text()
+        assert "ROLE server" in slog and "ROLE trainer" in wlog
+        assert "COL 127.0.0.1:" in slog and "COL 127.0.0.1:" in wlog
+        assert "LABEL server-0" in slog and "LABEL trainer-0" in wlog
+        assert "telemetry collector on" in r.stderr

@@ -32,11 +32,19 @@ echo "== elastic membership/re-form lane (fixed seed, incl. slow) =="
 JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
     python -m pytest tests/test_elastic.py -q
 
-echo "== observability lane (traced mini train -> trace_merge -> schema; prometheus grammar) =="
+echo "== observability lane (traced mini train -> trace_merge -> schema; prometheus grammar; cluster collector) =="
 # 3-step mini train with tracing armed, per-process span file merged by
 # tools/trace_merge.py into a chrome trace that must pass the schema
 # check; monitor.export_prometheus() must round-trip through the
-# Prometheus text-format grammar (incl. cumulative-bucket invariants)
+# Prometheus text-format grammar (incl. cumulative-bucket invariants
+# and the # HELP-per-metric scraper contract).  The collector leg then
+# gates the cluster telemetry plane: with collector.rpc faults injected
+# the training trajectory is bit-identical to a collector-less run
+# (drops counted, nothing blocks), and in a clean mini cluster
+# (2 workers + 1 PS server + collector) the rank with injected step
+# latency is named in the straggler report, the cluster_top view
+# (schema-validated), and the cluster-level ledger record perf_report
+# compare consumes
 JAX_PLATFORMS=cpu python tools/obs_check.py
 
 echo "== ingest lane (JPEG corpus -> full pipeline; stall + cache gates) =="
@@ -129,7 +137,7 @@ echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
     --zoo ps_transport --zoo ingest --zoo health --zoo zero_step \
-    --zoo numerics_step --zoo runlog \
+    --zoo numerics_step --zoo runlog --zoo collector \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="

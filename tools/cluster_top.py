@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Live cluster view — ``top`` for a paddle_tpu training fleet.
+
+Renders one text (or JSON) snapshot of the cluster from the central
+telemetry collector (``framework/collector.py`` — per-worker step
+p50/p99, stall %, RPC latency, anomaly/flight counts, straggler
+scores/flags, PS table request skew + hot rows), or — collector-less —
+by scraping each PS server's ``stat`` op directly over the same wire
+framing (the degraded view: transport/health per shard, no cross-worker
+straggler scoring).
+
+Usage::
+
+    python tools/cluster_top.py --collector 127.0.0.1:7070
+    python tools/cluster_top.py --collector 127.0.0.1:7070 --watch 2
+    python tools/cluster_top.py --collector 127.0.0.1:7070 --json
+    python tools/cluster_top.py --collector 127.0.0.1:7070 --capture \\
+        # ask the collector to append a cluster RunRecord to its ledger
+    python tools/cluster_top.py --ps 127.0.0.1:6070,127.0.0.1:6071
+
+Exit status: 0 on a rendered view, 2 when the target is unreachable,
+1 with ``--fail-on-straggler`` when the view names any straggler (the
+CI gate's inverted form).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+__all__ = ["fetch_view", "scrape_ps", "validate_view", "render", "main"]
+
+
+def fetch_view(endpoint: str, timeout: Optional[float] = None) -> dict:
+    """The collector's aggregated cluster view (its ``view`` op)."""
+    from paddle_tpu.framework import collector
+    reply = collector.request(endpoint, {"op": "view"}, timeout=timeout)
+    if not reply.get("ok"):
+        raise ConnectionError(
+            f"collector view failed: {reply.get('error')}")
+    return reply["view"]
+
+
+def scrape_ps(endpoints: List[str],
+              timeout: Optional[float] = None) -> dict:
+    """Collector-less fallback: scrape each PS server's ``stat`` op
+    (same wire framing) into a view-shaped dict.  Per-shard transport,
+    health, table skew and hot rows are real; cross-worker straggler
+    scoring needs the collector and is absent."""
+    from paddle_tpu.framework import collector
+    workers: Dict[str, dict] = {}
+    shards_by_table: Dict[str, Dict[str, dict]] = {}
+    for i, ep in enumerate(endpoints):
+        name = f"ps-{i}@{ep}"
+        try:
+            stat = collector.request(ep, {"op": "stat"}, timeout=timeout)
+        except (ConnectionError, OSError) as e:
+            workers[name] = {"role": "server", "error": repr(e)}
+            continue
+        tr = stat.get("transport") or {}
+        lat = tr.get("latency_ms") or {}
+        p99s = [h.get("p99") for h in lat.values() if h.get("count")]
+        h_field = stat.get("health") or {}
+        workers[name] = {
+            "role": "server",
+            "rpcs": tr.get("rpcs", 0),
+            "errors": tr.get("errors", 0),
+            "ps_rpc_p99_ms": max(p99s) if p99s else None,
+            "anomalies_total": h_field.get("anomalies_total", 0),
+            "flight_total": len(stat.get("flight") or []),
+            "workers_seen": sorted(stat.get("workers") or {}),
+            "dead": stat.get("dead") or [],
+            "epoch": stat.get("epoch"),
+        }
+        for tname, t in (stat.get("table_stats") or {}).items():
+            shards_by_table.setdefault(tname, {})[name] = t
+    # one shared aggregation (skew formula, hot-row merge/ranking) with
+    # the collector's view, so the fallback cannot silently diverge
+    tables = {tname: collector.aggregate_table_shards(shards)
+              for tname, shards in shards_by_table.items()}
+    return {"schema_version": 1, "ts": time.time(), "source": "ps-scrape",
+            "workers": workers, "tables": tables, "stragglers": [],
+            "flight": [], "reports_total": 0}
+
+
+def validate_view(view: dict) -> int:
+    """Schema gate over a cluster view (the CI collector leg's check):
+    required top-level keys, per-worker row shapes, straggler list
+    consistency.  Returns the worker-row count; raises ValueError."""
+    for key in ("schema_version", "ts", "workers", "tables",
+                "stragglers"):
+        if key not in view:
+            raise ValueError(f"view missing key {key!r}")
+    if not isinstance(view["workers"], dict):
+        raise ValueError("view.workers is not a dict")
+    for w, row in view["workers"].items():
+        if not isinstance(row, dict) or "role" not in row:
+            raise ValueError(f"worker row {w!r} malformed: {row!r}")
+    for s in view["stragglers"]:
+        if s not in view["workers"]:
+            raise ValueError(f"straggler {s!r} not a known worker")
+        row = view["workers"][s]
+        if "straggler" in row and not row["straggler"]:
+            raise ValueError(f"straggler {s!r} not flagged in its row")
+    for tname, t in view["tables"].items():
+        if "by_shard" not in t:
+            raise ValueError(f"table {tname!r} missing by_shard")
+        for rid_cnt in t.get("hot_rows") or []:
+            if len(rid_cnt) != 2:
+                raise ValueError(f"table {tname!r} hot_rows row "
+                                 f"malformed: {rid_cnt!r}")
+    return len(view["workers"])
+
+
+def _fmt(v, width: int, nd: int = 1) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, bool):
+        return ("YES" if v else "").rjust(width)
+    if isinstance(v, float):
+        return f"{v:.{nd}f}".rjust(width)
+    return str(v).rjust(width)
+
+
+def render(view: dict) -> str:
+    """One text frame of the cluster view."""
+    lines = []
+    src = view.get("source", view.get("endpoint", "collector"))
+    lines.append(f"== cluster_top @ {src}  "
+                 f"reports={view.get('reports_total', 0)}  "
+                 f"stragglers={len(view.get('stragglers') or [])} ==")
+    cols = (("worker", 16), ("role", 8), ("steps", 7), ("p50ms", 8),
+            ("p99ms", 8), ("stall%", 7), ("rpc_p99", 8), ("anom", 5),
+            ("flight", 7), ("drops", 6), ("gaps", 5), ("skew", 6),
+            ("STRAG", 6))
+    lines.append("  ".join(n.rjust(w) for n, w in cols))
+    for w, row in sorted((view.get("workers") or {}).items()):
+        lines.append("  ".join([
+            w[:16].rjust(16),
+            _fmt(row.get("role"), 8),
+            _fmt(row.get("steps_total", row.get("rpcs")), 7),
+            _fmt(row.get("step_p50_ms"), 8, 2),
+            _fmt(row.get("step_p99_ms"), 8, 2),
+            _fmt(row.get("input_stall_pct"), 7),
+            _fmt(row.get("ps_rpc_p99_ms"), 8, 2),
+            _fmt(row.get("anomalies_total"), 5),
+            _fmt(row.get("flight_total"), 7),
+            _fmt(row.get("drops_reported"), 6),
+            _fmt(row.get("gaps"), 5),
+            _fmt(row.get("straggler_score"), 6, 2),
+            _fmt(row.get("straggler"), 6),
+        ]))
+    tables = view.get("tables") or {}
+    if tables:
+        lines.append("-- tables --")
+        for tname, t in sorted(tables.items()):
+            hot = "  ".join(f"{rid}:{cnt}"
+                            for rid, cnt in (t.get("hot_rows") or [])[:8])
+            lines.append(f"{tname}: pulls={t.get('pulls', 0)} "
+                         f"pushes={t.get('pushes', 0)} "
+                         f"skew={t.get('shard_skew', 1.0)}"
+                         + (f"  hot: {hot}" if hot else ""))
+    flight_rows = view.get("flight") or []
+    if flight_rows:
+        lines.append("-- recent flight events --")
+        for ev in flight_rows[-8:]:
+            lines.append(f"[{ev.get('severity', '?'):5s}] "
+                         f"{ev.get('worker', '?')}#{ev.get('seq', 0)} "
+                         f"{ev.get('kind', '?')} {ev.get('attrs', {})}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="cluster_top.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--collector", default=None, metavar="HOST:PORT",
+                    help="the central collector's endpoint "
+                         "(PADDLE_COLLECTOR_ENDPOINT / launch "
+                         "--collector)")
+    ap.add_argument("--ps", default=None, metavar="EP[,EP...]",
+                    help="collector-less fallback: scrape these PS "
+                         "servers' stat ops directly")
+    ap.add_argument("--watch", type=float, default=None, metavar="SEC",
+                    help="re-render every SEC seconds until ^C")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw view as JSON")
+    ap.add_argument("--capture", action="store_true",
+                    help="ask the collector to append a cluster-level "
+                         "RunRecord to its configured ledger")
+    ap.add_argument("--fail-on-straggler", action="store_true",
+                    help="exit 1 when the view names any straggler "
+                         "(CI gate form)")
+    ap.add_argument("--timeout", type=float, default=None)
+    a = ap.parse_args(argv)
+    if (a.collector is None) == (a.ps is None):
+        ap.error("pass exactly one of --collector or --ps")
+    if a.capture and a.collector is None:
+        ap.error("--capture needs --collector")
+
+    def one() -> int:
+        try:
+            if a.collector:
+                view = fetch_view(a.collector, timeout=a.timeout)
+            else:
+                view = scrape_ps(
+                    [e.strip() for e in a.ps.split(",") if e.strip()],
+                    timeout=a.timeout)
+        except (ConnectionError, OSError) as e:
+            print(f"cluster_top: unreachable: {e}", file=sys.stderr)
+            return 2
+        validate_view(view)
+        if a.capture:
+            from paddle_tpu.framework import collector
+            reply = collector.request(a.collector, {"op": "capture"},
+                                      timeout=a.timeout)
+            view["capture_committed"] = bool(reply.get("committed"))
+        print(json.dumps(view, indent=1, default=str) if a.json
+              else render(view))
+        if a.fail_on_straggler and view.get("stragglers"):
+            print(f"cluster_top: stragglers flagged: "
+                  f"{view['stragglers']}", file=sys.stderr)
+            return 1
+        return 0
+
+    if a.watch is None:
+        return one()
+    try:
+        while True:
+            rc = one()
+            if rc != 0:
+                # unreachable target (2) or a tripped
+                # --fail-on-straggler gate (1): the watch form honors
+                # the same exit contract as one-shot, so an alerting
+                # wrapper keyed on exit status actually fires
+                return rc
+            time.sleep(a.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

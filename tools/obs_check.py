@@ -11,9 +11,19 @@ End-to-end check of the tracing + metrics plane on a real (tiny) train:
    chrome-trace schema — train-step and ingest-stage spans must both
    appear in the merged trace;
 3. render ``monitor.export_prometheus()`` and validate it against the
-   Prometheus text-format grammar (plus histogram invariants) —
-   ``input_stall_pct``, the per-stage ingest histograms, and the cache
-   hit/miss counters must all export.
+   Prometheus text-format grammar (plus histogram invariants and the
+   ``# HELP``-per-metric scraper contract) — ``input_stall_pct``, the
+   per-stage ingest histograms, and the cache hit/miss counters must
+   all export;
+4. **collector leg** (framework/collector.py): (a) with
+   ``collector.rpc`` error faults injected on EVERY push, a training
+   loop pushing telemetry must produce a bit-identical loss trajectory
+   to a collector-less run — drops counted, nothing blocks; (b) a mini
+   cluster (2 workers + 1 PS server + collector, one rank with
+   injected per-step latency) must name exactly that rank in the
+   collector's straggler report, in the ``cluster_top`` view (schema-
+   validated), and in the cluster-level run-ledger record that
+   ``perf_report compare`` consumes.
 
 Exits non-zero on any violation.  Deterministic, CPU-only, seconds.
 """
@@ -84,6 +94,148 @@ def mini_ingest():
     return n
 
 
+def _collector_train(n_steps: int, client=None):
+    """Fixed-seed training loop, optionally pushing telemetry after
+    every step — the bit-identical-under-faults gate's subject."""
+    from paddle_tpu.framework import collector as collector_mod
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = TrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 2)).astype(np.float32))
+    losses = []
+    for _ in range(n_steps):
+        losses.append(float(step(x, y)))
+        if client is not None:
+            client.push(collector_mod.local_payload())
+    return losses
+
+
+def collector_leg(d: str):
+    """The cluster-telemetry gates (see module docstring item 4)."""
+    import time
+
+    import cluster_top
+    from paddle_tpu.framework import chaos, runlog
+    from paddle_tpu.framework.collector import (CollectorClient,
+                                                CollectorServer)
+
+    # -- 4a. collector loss is invisible to training --------------------
+    baseline = _collector_train(5)
+    srv = CollectorServer().start()
+    chaos.reset()
+    chaos.arm("collector.rpc", mode="error", every=1)
+    try:
+        cli = CollectorClient(srv.endpoint, worker="gate", timeout=1.0)
+        faulted = _collector_train(5, client=cli)
+        cli.stop()
+    finally:
+        chaos.disarm("collector.rpc")
+        srv.shutdown()
+    assert faulted == baseline, \
+        f"trajectory diverged under collector faults: {faulted} " \
+        f"vs {baseline}"
+    assert cli.dropped == 5 and cli.sent == 0, \
+        f"expected every push dropped: sent={cli.sent} " \
+        f"dropped={cli.dropped}"
+    print(f"obs_check: collector chaos OK (trajectory bit-identical, "
+          f"{cli.dropped} pushes dropped, none blocked)")
+
+    # -- 4b. mini cluster: straggler named everywhere -------------------
+    from paddle_tpu.distributed.ps import HostEmbeddingTable
+    from paddle_tpu.distributed.ps.service import PsClient, PsServer
+    from paddle_tpu.framework.flags import set_flags
+
+    # hot-row telemetry is opt-in (per-pull cost); this leg gates it ON
+    set_flags({"ps_hot_row_k": 32})
+    ledger_path = os.path.join(d, "cluster_ledger.jsonl")
+    col = CollectorServer(straggler_ratio=2.0, window=4,
+                          ledger_path=ledger_path).start()
+    table = HostEmbeddingTable(64, 8, optimizer="sgd", seed=0)
+    ps = PsServer({"emb": table}, port=0).start()
+    K = 8
+    cli = ps_cli = None
+    workers = {}
+    try:
+        # the PS shard pushes its per-table telemetry like serve() does
+        ps_cli = CollectorClient(col.endpoint, worker="server-0",
+                                 role="server", timeout=1.0)
+        rng = np.random.default_rng(0)
+        for name, extra_ms in (("trainer-0", 0.0), ("trainer-1", 30.0)):
+            workers[name] = {"client": CollectorClient(
+                col.endpoint, worker=name, role="trainer", timeout=1.0),
+                "count": 0, "sum": 0.0, "extra": extra_ms}
+        cli = PsClient([f"127.0.0.1:{ps.port}"], wire_dtype="f32",
+                       backoff_base=0.01)
+        for step_i in range(K):
+            for name, st in workers.items():
+                t0 = time.perf_counter()
+                cli.pull("emb", rng.integers(0, 64, size=(8,)))
+                if st["extra"]:
+                    time.sleep(st["extra"] / 1e3)  # the injected latency
+                ms = (time.perf_counter() - t0) * 1e3
+                st["count"] += 1
+                st["sum"] += ms
+                st["client"].push({"stats": {}, "hists": {
+                    "train_step_ms": {"count": st["count"],
+                                      "sum": st["sum"],
+                                      "p50": ms, "p99": ms}}})
+            ps_cli.push({"stats": {}, "hists": {},
+                         "tables": ps.table_telemetry()})
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if col.straggler_report()["stragglers"] == ["trainer-1"]:
+                break
+            time.sleep(0.05)
+        report = col.straggler_report()
+        assert report["stragglers"] == ["trainer-1"], \
+            f"straggler not named within {K} steps: {report}"
+        assert report["scores"]["trainer-0"] < 2.0, \
+            f"clean rank flagged: {report}"
+        # the live view (what cluster_top renders) must pass the schema
+        view = cluster_top.fetch_view(col.endpoint)
+        n_workers = cluster_top.validate_view(view)
+        assert n_workers == 3, f"expected 3 reporting processes: {view}"
+        assert view["stragglers"] == ["trainer-1"]
+        assert view["tables"].get("emb", {}).get("pulls", 0) > 0, \
+            f"PS table telemetry missing: {view['tables']}"
+        assert view["tables"]["emb"].get("hot_rows"), \
+            "hot-row sketch empty in the cluster view"
+        text = cluster_top.render(view)
+        assert "trainer-1" in text and "YES" in text
+        # the cluster-level ledger record perf_report compare consumes
+        rec, committed = col.capture_record(label="obs_check")
+        assert committed, "cluster RunRecord did not commit"
+        assert rec["cluster"]["stragglers"] == ["trainer-1"]
+        assert rec["summary"]["cluster_straggler_count"] == 1
+        assert rec["summary"]["cluster_step_skew"] >= 2.0
+        stored = runlog.RunLedger(ledger_path).records(kind="cluster")
+        assert stored and \
+            stored[-1]["cluster"]["stragglers"] == ["trainer-1"]
+        import perf_report
+        series = perf_report.build_series(stored * 2)
+        assert "cluster_step_skew" in series and \
+            "cluster_straggler_count" in series, sorted(series)
+        print(f"obs_check: collector cluster OK (straggler trainer-1 "
+              f"named in report/view/ledger, score "
+              f"{report['scores']['trainer-1']:.2f}, emb pulls "
+              f"{view['tables']['emb']['pulls']})")
+    finally:
+        try:
+            if cli is not None:
+                cli.bye()
+        finally:
+            for st in workers.values():
+                st["client"].stop()
+            if ps_cli is not None:
+                ps_cli.stop()
+            ps.shutdown()
+            col.shutdown()
+
+
 def main() -> int:
     with tempfile.TemporaryDirectory() as d:
         # -- 1. traced mini train + ingest drain ---------------------------
@@ -116,15 +268,21 @@ def main() -> int:
               f"{sum(names.count(s) for s in INGEST_SPANS)} ingest.*)")
 
         # -- 3. prometheus export grammar ----------------------------------
+        # require_help: every metric must carry its # HELP line — the
+        # full contract a real Prometheus scraper expects
         text = monitor.export_prometheus()
-        n_samples = validate_prometheus(text)
+        n_samples = validate_prometheus(text, require_help=True)
         assert "train_steps_total" in text, "steps counter not exported"
         assert "train_step_ms_bucket" in text, \
             "step-time histogram not exported"
+        assert "# HELP train_steps_total" in text, "HELP line missing"
         for metric in INGEST_METRICS:
             assert metric in text, f"{metric} not exported"
         print(f"obs_check: prometheus export OK ({n_samples} samples, "
-              f"ingest metrics present)")
+              f"HELP lines present, ingest metrics present)")
+
+        # -- 4. cluster telemetry collector --------------------------------
+        collector_leg(d)
     print("obs_check: PASSED")
     return 0
 

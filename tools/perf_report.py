@@ -283,6 +283,24 @@ SUMMARY_SIGNAL_CFG: Dict[str, dict] = {
                                "z_threshold": 6.0},
     "numerics_nonfinite_steps_total": {"worse": "up", "min_mad": 0.1,
                                        "z_threshold": 6.0},
+    # cluster-granularity series (framework/collector.py
+    # CollectorServer.capture_record): the collector's cross-worker
+    # view gates here — a new straggler, a step-skew jump, or RPC-p99
+    # growth across runs is a named regression
+    "cluster_step_p99_ms_max": {"worse": "up", "min_mad": 5.0,
+                                "rel_floor": 0.5},
+    "cluster_ps_rpc_p99_ms": {"worse": "up", "min_mad": 5.0,
+                              "rel_floor": 0.5},
+    "cluster_input_stall_pct_max": {"worse": "up", "min_mad": 2.0,
+                                    "rel_floor": 0.25},
+    "cluster_step_skew": {"worse": "up", "min_mad": 0.5,
+                          "z_threshold": 6.0},
+    "cluster_straggler_count": {"worse": "up", "min_mad": 0.4,
+                                "z_threshold": 6.0},
+    "cluster_anomalies_total": {"worse": "up", "min_mad": 0.5,
+                                "z_threshold": 6.0},
+    "cluster_report_gaps_total": {"worse": "up", "min_mad": 2.0,
+                                  "rel_floor": 0.5},
 }
 
 

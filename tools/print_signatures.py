@@ -76,6 +76,7 @@ MODULES = [
     "paddle_tpu.framework.health",
     "paddle_tpu.framework.numerics",
     "paddle_tpu.framework.runlog",
+    "paddle_tpu.framework.collector",
     "paddle_tpu.distributed.fleet.metrics",
     "paddle_tpu.distributed.fleet.utils.fs",
     "paddle_tpu.utils.cpp_extension",

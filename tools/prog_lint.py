@@ -70,6 +70,11 @@ ZOO = {
     # fault-point hygiene in the run ledger + its TrainEpochRange
     # producer hook) — Report, like elastic_step
     "runlog": lambda: _zoo_runlog(),
+    # lints the cluster telemetry plane (collector.rpc fault-point
+    # hygiene in the fire-and-forget pusher + the MetricsReporter push
+    # mode and the launcher's endpoint plumbing) — Report, like
+    # elastic_step
+    "collector": lambda: _zoo_collector(),
 }
 
 
@@ -291,6 +296,28 @@ def _zoo_runlog():
     for rel in (os.path.join("paddle_tpu", "framework", "runlog.py"),
                 os.path.join("paddle_tpu", "framework",
                              "auto_checkpoint.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_collector():
+    """AST-lint the cluster telemetry plane — ``framework/collector.py``
+    (which threads the ``collector.rpc`` chaos fault point through
+    every fire-and-forget push attempt), the ``MetricsReporter`` push
+    mode in ``framework/observability.py``, and the launcher's
+    collector-endpoint env plumbing — so PTA301/302 validate the new
+    fault-point site against the registry and its drop-and-count
+    ownership pragma."""
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for rel in (os.path.join("paddle_tpu", "framework", "collector.py"),
+                os.path.join("paddle_tpu", "framework",
+                             "observability.py"),
+                os.path.join("paddle_tpu", "distributed", "launch.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:
