@@ -47,7 +47,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from paddle_tpu.framework import chaos
+from paddle_tpu.framework import chaos, locks
 from paddle_tpu.framework.observability import flight
 
 __all__ = ["LeaseExpired", "Evicted", "RendezvousStore", "DictStore",
@@ -217,7 +217,7 @@ class DictStore(RendezvousStore):
     def __init__(self, ttl: float = 10.0, clock=None):
         super().__init__(ttl, clock)
         self._state = self._blank()
-        self._lock = threading.RLock()
+        self._lock = locks.rlock("elastic.store")
 
     def _locked(self):
         import contextlib
@@ -455,7 +455,7 @@ class LocalHandle(WorkerHandle):
             except BaseException:       # noqa: BLE001 — worker crash
                 rc = 1
             if self._thread is me:      # stale incarnations stay silent
-                self._rc = rc
+                self._rc = rc  # pta: disable=PTA403 (single-store handoff: run() stores once, exit_code() reads after is_alive() goes False — the GIL makes the reference store atomic; owner: elastic)
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
         return self
@@ -547,7 +547,7 @@ class ElasticAgent:
         #: see note_stragglers); empty until a collector reports
         self.straggler_scores: Dict[str, float] = {}
         self._straggling: set = set()
-        self._straggler_lock = threading.Lock()
+        self._straggler_lock = locks.lock("elastic.stragglers")
         self._restarts: Dict[str, int] = {}
         self._alive_since: Dict[str, float] = {}
         self._restart_at: Dict[str, float] = {}

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import Parameter, Tensor, apply1
-from paddle_tpu.framework import health, monitor
+from paddle_tpu.framework import health, locks, monitor
 from paddle_tpu.jit import not_to_static
 from paddle_tpu.distributed.ps.device_table import (
     DeviceEmbeddingTrainStep, HotRowSketch, MeshShardedEmbedding,
@@ -108,7 +108,7 @@ class HostEmbeddingTable:
             self._g2 = np.zeros((num_embeddings,), np.float32)
         elif optimizer != "sgd":
             raise ValueError(f"unsupported table optimizer {optimizer!r}")
-        self._lock = threading.Lock()
+        self._lock = locks.lock("ps.host_table")
         # bounded hot-row telemetry (FLAGS_ps_hot_row_k; 0 = off): which
         # rows this table actually serves — the signal a serving-side
         # row cache / the cluster collector's hot-table view consumes
@@ -355,7 +355,7 @@ class HashEmbeddingTable:
             raise ValueError(f"unsupported table optimizer {optimizer!r}")
         self._rows: Dict[int, np.ndarray] = {}
         self._g2: Dict[int, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.lock("ps.dynamic_table")
         from paddle_tpu.framework.flags import flag
         k = int(flag("ps_hot_row_k"))
         self.hot_rows = HotRowSketch(k) if k > 0 else None
@@ -614,7 +614,7 @@ class PSTrainStep:
             push = self._take_pending_push()
             table = self.embedding.table
             client = getattr(table, "client", None)
-            if self._prefetch_pool is None:
+            if self._prefetch_pool is None:  # pta: disable=PTA404 (train-loop thread only: prefetch issue/consume both run on the consumer thread; the pool exists before any task can race it)
                 from concurrent.futures import ThreadPoolExecutor
                 self._prefetch_pool = ThreadPoolExecutor(
                     max_workers=max(1, self.prefetch_depth),
@@ -794,7 +794,7 @@ class PSTrainStep:
         params = {n: p._data for n, p in model.named_parameters()}
         buffers = {n: b._data for n, b in model.named_buffers()
                    if b is not None}
-        if self._opt_states is None:
+        if self._opt_states is None:  # pta: disable=PTA404 (train-loop thread only: step() is driven by the single consumer thread; prefetch tasks never touch optimizer state)
             self._opt_states = self.optimizer.functional_init_states(params)
         arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
                 for i in inputs]

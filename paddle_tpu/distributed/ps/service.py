@@ -34,7 +34,8 @@ import numpy as np
 from paddle_tpu.distributed.ps import HostEmbeddingTable
 from paddle_tpu.distributed.ps.device_table import (
     WIRE_DTYPES, dequantize_rows, normalize_wire, quantize_rows)
-from paddle_tpu.framework import chaos, health, monitor, observability
+from paddle_tpu.framework import (chaos, health, locks, monitor,
+                                  observability)
 from paddle_tpu.framework.flags import flag
 from paddle_tpu.framework.observability import flight
 
@@ -111,7 +112,7 @@ class TransportStats:
 
     def __init__(self, role: str = "client"):
         self.role = role
-        self._lock = threading.Lock()
+        self._lock = locks.lock("ps.transport.stats")
         self.rpcs = 0
         self.errors = 0
         self.bytes_sent = 0
@@ -187,7 +188,7 @@ class HeartBeatMonitor:
     def __init__(self, timeout: float = 30.0):
         self.timeout = timeout
         self._beats: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.lock("ps.heartbeat")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.on_dead = None            # callback(worker_id)
@@ -324,17 +325,17 @@ class PsServer:
         self.n_workers = n_workers
         self.epoch = 0                 # membership-epoch fence (elastic)
         self._bye_count = 0
-        self._lock = threading.Lock()
+        self._lock = locks.lock("ps.server.state")
         self.transport = TransportStats(role="server")
         # per-table request accounting (the PS-skew telemetry the
         # cluster collector aggregates per shard): pulls/pushes served
         # and row volume each way, plus the table's own bounded hot-row
         # sketch — see HostEmbeddingTable.hot_rows
         self._table_stats: Dict[str, Dict[str, int]] = {}
-        self._tstats_lock = threading.Lock()
+        self._tstats_lock = locks.lock("ps.server.table_stats")
         # push dedup: worker -> insertion-ordered {seq: True} window
         self._push_seen: Dict[str, "dict"] = {}
-        self._seen_lock = threading.Lock()
+        self._seen_lock = locks.lock("ps.server.push_seen")
         self._tcp = _TcpServer((host, port), _Handler)
         self._tcp.ps = self                        # type: ignore
         self.host, self.port = self._tcp.server_address
@@ -627,7 +628,7 @@ class _Conn:
         self.timeout = float(flag("ps_rpc_timeout")) if timeout is None \
             else timeout
         self.stats = stats
-        self.lock = threading.Lock()
+        self.lock = locks.lock("ps.conn")
         # first dial is best-effort: a client may legitimately be built
         # over a server set containing dead peers (elastic re-shard
         # probing survivors) — rpc() redials lazily and its retry path
@@ -659,7 +660,7 @@ class _Conn:
                     self.sock = self._connect()  # lazy redial after failure
                 try:
                     sent = _send_msg(self.sock, header, bufs)
-                    reply, rbufs, rcvd = _recv_msg(self.sock)
+                    reply, rbufs, rcvd = _recv_msg(self.sock)  # pta: disable=PTA402 (the per-connection lock IS the stream owner: it serializes request/reply framing so a concurrent caller can never read another RPC's reply; FLAGS_ps_rpc_timeout bounds the recv)
                 except (ConnectionError, OSError):
                     # the stream may be mid-message: invalidate UNDER the
                     # lock so no concurrent caller (e.g. the heartbeat
@@ -761,9 +762,9 @@ class PsClient:
         # silently drop its first pushes as duplicates
         self._push_ident = f"{self.worker_id}~{os.urandom(4).hex()}"
         self._seq = 0
-        self._seq_lock = threading.Lock()
+        self._seq_lock = locks.lock("ps.client.seq")
         self.dead_endpoints: List[str] = []
-        self._dead_lock = threading.Lock()
+        self._dead_lock = locks.lock("ps.client.dead")
         self.on_endpoint_dead = None       # callback(endpoint, exception)
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None

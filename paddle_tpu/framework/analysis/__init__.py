@@ -13,17 +13,25 @@ surprises.  Two front ends share one diagnostic core:
   the dy2static analysis machinery: traced-value control flow, side
   effects under jit, tracer leaks, numpy-on-tracer, chaos fault-point
   hygiene.
+* :mod:`.concurrency` — lock/thread pass family (PTA4xx): whole-repo
+  lock model, acquisition-order cycles, blocking calls under locks,
+  thread-shared writes, check-then-act init, finalizer-context locks,
+  queue protocol, daemon writers.  Validated at runtime by the
+  ``framework/locks.py`` watchdog (``FLAGS_lock_watchdog``).
 
 CLI: ``python tools/prog_lint.py <module|path> [--format=json|text]``.
 Suppression: ``# pta: disable=PTA201`` inline (see diagnostics.py).
 """
 from paddle_tpu.framework.analysis.ast_passes import (  # noqa: F401
     lint_file, lint_source)
+from paddle_tpu.framework.analysis.concurrency import (  # noqa: F401
+    analyze_files, analyze_sources, lint_threads_source)
 from paddle_tpu.framework.analysis.diagnostics import (  # noqa: F401
     Diagnostic, Report, RULES, Severity)
 from paddle_tpu.framework.analysis.jaxpr_passes import (  # noqa: F401
     analyze_callable, analyze_jaxpr, analyze_model)
 
 __all__ = ["Diagnostic", "Report", "RULES", "Severity", "analyze_jaxpr",
-           "analyze_callable", "analyze_model", "lint_source",
-           "lint_file"]
+           "analyze_callable", "analyze_model", "analyze_files",
+           "analyze_sources", "lint_source", "lint_file",
+           "lint_threads_source"]

@@ -149,7 +149,9 @@ class _FileLinter:
     def emit(self, rule: str, node: ast.AST, message: str,
              severity: Severity, hint: Optional[str] = None):
         line = getattr(node, "lineno", None)
-        if not self.sup.allows(rule, line):
+        # header-span suppression: pragmas on a decorator line or any
+        # line of a multi-line statement header count (allows_node)
+        if not self.sup.allows_node(rule, node):
             return
         self.report.add(Diagnostic(
             rule, message, severity, file=self.filename, line=line,

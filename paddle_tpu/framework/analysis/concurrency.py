@@ -1,0 +1,1469 @@
+"""Concurrency pass family — the third front end on the Diagnostic core.
+
+Where the jaxpr passes (PTA1xx) see what a trace produced and the
+jit-safety lint (PTA2xx/3xx) sees what source will do to a trace, this
+front end sees what the *threads* will do to each other.  It extracts a
+whole-repo **lock model** from the AST — per-class and module lock
+fields (``threading.Lock``/``RLock`` and the named
+``framework.locks.lock``/``rlock`` wrappers), ``with lock:`` scopes,
+explicit ``acquire``/``release`` pairs, queue/thread/executor fields,
+thread spawn sites — propagates lock-acquisition summaries over a
+resolvable call graph (``self.method``, module functions, imported
+modules, module-level instances), and checks the result:
+
+========  ==============================================================
+PTA401    lock-order inversion: a cycle in the static acquisition
+          graph (edge A→B = "B acquired while A held", direct nesting
+          and through calls), including a self-deadlock on a
+          non-reentrant lock
+PTA402    blocking call under a held lock: ``socket.recv``/``accept``,
+          ``subprocess``, ``Queue.get`` with no timeout, ``fsync``,
+          thread/queue ``join`` — direct, or through a call whose
+          callee blocks
+PTA403    shared-mutable ``self`` attribute written from a ``Thread``
+          target / executor task without a guarding lock, while other
+          (non-thread) methods touch the same attribute
+PTA404    check-then-act lazy init (``if x is None: x = ...``) on
+          shared state outside any lock, in a class/module that owns
+          locks — exempt when every same-class call site of the
+          (private) method already holds a lock
+PTA405    locks acquired in ``__del__`` / signal-handler / ``atexit``
+          context — a non-reentrant lock there can interrupt its own
+          holder (the FlightRecorder SIGTERM self-deadlock class);
+          reentrant locks pass
+PTA406    queue ``get``/``task_done`` imbalance: a ``task_done`` that
+          an exception between it and its ``get`` can skip (not in a
+          ``finally``), or a ``join()`` on a queue whose consumers
+          never call ``task_done``
+PTA407    daemon thread on a crash-safe-write path (``atomic_write``):
+          interpreter exit can kill it mid-write — safe only because
+          (and only while) the write is tmp+rename
+========  ==============================================================
+
+The **runtime half** is ``framework/locks.py``: the same held-before
+graph rebuilt from what actually runs, under ``FLAGS_lock_watchdog``.
+Locks created as ``locks.lock("name")`` are modeled under that literal
+name, so a PTA401 finding and the watchdog's ``locks.cycle`` flight
+event name the same cycle — the static model is validated by the
+dynamic one and vice versa (the CI watchdog lane pins this on a
+committed inversion fixture).
+
+Suppression: the shared ``# pta: disable=PTA4xx`` pragmas, header-span
+aware (a pragma on any line of a multi-line ``with`` header or on a
+decorator line counts).  CLI: ``python tools/prog_lint.py --threads
+<targets>``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.framework.analysis.diagnostics import (
+    Diagnostic, Report, Severity, parse_suppressions, register_rule)
+
+__all__ = ["analyze_files", "analyze_sources", "lint_threads_source",
+           "LockModel"]
+
+register_rule("PTA401", "lock-order inversion (static acquisition "
+              "cycle)", Severity.ERROR, "threads")
+register_rule("PTA402", "blocking call under a held lock",
+              Severity.WARNING, "threads")
+register_rule("PTA403", "unguarded shared write from a thread/executor "
+              "task", Severity.WARNING, "threads")
+register_rule("PTA404", "check-then-act lazy init without the lock",
+              Severity.WARNING, "threads")
+register_rule("PTA405", "lock acquired in __del__/signal/atexit "
+              "context", Severity.WARNING, "threads")
+register_rule("PTA406", "queue get/task_done imbalance",
+              Severity.WARNING, "threads")
+register_rule("PTA407", "daemon thread on a crash-safe write path",
+              Severity.WARNING, "threads")
+
+_LOCK_CTORS = {"Lock": False, "RLock": True}
+_WRAPPER_CTORS = {"lock": False, "rlock": True}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                "JoinableQueue"}
+_POOL_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor", "Pool"}
+_BLOCKING_ATTRS = {"recv": "socket.recv", "recv_into": "socket.recv",
+                   "accept": "socket.accept"}
+_SUBPROCESS_CALLS = {"run", "check_output", "check_call", "call",
+                     "Popen"}
+
+
+def _last_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        return _last_name(node.func)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Canonical dotted form of a Name/Attribute chain (ctx-blind)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+@dataclass(frozen=True)
+class LockDef:
+    key: str                       # graph node name (shared == same key)
+    reentrant: bool
+    file: str
+    line: int
+
+
+@dataclass
+class _CallSite:
+    expr: ast.Call
+    node: ast.AST                  # anchor for diagnostics
+    held: Tuple[str, ...]          # lock keys held at the site
+
+
+@dataclass
+class _Func:
+    key: str
+    module: str
+    cls: Optional[str]
+    name: str
+    node: ast.AST
+    file: str
+    acquires: List[Tuple[str, ast.AST, Tuple[str, ...]]] = \
+        field(default_factory=list)      # (lock key, node, held-before)
+    calls: List[_CallSite] = field(default_factory=list)
+    blocking: List[Tuple[str, ast.AST, Tuple[str, ...], str]] = \
+        field(default_factory=list)      # (kind, node, held, detail)
+    self_writes: List[Tuple[str, ast.AST, bool]] = \
+        field(default_factory=list)      # (attr, node, under_lock)
+    self_reads: Set[str] = field(default_factory=set)
+    lazy_inits: List[Tuple[str, ast.AST, bool, str]] = \
+        field(default_factory=list)      # (desc, node, under_lock, kind)
+    q_gets: List[Tuple[str, ast.AST]] = field(default_factory=list)
+    q_task_dones: List[Tuple[str, ast.AST, bool]] = \
+        field(default_factory=list)      # (queue, node, in_finally)
+    q_joins: List[Tuple[str, ast.AST]] = field(default_factory=list)
+    spawns: List[Tuple[Optional[str], bool, ast.AST, str]] = \
+        field(default_factory=list)      # (target key, daemon, node, how)
+    crash_safe_writes: List[ast.AST] = field(default_factory=list)
+    local_funcs: Dict[str, str] = field(default_factory=dict)
+    nested: List[str] = field(default_factory=list)
+    declared_global: Set[str] = field(default_factory=set)
+    finalizer: Optional[str] = None      # "__del__"|"signal"|"atexit"
+
+
+@dataclass
+class _Class:
+    key: str
+    module: str
+    name: str
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> fkey
+    lock_attrs: Dict[str, LockDef] = field(default_factory=dict)
+    queue_attrs: Set[str] = field(default_factory=set)
+    thread_attrs: Set[str] = field(default_factory=set)
+    pool_attrs: Set[str] = field(default_factory=set)
+    attr_instances: Dict[str, str] = field(default_factory=dict)
+    # same-class call sites per method: method name -> [under_lock?]
+    intra_calls: Dict[str, List[bool]] = field(default_factory=dict)
+
+
+@dataclass
+class _Module:
+    key: str
+    file: str
+    imports: Dict[str, str] = field(default_factory=dict)
+    from_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    classes: Dict[str, _Class] = field(default_factory=dict)
+    functions: Dict[str, str] = field(default_factory=dict)
+    locks: Dict[str, LockDef] = field(default_factory=dict)
+    queues: Set[str] = field(default_factory=set)
+    instances: Dict[str, str] = field(default_factory=dict)  # name->cls key
+    globals: Set[str] = field(default_factory=set)
+    source: str = ""
+
+
+class LockModel:
+    """The whole-repo model the passes run over: every module's symbol
+    tables plus per-function summaries."""
+
+    def __init__(self):
+        self.modules: Dict[str, _Module] = {}
+        self.funcs: Dict[str, _Func] = {}
+        self.locks: Dict[str, LockDef] = {}
+        self.callees: Dict[str, Set[str]] = {}       # resolved call graph
+        self.callers: Dict[str, Set[str]] = {}
+
+    def lock_def(self, key: str) -> Optional[LockDef]:
+        return self.locks.get(key)
+
+
+# ---------------------------------------------------------------------------
+# phase 1: per-module symbol tables
+# ---------------------------------------------------------------------------
+
+def _module_name(path: str, repo_root: Optional[str]) -> str:
+    p = os.path.normpath(os.path.abspath(path))
+    parts = p.replace("\\", "/").split("/")
+    if "paddle_tpu" in parts:
+        parts = parts[parts.index("paddle_tpu"):]
+    else:
+        parts = parts[-1:]
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1] or ["<root>"]
+    return ".".join(parts)
+
+
+def _lock_ctor(expr: ast.AST, imports: Dict[str, str],
+               from_imports: Dict[str, Tuple[str, str]]
+               ) -> Optional[Tuple[bool, Optional[str]]]:
+    """(reentrant, explicit name) when ``expr`` constructs a lock."""
+    if not isinstance(expr, ast.Call):
+        return None
+    fn = expr.func
+    name = _last_name(fn)
+    if name in _LOCK_CTORS:
+        root = _root_name(fn)
+        if isinstance(fn, ast.Name) or root in ("threading", "_threading"):
+            return _LOCK_CTORS[name], None
+        return None
+    if name in _WRAPPER_CTORS:
+        root = _root_name(fn)
+        ok = isinstance(fn, ast.Name) or root == "locks" or \
+            imports.get(root, "").endswith("locks") or \
+            from_imports.get(root or "", ("", ""))[0].endswith("locks")
+        if not ok:
+            return None
+        lit = None
+        if expr.args and isinstance(expr.args[0], ast.Constant) and \
+                isinstance(expr.args[0].value, str):
+            lit = expr.args[0].value
+        return _WRAPPER_CTORS[name], lit
+    return None
+
+
+def _is_queue_ctor(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and \
+        _last_name(expr.func) in _QUEUE_CTORS
+
+
+def _is_pool_ctor(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and \
+        _last_name(expr.func) in _POOL_CTORS
+
+
+def _is_thread_ctor(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and \
+        _last_name(expr.func) == "Thread"
+
+
+class _ModuleScanner:
+    """Builds one module's symbol tables (no statement semantics yet)."""
+
+    def __init__(self, model: LockModel, key: str, file: str,
+                 tree: ast.Module, source: str):
+        self.model = model
+        self.m = _Module(key=key, file=file, source=source)
+        model.modules[key] = self.m
+        self.tree = tree
+
+    def scan(self):
+        m = self.m
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    m.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    m.from_imports[a.asname or a.name] = (node.module,
+                                                          a.name)
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._scan_class(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                m.functions[stmt.name] = f"{m.key}.{stmt.name}"
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        m.globals.add(t.id)
+                        self._module_binding(t.id, stmt.value, stmt)
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                m.globals.add(stmt.target.id)
+                if stmt.value is not None:
+                    self._module_binding(stmt.target.id, stmt.value, stmt)
+
+    def _module_binding(self, name: str, value: ast.AST, stmt: ast.stmt):
+        m = self.m
+        lk = _lock_ctor(value, m.imports, m.from_imports)
+        if lk is not None:
+            reentrant, lit = lk
+            d = LockDef(lit or f"{m.key}.{name}", reentrant, m.file,
+                        stmt.lineno)
+            m.locks[name] = d
+            self.model.locks.setdefault(d.key, d)
+            return
+        if _is_queue_ctor(value):
+            m.queues.add(name)
+            return
+        if isinstance(value, ast.Call) and \
+                isinstance(value.func, ast.Name):
+            m.instances[name] = f"{m.key}.{value.func.id}"
+
+    def _scan_class(self, cls: ast.ClassDef):
+        m = self.m
+        c = _Class(key=f"{m.key}.{cls.name}", module=m.key, name=cls.name)
+        m.classes[cls.name] = c
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                c.methods[stmt.name] = f"{c.key}.{stmt.name}"
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self._attr_binding(c, t.id, stmt.value, stmt)
+        # self.X = ... bindings anywhere in the class's methods
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        self._attr_binding(c, t.attr, node.value, node)
+
+    def _attr_binding(self, c: _Class, attr: str, value: ast.AST,
+                      stmt: ast.stmt):
+        m = self.m
+        lk = _lock_ctor(value, m.imports, m.from_imports)
+        if lk is not None:
+            reentrant, lit = lk
+            d = LockDef(lit or f"{c.key}.{attr}", reentrant, m.file,
+                        stmt.lineno)
+            c.lock_attrs.setdefault(attr, d)
+            self.model.locks.setdefault(d.key, d)
+            return
+        if _is_queue_ctor(value):
+            c.queue_attrs.add(attr)
+        elif _is_pool_ctor(value):
+            c.pool_attrs.add(attr)
+        elif _is_thread_ctor(value):
+            c.thread_attrs.add(attr)
+        elif isinstance(value, ast.Call) and \
+                isinstance(value.func, ast.Name) and \
+                value.func.id[:1].isupper():
+            c.attr_instances.setdefault(attr, value.func.id)
+
+
+# ---------------------------------------------------------------------------
+# phase 2: per-function statement walk (held-stack accurate)
+# ---------------------------------------------------------------------------
+
+class _FuncWalker:
+    def __init__(self, model: LockModel, mod: _Module,
+                 cls: Optional[_Class], fn: ast.AST, key: str):
+        self.model = model
+        self.mod = mod
+        self.cls = cls
+        self.f = _Func(key=key, module=mod.key,
+                       cls=cls.name if cls else None,
+                       name=getattr(fn, "name", "<lambda>"), node=fn,
+                       file=mod.file)
+        model.funcs[key] = self.f
+        if cls is not None and self.f.name == "__del__":
+            self.f.finalizer = "__del__"
+        for dec in getattr(fn, "decorator_list", ()):
+            if _dotted(dec) == "atexit.register":
+                model.callees.setdefault("<finalizers>", set()).add(key)
+        self.local_locks: Dict[str, LockDef] = {}
+        self.local_queues: Set[str] = set()
+        self.local_pools: Set[str] = set()
+        self.local_threads: Set[str] = set()
+        self.held: List[Tuple[str, bool]] = []   # (key, via_with)
+        self.finally_depth = 0
+
+    # -- resolution ---------------------------------------------------------
+    def resolve_lock(self, expr: ast.AST) -> Optional[LockDef]:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return self.local_locks[expr.id]
+            return self.mod.locks.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base in ("self", "cls") and self.cls is not None:
+                return self.cls.lock_attrs.get(expr.attr)
+            # Class._lock via the class name (classmethod idiom)
+            c = self.mod.classes.get(base)
+            if c is not None:
+                return c.lock_attrs.get(expr.attr)
+            # other_module._lock
+            mk = self.mod.imports.get(base)
+            om = self.model.modules.get(mk) if mk else None
+            if om is not None:
+                return om.locks.get(expr.attr)
+        return None
+
+    def _is_queue(self, expr: ast.AST) -> Optional[str]:
+        """A canonical queue id when ``expr`` denotes a known queue (or
+        is queue-ish by name), else None."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_queues or expr.id in self.mod.queues:
+                return f"{self.f.key}.{expr.id}" \
+                    if expr.id in self.local_queues \
+                    else f"{self.mod.key}.{expr.id}"
+            if expr.id in ("q", "_q", "queue") or \
+                    expr.id.endswith("queue"):
+                return f"{self.f.key}.{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls is not None:
+            if expr.attr in self.cls.queue_attrs:
+                return f"{self.cls.key}.{expr.attr}"
+            if expr.attr in ("q", "_q") or expr.attr.endswith("queue"):
+                return f"{self.cls.key}.{expr.attr}"
+        return None
+
+    def _is_threadlike(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            return n in self.local_threads or "thread" in n or \
+                "proc" in n
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls is not None:
+            n = expr.attr
+            return n in self.cls.thread_attrs or "thread" in n or \
+                "proc" in n
+        return False
+
+    def _is_pool(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            return n in self.local_pools or "pool" in n or \
+                "executor" in n.lower()
+        if isinstance(expr, ast.Attribute):
+            n = expr.attr
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self" and self.cls is not None and \
+                    n in self.cls.pool_attrs:
+                return True
+            return "pool" in n or "executor" in n.lower()
+        return False
+
+    def _target_ref(self, expr: ast.AST) -> Optional[str]:
+        """Resolve a thread/executor *target expression* to a func key."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.f.local_funcs:
+                return self.f.local_funcs[expr.id]
+            if expr.id in self.mod.functions:
+                return self.mod.functions[expr.id]
+            fi = self.mod.from_imports.get(expr.id)
+            if fi is not None:
+                return f"{fi[0]}.{fi[1]}"
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and self.cls is not None:
+                return self.cls.methods.get(expr.attr)
+            mk = self.mod.imports.get(expr.value.id)
+            if mk is not None:
+                return f"{mk}.{expr.attr}"
+        return None
+
+    # -- the walk -----------------------------------------------------------
+    def run(self):
+        fn = self.f.node
+        for stmt in fn.body:
+            self.visit_stmt(stmt)
+        return self.f
+
+    def _held_keys(self) -> Tuple[str, ...]:
+        return tuple(k for k, _ in self.held)
+
+    def visit_stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            key = f"{self.f.key}.{stmt.name}"
+            self.f.local_funcs[stmt.name] = key
+            self.f.nested.append(key)
+            sub = _FuncWalker(self.model, self.mod, self.cls, stmt, key)
+            sub.f.local_funcs.update(self.f.local_funcs)
+            sub.run()
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, ast.Global):
+            self.f.declared_global.update(stmt.names)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                d = self.resolve_lock(item.context_expr)
+                if d is None and isinstance(item.context_expr, ast.Call):
+                    inner = item.context_expr.func
+                    # lock.acquire()-style context or cm-returning call
+                    d = self.resolve_lock(inner) \
+                        if isinstance(inner, ast.Attribute) and \
+                        _last_name(inner) in ("acquire",) else None
+                    if d is None:
+                        self.visit_expr(item.context_expr, stmt)
+                if d is not None:
+                    self.f.acquires.append((d.key, stmt,
+                                            self._held_keys()))
+                    self.held.append((d.key, True))
+                    pushed += 1
+                elif not isinstance(item.context_expr, ast.Call):
+                    self.visit_expr(item.context_expr, stmt)
+            for s in stmt.body:
+                self.visit_stmt(s)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self.visit_stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self.visit_stmt(s)
+            for s in stmt.orelse:
+                self.visit_stmt(s)
+            self.finally_depth += 1
+            for s in stmt.finalbody:
+                self.visit_stmt(s)
+            self.finally_depth -= 1
+            return
+        if isinstance(stmt, ast.If):
+            self._check_lazy_init(stmt)
+            self.visit_expr(stmt.test, stmt)
+            for s in stmt.body + stmt.orelse:
+                self.visit_stmt(s)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.visit_expr(stmt.iter, stmt)
+            for s in stmt.body + stmt.orelse:
+                self.visit_stmt(s)
+            return
+        if isinstance(stmt, ast.While):
+            self.visit_expr(stmt.test, stmt)
+            for s in stmt.body + stmt.orelse:
+                self.visit_stmt(s)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                self._check_binding(stmt, value)
+                self.visit_expr(value, stmt)
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                self._note_store(t, stmt)
+            return
+        if isinstance(stmt, ast.Expr):
+            self.visit_expr(stmt.value, stmt)
+            return
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            v = stmt.value if isinstance(stmt, ast.Return) else stmt.exc
+            if v is not None:
+                self.visit_expr(v, stmt)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.visit_expr(child, stmt)
+
+    # -- bindings / stores --------------------------------------------------
+    def _check_binding(self, stmt: ast.stmt, value: ast.AST):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [getattr(stmt, "target", None)]
+        name = targets[0].id if targets and isinstance(targets[0],
+                                                       ast.Name) else None
+        if name is None:
+            # `lock, seq_box = threading.Lock(), [0]` — pairwise displays
+            if targets and isinstance(targets[0], ast.Tuple) and \
+                    isinstance(value, ast.Tuple) and \
+                    len(targets[0].elts) == len(value.elts):
+                for t, v in zip(targets[0].elts, value.elts):
+                    if isinstance(t, ast.Name):
+                        self._bind_local(t.id, v, stmt)
+            return
+        self._bind_local(name, value, stmt)
+
+    def _bind_local(self, name: str, value: ast.AST, stmt: ast.stmt):
+        lk = _lock_ctor(value, self.mod.imports, self.mod.from_imports)
+        if lk is not None:
+            reentrant, lit = lk
+            d = LockDef(lit or f"{self.f.key}.{name}", reentrant,
+                        self.mod.file, stmt.lineno)
+            self.local_locks[name] = d
+            self.model.locks.setdefault(d.key, d)
+        elif _is_queue_ctor(value):
+            self.local_queues.add(name)
+        elif _is_pool_ctor(value):
+            self.local_pools.add(name)
+        elif _is_thread_ctor(value):
+            self.local_threads.add(name)
+            self._note_spawn(value, stmt)
+
+    def _note_store(self, target: ast.AST, stmt: ast.stmt):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._note_store(e, stmt)
+            return
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            self.f.self_writes.append((target.attr, stmt,
+                                       bool(self.held)))
+
+    def _check_lazy_init(self, stmt: ast.If):
+        """``if X is None: X = ...`` / ``if not X: X = ...`` on shared
+        state (self/cls attribute or module global)."""
+        test = stmt.test
+        target = None
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+                isinstance(test.ops[0], ast.Is) and \
+                isinstance(test.comparators[0], ast.Constant) and \
+                test.comparators[0].value is None:
+            target = test.left
+        elif isinstance(test, ast.UnaryOp) and \
+                isinstance(test.op, ast.Not):
+            target = test.operand
+        if target is None:
+            return
+        kind = desc = None
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id in ("self", "cls"):
+            kind, desc = "attr", f"{target.value.id}.{target.attr}"
+        elif isinstance(target, ast.Name) and (
+                target.id in self.mod.globals or
+                target.id in self.f.declared_global):
+            kind, desc = "global", target.id
+        if desc is None:
+            return
+        # the body must assign the same target (ctx-insensitive compare:
+        # the test reads it, the body stores it)
+        want = _dotted(target)
+        assigns = False
+        for s in stmt.body:
+            for node in ast.walk(s):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tg = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in tg:
+                        if _dotted(t) == want:
+                            assigns = True
+        if not assigns:
+            return
+        # double-checked locking: every assignment to the target sits
+        # inside a `with <known lock>:` of the body (the unlocked outer
+        # check is the fast path, the locked re-check the guard) — the
+        # canonical correct idiom, not a finding
+        guarded_spans = []
+        for s in stmt.body:
+            for node in ast.walk(s):
+                if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+                        self.resolve_lock(i.context_expr) is not None
+                        for i in node.items):
+                    guarded_spans.append(node)
+
+        def under_guard(n: ast.AST) -> bool:
+            return any(n in set(ast.walk(g)) for g in guarded_spans)
+
+        all_guarded = True
+        for s in stmt.body:
+            for node in ast.walk(s):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tg = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    if any(_dotted(t) == want for t in tg) and \
+                            not under_guard(node):
+                        all_guarded = False
+        if all_guarded:
+            return
+        self.f.lazy_inits.append((desc, stmt, bool(self.held), kind))
+
+    # -- expressions (calls) ------------------------------------------------
+    def visit_expr(self, expr: ast.AST, anchor: ast.stmt):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and \
+                    isinstance(node.ctx, ast.Load):
+                self.f.self_reads.add(node.attr)
+            if not isinstance(node, ast.Call):
+                continue
+            self._visit_call(node, anchor)
+
+    def _visit_call(self, call: ast.Call, anchor: ast.stmt):
+        fn = call.func
+        name = _last_name(fn)
+        held = self._held_keys()
+        # explicit acquire/release
+        if isinstance(fn, ast.Attribute) and name in ("acquire",
+                                                      "release"):
+            d = self.resolve_lock(fn.value)
+            if d is not None:
+                if name == "acquire":
+                    self.f.acquires.append((d.key, anchor, held))
+                    self.held.append((d.key, False))
+                else:
+                    for i in range(len(self.held) - 1, -1, -1):
+                        if self.held[i][0] == d.key:
+                            self.held.pop(i)
+                            break
+                return
+        # thread spawn / executor submit
+        if _is_thread_ctor(call):
+            self._note_spawn(call, anchor)
+        elif isinstance(fn, ast.Attribute) and \
+                name in ("submit", "map") and self._is_pool(fn.value):
+            if call.args:
+                ref = self._target_ref(call.args[0])
+                self.f.spawns.append((ref, False, anchor, name))
+        # finalizer registration (resolved refs are filtered against
+        # the final func table in phase 3 — registration may lexically
+        # precede or follow the handler's def)
+        root = _root_name(fn)
+        if name == "register" and (
+                root == "atexit" or
+                self.mod.imports.get(root or "") == "atexit") and \
+                call.args:
+            ref = self._target_ref(call.args[0])
+            if ref is not None:
+                self.model.callees.setdefault(
+                    "<finalizers>", set()).add(ref)
+        if name == "signal" and (
+                root in ("signal", "_signal") or
+                self.mod.imports.get(root or "") == "signal") and \
+                len(call.args) >= 2:
+            ref = self._target_ref(call.args[1])
+            if ref is not None:
+                self.model.callees.setdefault(
+                    "<signal-handlers>", set()).add(ref)
+        # blocking shapes
+        self._check_blocking(call, fn, name, anchor, held)
+        # queue protocol
+        if isinstance(fn, ast.Attribute):
+            qid = self._is_queue(fn.value)
+            if qid is not None:
+                if name == "get":
+                    self.f.q_gets.append((qid, anchor))
+                elif name == "task_done":
+                    self.f.q_task_dones.append(
+                        (qid, anchor, self.finally_depth > 0))
+                elif name == "join":
+                    self.f.q_joins.append((qid, anchor))
+        # crash-safe write path
+        if name == "atomic_write":
+            self.f.crash_safe_writes.append(anchor)
+        # resolvable call site (for the call graph)
+        self.f.calls.append(_CallSite(expr=call, node=anchor, held=held))
+
+    def _check_blocking(self, call: ast.Call, fn: ast.AST,
+                        name: Optional[str], anchor: ast.stmt,
+                        held: Tuple[str, ...]):
+        f = self.f
+        if isinstance(fn, ast.Attribute) and name in _BLOCKING_ATTRS:
+            f.blocking.append((_BLOCKING_ATTRS[name], anchor, held,
+                               ast.unparse(fn)))
+            return
+        root = _root_name(fn)
+        if root == "subprocess" or (
+                isinstance(fn, ast.Name) and name in _SUBPROCESS_CALLS
+                and self.mod.from_imports.get(name, ("",))[0]
+                == "subprocess"):
+            f.blocking.append(("subprocess", anchor, held,
+                               ast.unparse(fn)))
+            return
+        if name == "fsync":
+            f.blocking.append(("fsync", anchor, held, ast.unparse(fn)))
+            return
+        if isinstance(fn, ast.Attribute) and name == "get":
+            qid = self._is_queue(fn.value)
+            if qid is not None and not self._get_bounded(call):
+                f.blocking.append(("Queue.get (no timeout)", anchor,
+                                   held, ast.unparse(fn)))
+            return
+        if isinstance(fn, ast.Attribute) and name == "join":
+            if self._is_queue(fn.value) is not None or \
+                    self._is_threadlike(fn.value) or \
+                    self._is_pool(fn.value):
+                if not call.args and not any(
+                        k.arg == "timeout" for k in call.keywords):
+                    f.blocking.append(("join (no timeout)", anchor,
+                                       held, ast.unparse(fn)))
+
+    @staticmethod
+    def _get_bounded(call: ast.Call) -> bool:
+        if any(k.arg == "timeout" and not (
+                isinstance(k.value, ast.Constant) and
+                k.value.value is None) for k in call.keywords):
+            return True
+        # get(False) / get(block=False) never blocks
+        if call.args and isinstance(call.args[0], ast.Constant) and \
+                call.args[0].value is False:
+            return True
+        return any(k.arg == "block" and isinstance(k.value, ast.Constant)
+                   and k.value.value is False for k in call.keywords)
+
+    def _note_spawn(self, call: ast.Call, anchor: ast.stmt):
+        target = daemon = None
+        for k in call.keywords:
+            if k.arg == "target":
+                target = k.value
+            elif k.arg == "daemon" and isinstance(k.value, ast.Constant):
+                daemon = bool(k.value.value)
+        ref = self._target_ref(target) if target is not None else None
+        self.f.spawns.append((ref, bool(daemon), anchor, "Thread"))
+
+
+# ---------------------------------------------------------------------------
+# phase 3: call resolution, summaries, and the passes
+# ---------------------------------------------------------------------------
+
+class _Analyzer:
+    def __init__(self, model: LockModel):
+        self.model = model
+        self.report = Report()
+        self._sups: Dict[str, object] = {}
+
+    # -- emission -----------------------------------------------------------
+    def _suppressed(self, rule: str, file: str, node: ast.AST) -> bool:
+        sup = self._sups.get(file)
+        if sup is None:
+            mod = next((m for m in self.model.modules.values()
+                        if m.file == file), None)
+            sup = parse_suppressions(mod.source if mod else "")
+            self._sups[file] = sup
+        return not sup.allows_node(rule, node)
+
+    def emit(self, rule: str, file: str, node: ast.AST, message: str,
+             severity: Severity, hint: Optional[str] = None):
+        if self._suppressed(rule, file, node):
+            return
+        self.report.add(Diagnostic(
+            rule, message, severity, file=file,
+            line=getattr(node, "lineno", None),
+            col=getattr(node, "col_offset", None), hint=hint))
+
+    # -- call-graph resolution ----------------------------------------------
+    def resolve_call(self, f: _Func, call: ast.Call) -> Optional[str]:
+        fn = call.func
+        mod = self.model.modules[f.module]
+        cls = mod.classes.get(f.cls) if f.cls else None
+        if isinstance(fn, ast.Name):
+            n = fn.id
+            if n in f.local_funcs:
+                return f.local_funcs[n]
+            if n in mod.functions:
+                return mod.functions[n]
+            fi = mod.from_imports.get(n)
+            if fi is not None:
+                key = f"{fi[0]}.{fi[1]}"
+                if key in self.model.funcs:
+                    return key
+            return None
+        if not (isinstance(fn, ast.Attribute) and
+                isinstance(fn.value, (ast.Name, ast.Attribute))):
+            return None
+        meth = fn.attr
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and cls is not None:
+                key = cls.methods.get(meth)
+                if key is not None:
+                    cls.intra_calls.setdefault(meth, [])
+                    return key
+                return None
+            # module alias:  monitor.stat_add(...)
+            mk = mod.imports.get(base.id)
+            if mk is None and base.id in mod.from_imports:
+                fmk, attr = mod.from_imports[base.id]
+                # from pkg import module  /  from module import instance
+                cand = f"{fmk}.{attr}"
+                if cand in self.model.modules:
+                    mk = cand
+                else:
+                    om = self.model.modules.get(fmk)
+                    if om is not None and attr in om.instances:
+                        ckey = om.instances[attr]
+                        return self._method_of(ckey, meth)
+            if mk is not None:
+                om = self.model.modules.get(mk)
+                if om is not None:
+                    if meth in om.functions:
+                        return om.functions[meth]
+                    if meth in om.instances:      # mod.inst(...)? rare
+                        return None
+            # module-level instance in the same module
+            if base.id in mod.instances:
+                return self._method_of(mod.instances[base.id], meth)
+            # local/class instance via  x = ClassName(...)
+            return None
+        # self.attr.method(): instance field of a known class
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and cls is not None:
+            cname = cls.attr_instances.get(base.attr)
+            if cname is not None:
+                for m in self.model.modules.values():
+                    if cname in m.classes:
+                        return m.classes[cname].methods.get(meth)
+        return None
+
+    def _method_of(self, class_key: str, meth: str) -> Optional[str]:
+        for m in self.model.modules.values():
+            for c in m.classes.values():
+                if c.key == class_key:
+                    return c.methods.get(meth)
+        return None
+
+    # -- summaries ----------------------------------------------------------
+    def build(self):
+        model = self.model
+        # resolve every call site once
+        self.resolved: Dict[Tuple[str, int], Optional[str]] = {}
+        for f in model.funcs.values():
+            for cs in f.calls:
+                key = self.resolve_call(f, cs.expr)
+                self.resolved[(f.key, id(cs.expr))] = key
+                if key is not None:
+                    model.callees.setdefault(f.key, set()).add(key)
+                    model.callers.setdefault(key, set()).add(f.key)
+                    # same-class call-site lock context (PTA404 exemption)
+                    cf = model.funcs.get(key)
+                    if cf is not None and cf.cls == f.cls and \
+                            cf.module == f.module and f.cls is not None:
+                        cls = model.modules[f.module].classes[f.cls]
+                        cls.intra_calls.setdefault(
+                            cf.name, []).append(bool(cs.held))
+        # effective lock sets (direct + nested defs + callees), fixpoint
+        self.eff: Dict[str, Set[str]] = {
+            k: {a for a, _, _ in f.acquires} for k, f in
+            model.funcs.items()}
+        for k, f in model.funcs.items():
+            for nk in f.nested:
+                self.eff[k] |= self.eff.get(nk, set())
+        self._fixpoint(self.eff)
+        # blocking summaries, fixpoint over the same graph
+        self.blocks: Dict[str, Set[str]] = {
+            k: {kind for kind, _, _, _ in f.blocking}
+            for k, f in model.funcs.items()}
+        for k, f in model.funcs.items():
+            for nk in f.nested:
+                pass          # nested defs run later, not on this path
+        self._fixpoint(self.blocks)
+
+    def _fixpoint(self, table: Dict[str, Set[str]],
+                  rounds: Optional[int] = None):
+        # converges in at most |funcs| rounds (summaries only grow and
+        # propagate one call-graph level per sweep); the cap is a
+        # cycle-safety bound, never a silent truncation of deep chains
+        if rounds is None:
+            rounds = len(self.model.funcs) + 1
+        for _ in range(max(1, rounds)):
+            changed = False
+            for k, callees in self.model.callees.items():
+                if k.startswith("<"):
+                    continue
+                cur = table.setdefault(k, set())
+                before = len(cur)
+                for c in callees:
+                    cur |= table.get(c, set())
+                changed |= len(cur) != before
+            if not changed:
+                return
+
+    # -- PTA401: acquisition graph + cycles ---------------------------------
+    def check_lock_order(self):
+        model = self.model
+        edges: Dict[str, Dict[str, Tuple[str, ast.AST]]] = {}
+
+        def add_edge(a: str, b: str, file: str, node: ast.AST):
+            slot = edges.setdefault(a, {})
+            prev = slot.get(b)
+            if prev is None or (file, getattr(node, "lineno", 0)) < \
+                    (prev[0], getattr(prev[1], "lineno", 0)):
+                slot[b] = (file, node)
+
+        for f in model.funcs.values():
+            for lock_key, node, held in f.acquires:
+                for h in held:
+                    if h != lock_key:
+                        add_edge(h, lock_key, f.file, node)
+                if lock_key in held:
+                    # direct nested re-acquire: unconditional deadlock
+                    # on a non-reentrant lock, no call graph needed
+                    d = model.lock_def(lock_key)
+                    if d is not None and not d.reentrant:
+                        self.emit(
+                            "PTA401", f.file, node,
+                            f"self-deadlock: non-reentrant lock "
+                            f"`{lock_key}` re-acquired while already "
+                            "held on this path — the thread blocks on "
+                            "itself unconditionally", Severity.ERROR,
+                            hint="make it an rlock, or drop the inner "
+                                 "acquisition")
+            for cs in f.calls:
+                if not cs.held:
+                    continue
+                callee = self.resolved.get((f.key, id(cs.expr)))
+                if callee is None:
+                    continue
+                for lk in self.eff.get(callee, ()):
+                    for h in cs.held:
+                        if h != lk:
+                            add_edge(h, lk, f.file, cs.node)
+                    # self-deadlock: the held lock re-acquired downstream
+                    for h in cs.held:
+                        if lk == h:
+                            d = model.lock_def(h)
+                            if d is not None and not d.reentrant:
+                                self.emit(
+                                    "PTA401", f.file, cs.node,
+                                    f"self-deadlock: non-reentrant lock "
+                                    f"`{h}` is already held here and "
+                                    f"`{callee}` (re)acquires it",
+                                    Severity.ERROR,
+                                    hint="make it an rlock, or hoist "
+                                         "the call out of the locked "
+                                         "region")
+        # SCCs over the edge graph
+        for cycle in _find_cycles({a: set(bs) for a, bs in
+                                   edges.items()}):
+            sites = []
+            for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+                site = edges.get(a, {}).get(b)
+                if site is not None:
+                    sites.append((a, b, site))
+            if not sites:
+                continue
+            # a pragma on ANY edge of the cycle breaks it: the user is
+            # asserting that edge cannot race (e.g. runs before the
+            # threads exist), which dissolves the whole cycle
+            if any(self._suppressed("PTA401", fl, nd)
+                   for _, _, (fl, nd) in sites):
+                continue
+            sites.sort(key=lambda s: (s[2][0],
+                                      getattr(s[2][1], "lineno", 0)))
+            a, b, (file, node) = sites[0]
+            loop = " -> ".join(cycle + [cycle[0]])
+            others = "; ".join(
+                f"{x}->{y} at {fl}:{getattr(nd, 'lineno', '?')}"
+                for x, y, (fl, nd) in sites[1:]) or "single edge"
+            self.emit(
+                "PTA401", file, node,
+                f"lock-order inversion: static acquisition cycle "
+                f"{loop} (this edge acquires `{b}` while holding "
+                f"`{a}`; reverse edge(s): {others}) — two threads "
+                "taking the ends in opposite order deadlock",
+                Severity.ERROR,
+                hint="pick one global order for these locks (the "
+                     "runtime watchdog names the same cycle in a "
+                     "locks.cycle flight event under "
+                     "FLAGS_lock_watchdog)")
+
+    # -- PTA402: blocking under a held lock ---------------------------------
+    def check_blocking(self):
+        for f in self.model.funcs.values():
+            for kind, node, held, detail in f.blocking:
+                if held:
+                    self.emit(
+                        "PTA402", f.file, node,
+                        f"blocking call `{detail}` ({kind}) while "
+                        f"holding `{held[-1]}` — every other thread "
+                        "needing the lock stalls behind the I/O wait",
+                        Severity.WARNING,
+                        hint="narrow the lock scope, or bound the call "
+                             "with a timeout")
+            for cs in f.calls:
+                if not cs.held:
+                    continue
+                callee = self.resolved.get((f.key, id(cs.expr)))
+                if callee is None:
+                    continue
+                kinds = self.blocks.get(callee, ())
+                if kinds:
+                    self.emit(
+                        "PTA402", f.file, cs.node,
+                        f"call to `{callee}` while holding "
+                        f"`{cs.held[-1]}` — the callee blocks "
+                        f"({', '.join(sorted(kinds))})",
+                        Severity.WARNING,
+                        hint="narrow the lock scope, or bound the "
+                             "callee's wait with a timeout")
+
+    # -- PTA403: unguarded shared writes from threads -----------------------
+    def check_thread_writes(self):
+        model = self.model
+        # thread-entry closure over the resolved call graph
+        roots: Set[str] = set()
+        for f in model.funcs.values():
+            for ref, _daemon, _node, _how in f.spawns:
+                if ref is not None:
+                    roots.add(ref)
+        thread_set = _closure(roots, model.callees)
+        main_callers: Dict[str, bool] = {}
+        for k in thread_set:
+            main_callers[k] = any(c not in thread_set
+                                  for c in model.callers.get(k, ()))
+        for k in sorted(thread_set):
+            f = model.funcs.get(k)
+            if f is None or f.cls is None:
+                continue
+            cls = model.modules[f.module].classes.get(f.cls)
+            if cls is None:
+                continue
+            for attr, node, under_lock in f.self_writes:
+                if under_lock:
+                    continue
+                # a private method whose every same-class call site
+                # holds a lock is guarded by its callers (the
+                # FlightRecorder._buf idiom) — same exemption as PTA404
+                if f.name.startswith("_"):
+                    sites = cls.intra_calls.get(f.name, [])
+                    if sites and all(sites):
+                        continue
+                shared = main_callers.get(k, False)
+                if not shared:
+                    for ok, other in (
+                            (n, model.funcs.get(mk))
+                            for n, mk in cls.methods.items()):
+                        if other is None or other.key == k or \
+                                other.key in thread_set or \
+                                ok == "__init__":
+                            continue
+                        if attr in other.self_reads or any(
+                                a == attr for a, _, _ in
+                                other.self_writes):
+                            shared = True
+                            break
+                if shared:
+                    self.emit(
+                        "PTA403", f.file, node,
+                        f"`self.{attr}` written on a thread/executor "
+                        f"path (`{f.key}`) with no lock held, and "
+                        "touched from non-thread methods too — "
+                        "concurrent read-modify-write loses updates",
+                        Severity.WARNING,
+                        hint="guard both sides with one lock, or keep "
+                             "the attribute single-threaded")
+
+    # -- PTA404: check-then-act lazy init -----------------------------------
+    def check_lazy_init(self):
+        model = self.model
+        for f in model.funcs.values():
+            mod = model.modules[f.module]
+            cls = mod.classes.get(f.cls) if f.cls else None
+            for desc, node, under_lock, kind in f.lazy_inits:
+                if under_lock:
+                    continue
+                # shared-state scope gate: an attribute is a finding
+                # only in a class that owns concurrency structure (its
+                # own locks/queues/pools/threads); a module global only
+                # in a module that owns locks.  A lockless value class
+                # (Tensor) doing lazy init is not a thread hazard.
+                if kind == "attr":
+                    if cls is None or not (
+                            cls.lock_attrs or cls.queue_attrs or
+                            cls.pool_attrs or cls.thread_attrs):
+                        continue
+                elif not mod.locks:
+                    continue
+                # exemption: a private method whose every same-class
+                # call site holds a lock IS guarded — by its callers
+                if cls is not None and f.name.startswith("_"):
+                    sites = cls.intra_calls.get(f.name, [])
+                    if sites and all(sites):
+                        continue
+                self.emit(
+                    "PTA404", f.file, node,
+                    f"check-then-act lazy init of `{desc}` outside any "
+                    "lock — two threads can both see it unset and both "
+                    "initialize (lost state, double resource)",
+                    Severity.WARNING,
+                    hint="initialize under the owning lock "
+                         "(double-checked), or eagerly in __init__")
+
+    # -- PTA405: locks in finalizer context ---------------------------------
+    def check_finalizer_locks(self):
+        model = self.model
+        roots = {k for k, f in model.funcs.items() if f.finalizer}
+        roots |= model.callees.get("<finalizers>", set())
+        roots |= model.callees.get("<signal-handlers>", set())
+        roots = {r for r in roots if r in model.funcs}
+        for r in sorted(roots):
+            f = model.funcs[r]
+            ctx = f.finalizer or (
+                "signal handler" if r in model.callees.get(
+                    "<signal-handlers>", ()) else "atexit")
+            bad = []
+            for k in sorted(_closure({r}, model.callees)):
+                for lk in sorted({a for a, _, _ in
+                                  model.funcs[k].acquires}
+                                 if k in model.funcs else ()):
+                    d = model.lock_def(lk)
+                    if d is not None and not d.reentrant and \
+                            lk not in bad:
+                        bad.append(lk)
+            if bad:
+                self.emit(
+                    "PTA405", f.file, f.node,
+                    f"`{f.name}` runs in {ctx} context and (possibly "
+                    f"transitively) acquires non-reentrant lock(s) "
+                    f"{', '.join(bad)} — if the interrupted thread "
+                    "already holds one, the process self-deadlocks "
+                    "(the FlightRecorder SIGTERM bug class)",
+                    Severity.WARNING,
+                    hint="use a reentrant lock (locks.rlock) on every "
+                         "lock a finalizer path can touch, or defer "
+                         "the work out of the handler")
+
+    # -- PTA406: queue get/task_done imbalance ------------------------------
+    def check_queue_protocol(self):
+        model = self.model
+        gets: Dict[str, List[Tuple[_Func, ast.AST]]] = {}
+        dones: Dict[str, List[Tuple[_Func, ast.AST, bool]]] = {}
+        joins: Dict[str, List[Tuple[_Func, ast.AST]]] = {}
+        for f in model.funcs.values():
+            for q, node in f.q_gets:
+                gets.setdefault(q, []).append((f, node))
+            for q, node, fin in f.q_task_dones:
+                dones.setdefault(q, []).append((f, node, fin))
+            for q, node in f.q_joins:
+                joins.setdefault(q, []).append((f, node))
+        for q, dlist in dones.items():
+            if q not in gets:
+                continue
+            for f, node, in_finally in dlist:
+                if not in_finally:
+                    self.emit(
+                        "PTA406", f.file, node,
+                        f"`task_done()` on `{q}` outside a finally: an "
+                        "exception between get() and task_done() "
+                        "undercounts, and join() waits forever",
+                        Severity.WARNING,
+                        hint="call task_done() in a try/finally around "
+                             "the work after get()")
+        for q, jlist in joins.items():
+            if q in gets and q not in dones:
+                for f, node in jlist:
+                    self.emit(
+                        "PTA406", f.file, node,
+                        f"`join()` on `{q}` but its consumers never "
+                        "call task_done() — join() blocks forever "
+                        "once anything was enqueued",
+                        Severity.WARNING,
+                        hint="pair every get() with task_done(), or "
+                             "join the worker thread instead")
+
+    # -- PTA407: daemon threads on crash-safe write paths -------------------
+    def check_daemon_writers(self):
+        model = self.model
+        for f in model.funcs.values():
+            for ref, daemon, node, how in f.spawns:
+                if not daemon or ref is None:
+                    continue
+                for k in sorted(_closure({ref}, model.callees)):
+                    kf = model.funcs.get(k)
+                    if kf is not None and kf.crash_safe_writes:
+                        self.emit(
+                            "PTA407", f.file, node,
+                            f"daemon thread target `{ref}` reaches a "
+                            f"crash-safe write (`atomic_write` in "
+                            f"`{k}`) — interpreter exit kills daemon "
+                            "threads mid-call; this is safe ONLY "
+                            "because the write is tmp+rename",
+                            Severity.WARNING,
+                            hint="make the thread non-daemon with a "
+                                 "bounded join on shutdown, or accept "
+                                 "torn-tmp garbage and say so with a "
+                                 "pragma")
+                        break
+
+
+def _closure(roots: Set[str], callees: Dict[str, Set[str]]) -> Set[str]:
+    out = set(roots)
+    stack = list(roots)
+    while stack:
+        k = stack.pop()
+        for c in callees.get(k, ()):
+            if c not in out:
+                out.add(c)
+                stack.append(c)
+    return out
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Cycles in the acquisition digraph: one representative simple
+    cycle per non-trivial SCC (iterative Tarjan), deterministic order."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+    nodes = sorted(set(graph) | {b for bs in graph.values() for b in bs})
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+    # representative cycle per SCC: backtracking DFS for a TRUE simple
+    # cycle through the smallest member (a greedy walk can dead-end and
+    # return a path whose closing edge does not exist — a reported
+    # "cycle" must be one the edge graph actually contains)
+    cycles = []
+    for scc in sccs:
+        members = set(scc)
+        start = scc[0]
+        path = [start]
+        on_path = {start}
+        iters = [iter(sorted(n for n in graph.get(start, ())
+                             if n in members))]
+        while iters:
+            advanced = False
+            for nxt in iters[-1]:
+                if nxt == start:
+                    cycles.append(list(path))
+                    iters = []
+                    advanced = True
+                    break
+                if nxt not in on_path:
+                    path.append(nxt)
+                    on_path.add(nxt)
+                    iters.append(iter(sorted(
+                        n for n in graph.get(nxt, ()) if n in members)))
+                    advanced = True
+                    break
+            if not advanced:
+                iters.pop()
+                on_path.discard(path.pop())
+    return cycles
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def analyze_sources(sources: Dict[str, str],
+                    disable: Sequence[str] = ()) -> Report:
+    """Run the PTA4xx pass family over ``{filename: source}`` as ONE
+    model (cross-file acquisition edges included)."""
+    model = LockModel()
+    scanners = []
+    report = Report()
+    for path in sorted(sources):
+        src = sources[path]
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            report.add(Diagnostic(
+                "PTA401", f"file does not parse: {e}", Severity.ERROR,
+                file=path, line=e.lineno))
+            continue
+        key = _module_name(path, None)
+        sc = _ModuleScanner(model, key, path, tree, src)
+        sc.scan()
+        scanners.append((sc, tree))
+        report.files_seen.append(path)
+    for sc, tree in scanners:
+        mod = sc.m
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FuncWalker(model, mod, None, stmt,
+                            f"{mod.key}.{stmt.name}").run()
+            elif isinstance(stmt, ast.ClassDef):
+                cls = mod.classes[stmt.name]
+                for meth in stmt.body:
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        _FuncWalker(model, mod, cls, meth,
+                                    f"{cls.key}.{meth.name}").run()
+    an = _Analyzer(model)
+    an.build()
+    an.check_lock_order()
+    an.check_blocking()
+    an.check_thread_writes()
+    an.check_lazy_init()
+    an.check_finalizer_locks()
+    an.check_queue_protocol()
+    an.check_daemon_writers()
+    out = an.report
+    report.extend(out)
+    return report.filter(disable=disable)
+
+
+def analyze_files(paths: Sequence[str],
+                  disable: Sequence[str] = ()) -> Report:
+    """Concurrency-analyze a set of files as one whole-repo model.  An
+    unreadable path degrades to one error diagnostic; every other
+    file's findings survive."""
+    sources = {}
+    unreadable = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                sources[p] = f.read()
+        except OSError as e:
+            unreadable.append(Diagnostic(
+                "PTA401", f"unreadable: {e}", Severity.ERROR, file=p))
+    report = analyze_sources(sources, disable=disable)
+    report.extend(d for d in unreadable if d.rule not in set(disable))
+    return report
+
+
+def lint_threads_source(source: str, filename: str = "fixture.py",
+                        disable: Sequence[str] = ()) -> Report:
+    """One-source convenience wrapper (tests)."""
+    return analyze_sources({filename: source}, disable=disable)

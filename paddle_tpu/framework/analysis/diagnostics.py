@@ -227,6 +227,31 @@ class Suppressions:
                 return False
         return True
 
+    def allows_node(self, rule: str, node) -> bool:
+        """Node-aware form of :meth:`allows`: a pragma anywhere in the
+        statement's *header span* suppresses — from the first decorator
+        line of a decorated ``def`` through the line before its first
+        body statement, or across every line of a multi-line simple
+        statement / ``with`` header.  This is what lets the pragma ride
+        the line a human would naturally put it on (the decorator, the
+        last line of a wrapped ``with``) instead of only the line the
+        AST happens to anchor."""
+        lo = getattr(node, "lineno", None)
+        if node is None or lo is None:
+            return self.allows(rule, None)
+        for d in getattr(node, "decorator_list", None) or []:
+            d_line = getattr(d, "lineno", None)
+            if d_line is not None:
+                lo = min(lo, d_line)
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body and \
+                getattr(body[0], "lineno", None) is not None:
+            hi = body[0].lineno - 1        # compound stmt: header only
+        else:
+            hi = getattr(node, "end_lineno", None) or lo
+        return all(self.allows(rule, ln)
+                   for ln in range(lo, max(lo, hi) + 1))
+
 
 def parse_suppressions(source: str) -> Suppressions:
     sup = Suppressions()

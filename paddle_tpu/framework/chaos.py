@@ -80,6 +80,15 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         being recorded must never crash on its
                         recorder; ``mode="latency"`` a slow disk the
                         append simply absorbs
+``locks.observe``       head of every lock-watchdog observation
+                        (framework/locks.py LockWatchdog.note_acquire,
+                        armed via FLAGS_lock_watchdog) —
+                        ``mode="error"`` is broken watchdog bookkeeping
+                        the observation path must swallow and count
+                        (``lock_watchdog_errors_total``): the watcher
+                        must never deadlock or crash the watched lock;
+                        ``mode="latency"`` a slow observation the
+                        acquire simply absorbs
 ``collector.rpc``       head of every telemetry push the
                         fire-and-forget sender thread attempts
                         (framework/collector.py CollectorClient) —
@@ -129,7 +138,8 @@ FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "ckpt.save", "download.fetch", "train.step_grads",
                 "elastic.lease", "elastic.worker_hang",
                 "health.detector", "zero.collective",
-                "numerics.observe", "runlog.observe", "collector.rpc")
+                "numerics.observe", "runlog.observe", "collector.rpc",
+                "locks.observe")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)
@@ -248,7 +258,11 @@ class ChaosRegistry:
             self.armed = bool(self._specs)
 
     def reseed(self, seed: int):
-        self._rng = np.random.default_rng(seed)
+        # under the registry lock: fire() reads the generator under it,
+        # and a reseed racing a fire must swap the reference atomically
+        # with the schedule state (PTA403)
+        with self._lock:
+            self._rng = np.random.default_rng(seed)
 
     def fire(self, name: str, payload: Any = None, meta: dict = None):
         spec = self._specs.get(name)

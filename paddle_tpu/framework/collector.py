@@ -63,7 +63,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework import chaos, locks, monitor
 from paddle_tpu.framework.flags import flag
 from paddle_tpu.framework.observability import flight, tracer
 
@@ -152,7 +152,7 @@ _HIST_KEYS = ("count", "sum", "mean", "p50", "p95", "p99", "max")
 # over a bounded window of recent durations)
 _SPAN_WINDOW = 512
 _span_cursors: Dict[str, dict] = {}
-_span_lock = threading.Lock()
+_span_lock = locks.lock("collector.spans")
 
 
 def _own_span_rows(path: str) -> List[dict]:
@@ -331,7 +331,10 @@ class CollectorClient:
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, cap))
         self._stop = threading.Event()
         self._seq = 0
-        self._seq_lock = threading.Lock()
+        # guards the push seq AND the drop counter: _drop runs on both
+        # the caller thread (queue full) and the sender thread (send
+        # failure), and an unlocked += loses counts (PTA403)
+        self._seq_lock = locks.lock("collector.client.seq")
         # per-INCARNATION identity (the PsClient._push_ident idiom): an
         # elastic-restarted worker reuses its name but restarts seq at
         # 1 — without this stamp the collector would read the rewound
@@ -373,7 +376,8 @@ class CollectorClient:
             return False
 
     def _drop(self):
-        self.dropped += 1
+        with self._seq_lock:
+            self.dropped += 1
         monitor.stat_add("collector_dropped_total")
 
     def _close(self):
@@ -388,7 +392,7 @@ class CollectorClient:
         chaos.fault_point("collector.rpc",  # pta: disable=PTA301 (fire-and-forget by contract: a failed push is dropped and counted, never retried or escalated into the observed process)
                           meta={"endpoint": self.endpoint,
                                 "seq": item["seq"]})
-        if self._sock is None:
+        if self._sock is None:  # pta: disable=PTA404 (sender-thread-only state: _send_one/_close run exclusively on the collector-push thread, so the lazy redial is single-threaded)
             host, port = self.endpoint.rsplit(":", 1)
             self._sock = socket.create_connection(
                 (host, int(port)), timeout=self.timeout)
@@ -528,7 +532,7 @@ class CollectorServer:
         self.ledger_path = ledger_path
         self.on_straggler = on_straggler
         self.clock = clock or time.time
-        self._lock = threading.Lock()
+        self._lock = locks.lock("collector.server.state")
         self._workers: Dict[str, _WorkerState] = {}
         self._tables: Dict[str, dict] = {}
         self._flight: deque = deque(maxlen=max(1, int(flight_capacity)))
@@ -540,11 +544,18 @@ class CollectorServer:
         self.host, self.port = self._tcp.server_address
         self.endpoint = f"{self.host}:{self.port}"
         self._thread: Optional[threading.Thread] = None
+        # lifecycle latch: _serving is flipped from the owner thread
+        # AND from the dispatch thread a remote `shutdown` op spawns —
+        # the check-and-clear must be atomic or two racing shutdowns
+        # both call BaseServer.shutdown() (PTA403/404, the bug class of
+        # the original shutdown-on-never-started-server deadlock)
+        self._life_lock = locks.lock("collector.server.lifecycle")
         self._serving = False
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "CollectorServer":
-        self._serving = True
+        with self._life_lock:
+            self._serving = True
         self._thread = threading.Thread(target=self._tcp.serve_forever,
                                         daemon=True,
                                         name="collector-server")
@@ -552,17 +563,21 @@ class CollectorServer:
         return self
 
     def serve_forever(self):
-        self._serving = True
+        with self._life_lock:
+            self._serving = True
         self._tcp.serve_forever()
 
     def shutdown(self):
         # BaseServer.shutdown() waits for a serve_forever loop to
         # acknowledge — on a server that was never started it would
         # wait forever, and an aggregation-only CollectorServer (tests
-        # drive _handle_report directly) is legitimate
-        if self._serving:
+        # drive _handle_report directly) is legitimate.  The atomic
+        # swap also makes concurrent shutdowns idempotent: exactly one
+        # caller sees serving=True and stops the loop.
+        with self._life_lock:
+            serving, self._serving = self._serving, False
+        if serving:
             self._tcp.shutdown()
-            self._serving = False
         self._tcp.server_close()
         if self._thread is not None:
             self._thread.join(timeout=2.0)

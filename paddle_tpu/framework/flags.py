@@ -225,6 +225,23 @@ define_flag("ps_hot_row_k", 0,
             "per-step observability work is opt-in in this repo "
             "(FLAGS_numerics precedent); 32 is the recommended "
             "serving-telemetry setting")
+# concurrency tier (framework/locks.py runtime lock-order watchdog):
+define_flag("lock_watchdog", False,
+            "arm the runtime lock-order watchdog: every tracked lock "
+            "(locks.lock/locks.rlock — adopted by the PS service, "
+            "cluster collector, ingest pipeline, and elastic agent) "
+            "records per-thread acquisition order into a global "
+            "held-before graph; a cycle fires a locks.cycle flight "
+            "event naming the cycle, a hold past "
+            "FLAGS_lock_hold_warn_ms fires locks.long_hold, and "
+            "lock_waits_total/lock_hold_ms metrics export.  The "
+            "watchdog NEVER raises (locks.observe chaos point + "
+            "swallow-and-count guard).  Off (default): one flag "
+            "lookup per acquire on top of the plain primitive")
+define_flag("lock_hold_warn_ms", 1000.0,
+            "hold time (ms) past which an armed lock watchdog fires a "
+            "locks.long_hold flight event on release; 0 disables the "
+            "long-hold check (the hold histogram still records)")
 # perf health tier (framework/health.py detectors + compile/memory
 # observability):
 define_flag("health_detectors", "",

@@ -47,7 +47,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from paddle_tpu.framework import monitor
+from paddle_tpu.framework import locks, monitor
 from paddle_tpu.framework.flags import flag
 
 __all__ = ["SpanContext", "Span", "Tracer", "tracer", "FlightRecorder",
@@ -190,7 +190,7 @@ class Tracer:
         self.label = label or os.environ.get(
             "PADDLE_TRACE_LABEL") or f"pid{os.getpid()}"
         self._file = None
-        self._file_lock = threading.Lock()
+        self._file_lock = locks.lock("obs.tracer.file")
         self._local = threading.local()
         self._checked_env = trace_dir is not None
         self.clock_offset = 0.0
@@ -199,7 +199,7 @@ class Tracer:
     # -- enablement ---------------------------------------------------------
     @property
     def enabled(self) -> bool:
-        if not self._checked_env:
+        if not self._checked_env:  # pta: disable=PTA404 (idempotent env re-read: racing arm-from-env passes compute identical values, and span writes re-check under _file_lock)
             # lazy env arming, chaos-style: a launcher can turn tracing
             # on for a whole child tree via FLAGS_trace_dir alone
             self._checked_env = True
@@ -427,8 +427,9 @@ class FlightRecorder:
         # reentrant: the SIGTERM crash handler dumps the recorder from
         # a signal frame that may interrupt the main thread mid-record
         # — a plain Lock would self-deadlock exactly when the launcher
-        # kills a hung child
-        self._lock = threading.RLock()
+        # kills a hung child (the PTA405 rule exists because of this
+        # line; the tracked rlock keeps it visible to the watchdog)
+        self._lock = locks.rlock("obs.flight")
         self.dropped = 0
         # per-kind lifetime totals (NOT ring-bounded): the run ledger's
         # "flight events by kind" capture must survive ring eviction
@@ -667,7 +668,7 @@ class MetricsReporter:
             if d:
                 os.makedirs(d, exist_ok=True)
             LocalFS().atomic_write(self.path, text)
-            self.writes += 1
+            self.writes += 1  # pta: disable=PTA403 (happens-before sequencing: start()'s initial write precedes the thread, stop()'s final write follows the join — never concurrent with _loop)
         if self._collector is not None:
             from paddle_tpu.framework import collector as _collector_mod
             extra = None
@@ -678,7 +679,7 @@ class MetricsReporter:
                     extra = None
             self._collector.push(_collector_mod.local_payload(
                 since_seq=self._collector.flight_seq_sent, extra=extra))
-            self.pushes += 1
+            self.pushes += 1  # pta: disable=PTA403 (same happens-before sequencing as self.writes above)
         return text
 
     def _loop(self):
@@ -690,8 +691,13 @@ class MetricsReporter:
 
     def start(self) -> "MetricsReporter":
         self.write_once()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="metrics-reporter")
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            # daemon is deliberate: the reporter must never block
+            # interpreter exit, and the export IS tmp+rename — a
+            # mid-write kill leaves a whole old file (at worst plus a
+            # dead .tmp)
+            name="metrics-reporter")  # pta: disable=PTA407 (tmp+rename export is kill-safe; owner: observability)
         self._thread.start()
         return self
 

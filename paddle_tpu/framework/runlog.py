@@ -41,11 +41,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework import chaos, locks, monitor
 from paddle_tpu.framework.flags import flag
 
 __all__ = ["SCHEMA_VERSION", "LEDGER_NAME", "RunLedger", "run_meta",
@@ -74,7 +73,7 @@ def default_ledger_path() -> Optional[str]:
 # ---------------------------------------------------------------------------
 
 _META: Optional[dict] = None
-_META_LOCK = threading.Lock()
+_META_LOCK = locks.lock("runlog.meta")
 
 
 def run_meta(refresh: bool = False) -> dict:
@@ -140,11 +139,17 @@ _RUN_ID: Optional[str] = None
 
 
 def _run_id() -> str:
-    """One id per process, so a multi-leg run's records group."""
+    """One id per process, so a multi-leg run's records group.  Minted
+    under the meta lock: the id embeds a timestamp, so two racing first
+    callers (a TrainEpochRange capture vs a collector capture thread)
+    would otherwise mint DIFFERENT ids and split one run's records
+    (PTA404)."""
     global _RUN_ID
-    if _RUN_ID is None:
-        _RUN_ID = f"{os.getpid()}-{int(time.time() * 1e3) & 0xffffffff:x}"
-    return _RUN_ID
+    with _META_LOCK:
+        if _RUN_ID is None:
+            _RUN_ID = f"{os.getpid()}-" \
+                      f"{int(time.time() * 1e3) & 0xffffffff:x}"
+        return _RUN_ID
 
 
 # ---------------------------------------------------------------------------

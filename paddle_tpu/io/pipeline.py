@@ -48,7 +48,6 @@ from __future__ import annotations
 import json
 import os
 import pickle
-import threading
 import time
 import warnings
 from collections import deque
@@ -58,7 +57,7 @@ from typing import Any, Callable, Iterable, Optional
 import numpy as np
 
 from paddle_tpu.core import Tensor
-from paddle_tpu.framework import chaos, health, monitor
+from paddle_tpu.framework import chaos, health, locks, monitor
 from paddle_tpu.framework.flags import flag
 from paddle_tpu.io import Dataset
 
@@ -144,7 +143,7 @@ class SampleCache:
         self.misses = 0
         self._mem: dict = {}
         self._puts = 0
-        self._lock = threading.Lock()
+        self._lock = locks.lock("ingest.cache")
 
     @property
     def enabled(self) -> bool:
@@ -280,7 +279,7 @@ class SampleCache:
 
     def __setstate__(self, d):
         self.__dict__.update(d)
-        self._lock = threading.Lock()
+        self._lock = locks.lock("ingest.cache")
         self._puts = 0          # fresh process: resync on first put
 
 
@@ -462,7 +461,7 @@ class IngestPipeline:
 
     def _iter_sync(self):
         it = iter(self.loader)
-        lock, seq_box = threading.Lock(), [0]
+        lock, seq_box = locks.lock("ingest.fetch"), [0]
         t_ret = None
         while True:
             if t_ret is not None:
@@ -480,7 +479,7 @@ class IngestPipeline:
     def _iter_pipelined(self):
         from concurrent.futures import ThreadPoolExecutor
         it = iter(self.loader)
-        lock, seq_box = threading.Lock(), [0]
+        lock, seq_box = locks.lock("ingest.fetch"), [0]
         pool = ThreadPoolExecutor(max_workers=1,
                                   thread_name_prefix="ingest")
         inflight: deque = deque()

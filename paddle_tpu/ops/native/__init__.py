@@ -55,7 +55,7 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         if (not os.path.exists(_SO) or
                 os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-            _build_err = _build()
+            _build_err = _build()  # pta: disable=PTA402 (build serialization is the point: one g++ at a time, bounded by subprocess timeout=180; owner: ops.native)
             if _build_err is not None:
                 return None
         lib = ctypes.CDLL(_SO)

@@ -1,0 +1,78 @@
+"""Seeded two-lock inversion fixture — the concurrency plane's
+acceptance artifact.
+
+The SAME committed file must be caught by BOTH halves of the plane:
+
+* **statically** — ``python tools/prog_lint.py --threads
+  tests/fixtures/lock_inversion.py`` flags PTA401 (the cycle
+  ``fixture.inversion.a -> fixture.inversion.b -> fixture.inversion.a``)
+  and exits nonzero;
+* **dynamically** — ``FLAGS_lock_watchdog=1 python
+  tests/fixtures/lock_inversion.py`` executes both orders (on separate
+  threads, sequentially — the inversion is observed, never allowed to
+  actually deadlock), and the runtime watchdog names the same cycle in
+  a ``locks.cycle`` flight event while the run completes normally
+  (exit 0, ``LOCK_CYCLE <names>`` on stdout).
+
+The CI watchdog lane runs both and asserts they agree.  Deliberately a
+finding: do NOT "fix" the inversion and do NOT pragma it.
+"""
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from paddle_tpu.framework import locks  # noqa: E402
+
+
+class InversionPair:
+    """Two locks taken in opposite orders by two code paths."""
+
+    def __init__(self):
+        self.lock_a = locks.lock("fixture.inversion.a")
+        self.lock_b = locks.lock("fixture.inversion.b")
+
+    def a_then_b(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+
+    def b_then_a(self):
+        with self.lock_b:
+            with self.lock_a:
+                pass
+
+
+def run() -> list:
+    """Execute both orders on separate threads (sequentially, so the
+    fixture observes the inversion without deadlocking) and return the
+    watchdog's named cycles."""
+    pair = InversionPair()
+    for target in (pair.a_then_b, pair.b_then_a):
+        t = threading.Thread(target=target)
+        t.start()
+        t.join(timeout=10.0)
+    return locks.watchdog.cycles()
+
+
+def main() -> int:
+    from paddle_tpu.framework.flags import get_flags
+    from paddle_tpu.framework.observability import flight
+    if not get_flags("lock_watchdog")["lock_watchdog"]:
+        print("lock watchdog disarmed (set FLAGS_lock_watchdog=1)",
+              file=sys.stderr)
+        return 2
+    cycles = run()
+    events = flight.recent(8, kind="locks.cycle")
+    if not cycles or not events:
+        print("NO_CYCLE_DETECTED", file=sys.stderr)
+        return 1
+    names = sorted(set(events[-1]["attrs"]["cycle"]))
+    print("LOCK_CYCLE", " ".join(names))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,981 @@
+"""Concurrency plane test suite: the PTA4xx static pass family
+(framework.analysis.concurrency), the runtime lock watchdog
+(framework.locks), the pragma header-span handling both front ends
+share, the prog_lint CLI surfaces (--threads / --list-rules /
+--check-docs), and the acceptance contract — the committed inversion
+fixture is flagged statically AND named identically by the runtime
+watchdog, while the in-tree sources stay --threads-clean."""
+import json
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.framework import chaos, locks, monitor
+from paddle_tpu.framework.analysis import (
+    RULES, Severity, analyze_files, analyze_sources, lint_source,
+    lint_threads_source)
+from paddle_tpu.framework.flags import get_flags, set_flags
+from paddle_tpu.framework.observability import flight
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "lock_inversion.py")
+
+
+def rules_of(report):
+    return [d.rule for d in report.diagnostics]
+
+
+def tlint(src, filename="fixture.py"):
+    return lint_threads_source(textwrap.dedent(src), filename)
+
+
+@pytest.fixture
+def armed_watchdog():
+    saved = get_flags(["lock_watchdog", "lock_hold_warn_ms"])
+    locks.watchdog.reset()
+    set_flags({"lock_watchdog": True})
+    yield locks.watchdog
+    set_flags(saved)
+    locks.watchdog.reset()
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_pta4xx_registered_on_threads_frontend(self):
+        for rid in ("PTA401", "PTA402", "PTA403", "PTA404", "PTA405",
+                    "PTA406", "PTA407"):
+            assert rid in RULES
+            assert RULES[rid].frontend == "threads"
+        assert RULES["PTA401"].severity == Severity.ERROR
+
+    def test_three_frontends_share_one_registry(self):
+        frontends = {r.frontend for r in RULES.values()}
+        assert {"jaxpr", "ast", "chaos", "threads"} <= frontends
+
+
+# ---------------------------------------------------------------------------
+# PTA401: lock-order inversion
+# ---------------------------------------------------------------------------
+
+
+class TestPTA401:
+    def test_two_lock_inversion(self):
+        r = tlint("""
+            from paddle_tpu.framework import locks
+            class P:
+                def __init__(self):
+                    self.a = locks.lock("t401.a")
+                    self.b = locks.lock("t401.b")
+                def ab(self):
+                    with self.a:
+                        with self.b:
+                            pass
+                def ba(self):
+                    with self.b:
+                        with self.a:
+                            pass
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA401"]
+        assert d and d[0].severity == Severity.ERROR
+        assert "t401.a" in d[0].message and "t401.b" in d[0].message
+
+    def test_consistent_order_is_clean(self):
+        r = tlint("""
+            import threading
+            class P:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+                def one(self):
+                    with self.a:
+                        with self.b:
+                            pass
+                def two(self):
+                    with self.a:
+                        with self.b:
+                            pass
+            """)
+        assert "PTA401" not in rules_of(r)
+
+    def test_three_lock_cycle(self):
+        r = tlint("""
+            import threading
+            class P:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+                    self.c = threading.Lock()
+                def f(self):
+                    with self.a:
+                        with self.b:
+                            pass
+                def g(self):
+                    with self.b:
+                        with self.c:
+                            pass
+                def h(self):
+                    with self.c:
+                        with self.a:
+                            pass
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA401"]
+        assert len(d) == 1          # one diagnostic per cycle, not three
+
+    def test_cross_file_cycle_via_calls(self):
+        # module x holds its lock and calls into y; y holds its lock
+        # and calls back into x — an inversion no single file shows
+        a = textwrap.dedent("""
+            import threading
+            import yy
+            _lock = threading.Lock()
+            def locked_entry():
+                with _lock:
+                    yy.helper()
+            def helper():
+                with _lock:
+                    pass
+            """)
+        b = textwrap.dedent("""
+            import threading
+            import xx
+            _lock = threading.Lock()
+            def locked_entry():
+                with _lock:
+                    xx.helper()
+            def helper():
+                with _lock:
+                    pass
+            """)
+        r = analyze_sources({"xx.py": a, "yy.py": b})
+        d = [d for d in r.diagnostics if d.rule == "PTA401"]
+        assert d, r.to_text()
+        assert "xx._lock" in d[0].message and "yy._lock" in d[0].message
+
+    def test_self_deadlock_through_helper(self):
+        r = tlint("""
+            import threading
+            class P:
+                def __init__(self):
+                    self.a = threading.Lock()
+                def outer(self):
+                    with self.a:
+                        self.inner()
+                def inner(self):
+                    with self.a:
+                        pass
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA401"]
+        assert d and "self-deadlock" in d[0].message
+
+    def test_direct_nested_self_deadlock(self):
+        # the most obvious guaranteed deadlock: `with lock:` nested
+        # directly inside itself, no call graph involved
+        r = tlint("""
+            import threading
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA401"]
+        assert d and "self-deadlock" in d[0].message
+
+    def test_reported_cycle_edges_all_exist(self):
+        # regression: an SCC with a dead-end branch must never yield a
+        # representative "cycle" whose closing edge the graph lacks
+        from paddle_tpu.framework.analysis.concurrency import \
+            _find_cycles
+        graph = {"a": {"b"}, "b": {"c", "d"}, "c": {"b"}, "d": {"a"}}
+        for cycle in _find_cycles(graph):
+            for x, y in zip(cycle, cycle[1:] + cycle[:1]):
+                assert y in graph.get(x, ()), (cycle, x, y)
+
+    def test_deep_call_chain_propagates(self):
+        # regression: summary fixpoint must not truncate on chains
+        # deeper than any fixed round cap
+        chain = "\n".join(
+            f"def f{i}():\n    f{i + 1}()" for i in range(19))
+        src = (
+            "import threading, os\n"
+            "_lock = threading.Lock()\n"
+            "def top():\n"
+            "    with _lock:\n"
+            "        f0()\n"
+            + chain
+            + "\ndef f19():\n    os.fsync(3)\n")
+        r = lint_threads_source(src, "deep.py")
+        assert "PTA402" in rules_of(r), r.to_text()
+
+    def test_reentrant_self_acquire_is_clean(self):
+        r = tlint("""
+            import threading
+            class P:
+                def __init__(self):
+                    self.a = threading.RLock()
+                def outer(self):
+                    with self.a:
+                        self.inner()
+                def inner(self):
+                    with self.a:
+                        pass
+            """)
+        assert "PTA401" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# PTA402: blocking under a held lock
+# ---------------------------------------------------------------------------
+
+
+class TestPTA402:
+    def test_recv_and_fsync_under_lock(self):
+        r = tlint("""
+            import threading, os
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.sock = None
+                def f(self):
+                    with self._lock:
+                        data = self.sock.recv(4)
+                        os.fsync(3)
+            """)
+        assert rules_of(r).count("PTA402") == 2
+
+    def test_queue_get_timeout_distinction(self):
+        r = tlint("""
+            import threading, queue
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                def bad(self):
+                    with self._lock:
+                        return self._q.get()
+                def bounded(self):
+                    with self._lock:
+                        return self._q.get(timeout=0.1)
+                def nonblocking(self):
+                    with self._lock:
+                        return self._q.get(block=False)
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA402"]
+        assert len(d) == 1 and "no timeout" in d[0].message
+
+    def test_from_imported_subprocess_call(self):
+        r = tlint("""
+            import threading
+            from subprocess import run
+            _lock = threading.Lock()
+            def f():
+                with _lock:
+                    run(["make"])
+            """)
+        assert "PTA402" in rules_of(r)
+
+    def test_subprocess_under_lock_transitive(self):
+        r = tlint("""
+            import threading, subprocess
+            _lock = threading.Lock()
+            def build():
+                subprocess.run(["make"])
+            def locked_build():
+                with _lock:
+                    build()
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA402"]
+        assert d and "build" in d[0].message
+
+    def test_blocking_outside_lock_is_clean(self):
+        r = tlint("""
+            import threading, queue
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                def f(self):
+                    item = self._q.get()
+                    with self._lock:
+                        return item
+            """)
+        assert "PTA402" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# PTA403: unguarded shared writes from threads
+# ---------------------------------------------------------------------------
+
+
+class TestPTA403:
+    def test_thread_target_write_positive(self):
+        r = tlint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    self.count += 1
+                def read(self):
+                    return self.count
+            """)
+        assert "PTA403" in rules_of(r)
+
+    def test_guarded_write_is_clean(self):
+        r = tlint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    with self._lock:
+                        self.count += 1
+                def read(self):
+                    return self.count
+            """)
+        assert "PTA403" not in rules_of(r)
+
+    def test_executor_submit_counts_as_thread(self):
+        r = tlint("""
+            import threading
+            from concurrent.futures import ThreadPoolExecutor
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pool = ThreadPoolExecutor(2)
+                    self.done = 0
+                def go(self):
+                    self._pool.submit(self._task)
+                def _task(self):
+                    self.done += 1
+                def read(self):
+                    return self.done
+            """)
+        assert "PTA403" in rules_of(r)
+
+    def test_thread_private_attr_is_clean(self):
+        # written only on the thread path, never touched elsewhere
+        r = tlint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    self.scratch = 1
+            """)
+        assert "PTA403" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# PTA404: check-then-act lazy init
+# ---------------------------------------------------------------------------
+
+
+class TestPTA404:
+    def test_unlocked_lazy_init_positive(self):
+        r = tlint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._buf = None
+                def get(self):
+                    if self._buf is None:
+                        self._buf = []
+                    return self._buf
+            """)
+        assert "PTA404" in rules_of(r)
+
+    def test_double_checked_locking_is_clean(self):
+        r = tlint("""
+            import threading
+            _lock = threading.Lock()
+            _cache = None
+            def load():
+                global _cache
+                if _cache is None:
+                    with _lock:
+                        if _cache is None:
+                            _cache = {}
+                return _cache
+            """)
+        assert "PTA404" not in rules_of(r)
+
+    def test_private_method_called_under_lock_is_exempt(self):
+        r = tlint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._ring = None
+                def _buf(self):
+                    if self._ring is None:
+                        self._ring = []
+                    return self._ring
+                def record(self, ev):
+                    with self._lock:
+                        self._buf().append(ev)
+                def recent(self):
+                    with self._lock:
+                        return list(self._buf())
+            """)
+        assert "PTA404" not in rules_of(r)
+
+    def test_lockless_value_class_is_out_of_scope(self):
+        r = tlint("""
+            class Tensor:
+                def __init__(self):
+                    self._hooks = None
+                def register_hook(self, h):
+                    if self._hooks is None:
+                        self._hooks = []
+                    self._hooks.append(h)
+            """)
+        assert "PTA404" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# PTA405: locks in finalizer context
+# ---------------------------------------------------------------------------
+
+
+class TestPTA405:
+    def test_del_with_plain_lock(self):
+        r = tlint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def __del__(self):
+                    with self._lock:
+                        pass
+            """)
+        assert "PTA405" in rules_of(r)
+
+    def test_del_with_reentrant_lock_is_clean(self):
+        r = tlint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                def __del__(self):
+                    with self._lock:
+                        pass
+            """)
+        assert "PTA405" not in rules_of(r)
+
+    def test_signal_handler_transitive(self):
+        r = tlint("""
+            import threading, signal
+            _lock = threading.Lock()
+            def record():
+                with _lock:
+                    pass
+            def install():
+                def handler(sig, frame):
+                    record()
+                signal.signal(signal.SIGTERM, handler)
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA405"]
+        assert d and "signal" in d[0].message
+
+    def test_atexit_decorator_form(self):
+        r = tlint("""
+            import threading, atexit
+            _lock = threading.Lock()
+            @atexit.register
+            def cleanup():
+                with _lock:
+                    pass
+            """)
+        assert "PTA405" in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# PTA406: queue protocol
+# ---------------------------------------------------------------------------
+
+
+class TestPTA406:
+    def test_task_done_outside_finally(self):
+        r = tlint("""
+            import queue, threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                def drain(self):
+                    item = self._q.get(timeout=1)
+                    work(item)
+                    self._q.task_done()
+            """)
+        assert "PTA406" in rules_of(r)
+
+    def test_task_done_in_finally_is_clean(self):
+        r = tlint("""
+            import queue, threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                def drain(self):
+                    item = self._q.get(timeout=1)
+                    try:
+                        work(item)
+                    finally:
+                        self._q.task_done()
+            """)
+        assert "PTA406" not in rules_of(r)
+
+    def test_join_without_task_done(self):
+        r = tlint("""
+            import queue, threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+                def drain(self):
+                    return self._q.get(timeout=1)
+                def wait(self):
+                    self._q.join()
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA406"]
+        assert d and "never" in d[0].message
+
+
+# ---------------------------------------------------------------------------
+# PTA407: daemon writers
+# ---------------------------------------------------------------------------
+
+
+class TestPTA407:
+    def test_daemon_thread_reaching_atomic_write(self):
+        r = tlint("""
+            import threading
+            class R:
+                def _loop(self):
+                    self._write()
+                def _write(self):
+                    fs.atomic_write("/tmp/x", b"")
+                def start(self):
+                    threading.Thread(target=self._loop,
+                                     daemon=True).start()
+            """)
+        assert "PTA407" in rules_of(r)
+
+    def test_non_daemon_is_clean(self):
+        r = tlint("""
+            import threading
+            class R:
+                def _loop(self):
+                    fs.atomic_write("/tmp/x", b"")
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+            """)
+        assert "PTA407" not in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# pragma handling (the PR-2 gap, now load-bearing): decorated functions
+# and multi-line with headers, in BOTH AST front ends
+# ---------------------------------------------------------------------------
+
+
+class TestPragmaSpans:
+    def test_multiline_with_header_pragma_concurrency(self):
+        src = """
+            import threading
+            class P:
+                def __init__(self):
+                    self.first_lock = threading.Lock()
+                    self.second_lock = threading.Lock()
+                def ab(self):
+                    with self.first_lock:
+                        with self.second_lock:
+                            pass
+                def ba(self):
+                    with self.second_lock:
+                        with (
+                            self.first_lock
+                        ):  # pta: disable=PTA401 (proven safe: ba only runs before the pool starts)
+                            pass
+            """
+        assert "PTA401" not in rules_of(tlint(src))
+        # same source without the pragma: the finding is real
+        assert "PTA401" in rules_of(tlint(src.replace(
+            "# pta: disable=PTA401 (proven safe: ba only runs "
+            "before the pool starts)", "")))
+
+    def test_decorator_line_pragma_concurrency(self):
+        src = """
+            import threading, atexit
+            _lock = threading.Lock()
+            @atexit.register  # pta: disable=PTA405 (handler runs post-join: no thread can hold _lock)
+            def cleanup():
+                with _lock:
+                    pass
+            """
+        assert "PTA405" not in rules_of(tlint(src))
+
+    def test_multiline_if_header_pragma_ast_frontend(self):
+        src = textwrap.dedent("""
+            import jax
+            @jax.jit
+            def f(x):
+                if (x.sum() >
+                        0):  # pta: disable=PTA201 (hoisted by caller)
+                    x = x + 1
+                return x
+            """)
+        r = lint_source(src, "fixture.py")
+        assert "PTA201" not in rules_of(r)
+        r = lint_source(src.replace(
+            "# pta: disable=PTA201 (hoisted by caller)", ""),
+            "fixture.py")
+        assert "PTA201" in rules_of(r)
+
+    def test_line_pragma_still_line_scoped(self):
+        # a pragma inside a compound statement's BODY must not blanket
+        # the whole statement
+        r = tlint("""
+            import threading
+            class P:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+                def ab(self):
+                    with self.a:
+                        with self.b:
+                            x = 1  # pta: disable=PTA401
+                def ba(self):
+                    with self.b:
+                        with self.a:
+                            pass
+            """)
+        assert "PTA401" in rules_of(r)
+
+
+# ---------------------------------------------------------------------------
+# runtime watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_disarmed_records_nothing(self):
+        locks.watchdog.reset()
+        a, b = locks.lock("wd.off.a"), locks.lock("wd.off.b")
+        with a:
+            with b:
+                pass
+        assert locks.watchdog.graph() == {}
+        assert locks.held_locks() == []
+
+    def test_cycle_detection_and_flight_event(self, armed_watchdog):
+        a, b = locks.lock("wd.cyc.a"), locks.lock("wd.cyc.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        cycles = armed_watchdog.cycles()
+        assert cycles and set(cycles[0]) == {"wd.cyc.a", "wd.cyc.b"}
+        ev = [e for e in flight.recent(50, kind="locks.cycle")
+              if "wd.cyc.a" in e["attrs"]["cycle"]]
+        assert ev and ev[-1]["severity"] == "error"
+
+    def test_cycle_reported_once(self, armed_watchdog):
+        a, b = locks.lock("wd.once.a"), locks.lock("wd.once.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        named = [c for c in armed_watchdog.cycles()
+                 if "wd.once.a" in c]
+        assert len(named) == 1
+
+    def test_consistent_order_never_cycles(self, armed_watchdog):
+        a, b = locks.lock("wd.ok.a"), locks.lock("wd.ok.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert [c for c in armed_watchdog.cycles()
+                if "wd.ok.a" in c] == []
+        assert armed_watchdog.graph().get("wd.ok.a") == ["wd.ok.b"]
+
+    def test_long_hold_event_and_metrics(self, armed_watchdog):
+        set_flags({"lock_hold_warn_ms": 1.0})
+        before = monitor.get_stat("lock_long_holds_total")
+        lk = locks.lock("wd.hold")
+        with lk:
+            time.sleep(0.02)
+        assert monitor.get_stat("lock_long_holds_total") == before + 1
+        ev = [e for e in flight.recent(50, kind="locks.long_hold")
+              if e["attrs"]["lock"] == "wd.hold"]
+        assert ev and ev[-1]["attrs"]["held_ms"] >= 1.0
+        assert monitor.get_histogram("lock_hold_ms").count > 0
+
+    def test_contended_acquire_counts_wait(self, armed_watchdog):
+        lk = locks.lock("wd.wait")
+        before = monitor.get_stat("lock_waits_total")
+        release = threading.Event()
+        held = threading.Event()
+
+        def holder():
+            with lk:
+                held.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        held.wait(5.0)
+        got = lk.acquire(blocking=False)
+        assert got is False
+        release.set()
+        t.join(5.0)
+        with lk:
+            pass
+        assert monitor.get_stat("lock_waits_total") >= before + 1
+
+    def test_rlock_reentrancy_no_self_edge(self, armed_watchdog):
+        r = locks.rlock("wd.re")
+        with r:
+            with r:
+                assert locks.held_locks().count("wd.re") == 2
+        assert locks.held_locks() == []
+        assert "wd.re" not in armed_watchdog.graph().get("wd.re", [])
+
+    def test_chaos_observe_fault_is_swallowed(self, armed_watchdog):
+        chaos.reset(0)
+        lk = locks.lock("wd.chaos")
+        before = monitor.get_stat("lock_watchdog_errors_total")
+        try:
+            with chaos.inject("locks.observe", mode="error", every=1):
+                with lk:         # the acquire itself must not raise
+                    pass
+        finally:
+            chaos.reset(0)
+        assert monitor.get_stat("lock_watchdog_errors_total") > before
+
+    def test_tracked_lock_protocol(self):
+        lk = locks.lock("wd.proto")
+        assert lk.acquire() is True
+        assert lk.locked()
+        lk.release()
+        assert not lk.locked()
+        assert repr(lk) == "TrackedLock('wd.proto', lock)"
+        rk = locks.rlock("wd.proto.r")
+        assert rk.reentrant and "rlock" in repr(rk)
+
+    def test_reset_clears_graph_and_cycles(self, armed_watchdog):
+        a, b = locks.lock("wd.rst.a"), locks.lock("wd.rst.b")
+        with a:
+            with b:
+                pass
+        assert armed_watchdog.graph()
+        armed_watchdog.reset()
+        assert armed_watchdog.graph() == {} and \
+            armed_watchdog.cycles() == []
+
+    def test_unreadable_path_degrades_not_aborts(self, tmp_path):
+        bad = tmp_path / "has_finding.py"
+        bad.write_text(textwrap.dedent("""
+            import threading, os
+            _lock = threading.Lock()
+            def f():
+                with _lock:
+                    os.fsync(3)
+            """))
+        r = analyze_files([str(bad), str(tmp_path / "missing.py")])
+        msgs = [d.message for d in r.diagnostics]
+        assert any("fsync" in m for m in msgs), msgs   # finding kept
+        assert any("unreadable" in m for m in msgs)
+
+    def test_disarm_mid_hold_leaks_no_stack_entry(self, armed_watchdog):
+        # regression: disarming between acquire and release must still
+        # pop the per-thread stack entry, or a later re-armed acquire
+        # fabricates a held-before edge (and a spurious cycle)
+        a, b = locks.lock("wd.flip.a"), locks.lock("wd.flip.b")
+        a.acquire()                      # armed: entry pushed
+        set_flags({"lock_watchdog": False})
+        a.release()                      # disarmed: must still pop
+        set_flags({"lock_watchdog": True})
+        assert locks.held_locks() == []
+        with b:
+            pass
+        assert "wd.flip.a" not in armed_watchdog.graph()
+
+    def test_seen_covers_leaf_locks(self, armed_watchdog):
+        # the held-before graph only shows NESTED acquisitions; seen()
+        # must still name a leaf lock that was exercised alone (the
+        # adoption-coverage surface the verify drive checks)
+        leaf = locks.lock("wd.leaf")
+        with leaf:
+            pass
+        assert "wd.leaf" in armed_watchdog.seen()
+        assert "wd.leaf" not in armed_watchdog.graph()
+        armed_watchdog.reset()
+        assert armed_watchdog.seen() == []
+
+
+# ---------------------------------------------------------------------------
+# the acceptance contract: fixture flagged statically, watchdog names
+# the SAME cycle at runtime, in-tree sources are --threads-clean
+# ---------------------------------------------------------------------------
+
+
+class TestFixtureContract:
+    def test_static_flags_committed_fixture(self):
+        r = analyze_files([FIXTURE])
+        d = [d for d in r.diagnostics if d.rule == "PTA401"]
+        assert d, "committed inversion fixture must be flagged"
+        assert "fixture.inversion.a" in d[0].message
+        assert "fixture.inversion.b" in d[0].message
+        assert r.exit_code() == 1
+
+    def test_runtime_names_same_cycle(self, armed_watchdog):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "lock_inversion_fixture", FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        cycles = mod.run()
+        assert cycles, "watchdog must detect the fixture inversion"
+        runtime_names = set(cycles[-1])
+        r = analyze_files([FIXTURE])
+        msg = [d for d in r.diagnostics if d.rule == "PTA401"][0].message
+        assert runtime_names == {"fixture.inversion.a",
+                                 "fixture.inversion.b"}
+        for name in runtime_names:
+            assert name in msg     # both halves name the same locks
+
+    def test_in_tree_sources_threads_clean(self):
+        from tools.prog_lint import resolve_target
+        paths = resolve_target(os.path.join(REPO, "paddle_tpu"))
+        r = analyze_files(paths)
+        bad = r.errors + r.warnings
+        assert bad == [], "\n".join(d.render() for d in bad)
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_threads_mode_exit_codes(self, tmp_path):
+        from tools import prog_lint
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        assert prog_lint.main(["--threads", str(ok)]) == 0
+        assert prog_lint.main(["--threads", FIXTURE,
+                               "--format=json"]) == 1
+
+    def test_threads_json_schema(self, tmp_path, capsys):
+        from tools import prog_lint
+        prog_lint.main(["--threads", FIXTURE, "--format=json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == 1
+        assert any(f["rule"] == "PTA401" and f["frontend"] == "threads"
+                   for f in doc["findings"])
+
+    def test_list_rules_text(self, capsys):
+        from tools import prog_lint
+        assert prog_lint.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in sorted(RULES):
+            assert rid in out
+
+    def test_list_rules_json(self, capsys):
+        from tools import prog_lint
+        assert prog_lint.main(["--list-rules", "--format=json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        ids = {r["id"] for r in doc["rules"]}
+        assert ids == set(RULES)
+        for row in doc["rules"]:
+            assert set(row) == {"id", "severity", "frontend", "title"}
+
+    def test_check_docs_matches_readme(self, capsys):
+        from tools import prog_lint
+        assert prog_lint.main(["--list-rules", "--check-docs"]) == 0
+
+    def test_check_docs_catches_drift(self, tmp_path):
+        from tools.prog_lint import check_docs
+        readme = tmp_path / "README.md"
+        readme.write_text("| `PTA401` | threads | error | x |\n"
+                          "| `PTA999` | threads | warn | ghost |\n")
+        problems = check_docs(str(readme))
+        assert any("PTA999" in p for p in problems)       # undocumented
+        assert any("PTA402" in p for p in problems)       # missing
+
+
+class TestLockModelExtraction:
+    def test_wrapper_literal_names_are_graph_nodes(self):
+        from paddle_tpu.framework.analysis.concurrency import LockModel
+        r = tlint("""
+            from paddle_tpu.framework import locks
+            class C:
+                def __init__(self):
+                    self.a = locks.lock("named.explicitly")
+                def f(self):
+                    with self.a:
+                        pass
+            """)
+        assert r.diagnostics == []       # model builds, nothing to flag
+
+    def test_module_and_local_locks_resolve(self):
+        r = tlint("""
+            import threading
+            _mod_lock = threading.Lock()
+            def f():
+                local_lock = threading.Lock()
+                with _mod_lock:
+                    with local_lock:
+                        pass
+            def g():
+                with _mod_lock:
+                    pass
+            """)
+        assert "PTA401" not in rules_of(r)
+
+    def test_explicit_acquire_release_pairs_track_held(self):
+        r = tlint("""
+            import threading, os
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    self._lock.acquire()
+                    os.fsync(3)
+                    self._lock.release()
+                def g(self):
+                    self._lock.acquire()
+                    self._lock.release()
+                    os.fsync(3)
+            """)
+        d = [d for d in r.diagnostics if d.rule == "PTA402"]
+        assert len(d) == 1               # only the held-site fsync

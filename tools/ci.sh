@@ -127,6 +127,34 @@ if [ "$rc" -gt 1 ] || ! grep -q "^verdict:" "$OBSV/hist_verdict.txt"; then
 fi
 rm -rf "$OBSV"
 
+echo "== concurrency lint + lock watchdog lane (PTA4xx static; runtime cycle naming) =="
+# static half: the in-tree sources must be PTA4xx-clean (zero errors AND
+# zero warnings — every accepted pattern carries an audited pragma), the
+# rule table must match the README rows, and the committed two-lock
+# inversion fixture MUST be flagged (a pass suite that can't see the
+# seeded bug gates nothing)
+JAX_PLATFORMS=cpu python tools/prog_lint.py --threads paddle_tpu --strict
+JAX_PLATFORMS=cpu python tools/prog_lint.py --list-rules --check-docs
+rc=0
+JAX_PLATFORMS=cpu python tools/prog_lint.py --threads \
+    tests/fixtures/lock_inversion.py --format=json \
+    > /tmp/pt_threads_fixture.json || rc=$?
+if [ "$rc" != 1 ] || ! grep -q '"PTA401"' /tmp/pt_threads_fixture.json; then
+  echo "concurrency lane FAILED: inversion fixture not flagged (rc=$rc)" >&2
+  exit 1
+fi
+# dynamic half: executing the SAME fixture under FLAGS_lock_watchdog
+# must name the same cycle in a locks.cycle flight event while the run
+# completes normally (exit 0) — the static model validated by runtime
+JAX_PLATFORMS=cpu FLAGS_lock_watchdog=1 \
+    python tests/fixtures/lock_inversion.py | tee /tmp/pt_watchdog.txt
+if ! grep -q "LOCK_CYCLE fixture.inversion.a fixture.inversion.b" \
+    /tmp/pt_watchdog.txt; then
+  echo "concurrency lane FAILED: watchdog did not name the cycle" >&2
+  exit 1
+fi
+rm -f /tmp/pt_threads_fixture.json /tmp/pt_watchdog.txt
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and

@@ -77,6 +77,8 @@ MODULES = [
     "paddle_tpu.framework.numerics",
     "paddle_tpu.framework.runlog",
     "paddle_tpu.framework.collector",
+    "paddle_tpu.framework.locks",
+    "paddle_tpu.framework.analysis.concurrency",
     "paddle_tpu.distributed.fleet.metrics",
     "paddle_tpu.distributed.fleet.utils.fs",
     "paddle_tpu.utils.cpp_extension",

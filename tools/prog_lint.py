@@ -12,10 +12,19 @@ Usage:
     python tools/prog_lint.py paddle_tpu               # whole package
     python tools/prog_lint.py --zoo resnet18           # jaxpr passes
     python tools/prog_lint.py --zoo all paddle_tpu.vision.models
+    python tools/prog_lint.py --threads paddle_tpu     # PTA4xx passes
+    python tools/prog_lint.py --list-rules [--format=json]
+    python tools/prog_lint.py --list-rules --check-docs
 
 Targets are dotted module names or filesystem paths; packages recurse.
 ``--zoo`` additionally traces a vision/transformer model (tiny config,
 abstract trace — no FLOPs spent) and runs the jaxpr IR passes on it.
+``--threads`` switches the source front end to the concurrency pass
+family (PTA401-407): all target files form ONE whole-repo lock model,
+so cross-module acquisition edges and cycles are visible.
+``--list-rules`` prints the full rule table (id, severity, front end,
+title); with ``--check-docs`` it diffs the table against the README's
+rule rows and exits 1 on drift, so the docs cannot silently rot.
 Exit status: 1 if any error-severity finding survives suppression
 (``--strict`` also fails on warnings), 2 on bad invocation.
 """
@@ -348,6 +357,66 @@ def resolve_target(target: str):
     return [origin]
 
 
+def list_rules(fmt: str = "text") -> str:
+    """The full registered rule table (``--list-rules``)."""
+    import json as _json
+
+    from paddle_tpu.framework.analysis import RULES
+    rows = [{"id": r.id, "severity": str(r.severity),
+             "frontend": r.frontend, "title": r.title}
+            for r in sorted(RULES.values(), key=lambda r: r.id)]
+    if fmt == "json":
+        return _json.dumps({"rules": rows}, indent=1)
+    w = max(len(r["title"]) for r in rows)
+    lines = [f"{'id':<8} {'severity':<8} {'frontend':<8} title",
+             "-" * (28 + w)]
+    for r in rows:
+        lines.append(f"{r['id']:<8} {r['severity']:<8} "
+                     f"{r['frontend']:<8} {r['title']}")
+    return "\n".join(lines)
+
+
+def check_docs(readme_path: str = None) -> list:
+    """Diff the registered rule table against the README's rule rows
+    (``| `PTAnnn` | frontend | severity | ... |``).  Returns a list of
+    drift messages — empty when the docs match the registry."""
+    import re
+
+    from paddle_tpu.framework.analysis import RULES
+    readme_path = readme_path or os.path.join(REPO, "README.md")
+    with open(readme_path, encoding="utf-8") as f:
+        text = f.read()
+    row_re = re.compile(
+        r"^\|\s*`(PTA\d+)`\s*\|\s*([^|]+?)\s*\|\s*([^|]+?)\s*\|",
+        re.MULTILINE)
+    doc_rows = {m.group(1): (m.group(2).strip(), m.group(3).strip())
+                for m in row_re.finditer(text)}
+    problems = []
+    fe_alias = {"ast": "ast", "chaos": "ast", "jaxpr": "jaxpr",
+                "threads": "threads"}
+    for rid, info in sorted(RULES.items()):
+        if rid not in doc_rows:
+            problems.append(f"{rid}: registered but missing from the "
+                            f"README rule table")
+            continue
+        fe_doc, sev_doc = doc_rows[rid]
+        want_fe = fe_alias.get(info.frontend, info.frontend)
+        if fe_doc.lower() not in (want_fe, info.frontend):
+            problems.append(f"{rid}: README front end {fe_doc!r} != "
+                            f"registry {info.frontend!r}")
+        sev_short = {"warning": "warn", "error": "error",
+                     "info": "info"}[str(info.severity)]
+        if sev_short not in sev_doc.lower():
+            problems.append(f"{rid}: README severity {sev_doc!r} does "
+                            f"not mention registry default "
+                            f"{info.severity}")
+    for rid in sorted(doc_rows):
+        if rid not in RULES:
+            problems.append(f"{rid}: documented in README but not "
+                            "registered in any front end")
+    return problems
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="prog_lint.py", description=__doc__,
@@ -359,6 +428,15 @@ def main(argv=None) -> int:
                     metavar="ENTRY",
                     help="run the jaxpr IR passes on a model-zoo entry "
                          f"({', '.join(sorted(ZOO))}, or 'all')")
+    ap.add_argument("--threads", action="store_true",
+                    help="run the concurrency pass family (PTA401-407) "
+                         "over the targets as one whole-repo lock "
+                         "model, instead of the jit-safety lint")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the registered rule table and exit")
+    ap.add_argument("--check-docs", action="store_true",
+                    help="with --list-rules: diff the rule table "
+                         "against the README rows; exit 1 on drift")
     ap.add_argument("--disable", default="",
                     help="comma-separated rule IDs to drop (jaxpr rules "
                          "have no source line for inline pragmas)")
@@ -371,21 +449,44 @@ def main(argv=None) -> int:
     ap.add_argument("--no-cost", action="store_true",
                     help="skip the PTA106 cost report (quieter json)")
     a = ap.parse_args(argv)
+    if a.list_rules:
+        print(list_rules(a.format))
+        if a.check_docs:
+            problems = check_docs()
+            if problems:
+                for p in problems:
+                    print(f"DOC DRIFT: {p}", file=sys.stderr)
+                return 1
+            from paddle_tpu.framework.analysis import RULES
+            print(f"rule table matches README ({len(RULES)} rules)")
+        return 0
     if not a.targets and not a.zoo:
         ap.error("nothing to lint: pass a target module/path or --zoo")
     disable = [r.strip() for r in a.disable.split(",") if r.strip()]
 
     from paddle_tpu.framework.analysis import Report, lint_file
     report = Report()
-    for target in a.targets:
-        for path in resolve_target(target):
-            rel = os.path.relpath(path, REPO) \
-                if path.startswith(REPO) else path
-            sub = lint_file(path, disable=disable)
-            sub.files_seen = [rel]
-            for d in sub.diagnostics:
-                d.file = rel
-            report.extend(sub)
+    if a.threads:
+        from paddle_tpu.framework.analysis import analyze_files
+        paths = [p for target in a.targets
+                 for p in resolve_target(target)]
+        sub = analyze_files(paths, disable=disable)
+        sub.files_seen = [os.path.relpath(p, REPO)
+                          if p.startswith(REPO) else p for p in paths]
+        for d in sub.diagnostics:
+            if d.file and d.file.startswith(REPO):
+                d.file = os.path.relpath(d.file, REPO)
+        report.extend(sub)
+    else:
+        for target in a.targets:
+            for path in resolve_target(target):
+                rel = os.path.relpath(path, REPO) \
+                    if path.startswith(REPO) else path
+                sub = lint_file(path, disable=disable)
+                sub.files_seen = [rel]
+                for d in sub.diagnostics:
+                    d.file = rel
+                report.extend(sub)
 
     zoo = a.zoo
     if "all" in zoo:
