@@ -18,12 +18,21 @@ surprises.  Two front ends share one diagnostic core:
   thread-shared writes, check-then-act init, finalizer-context locks,
   queue protocol, daemon writers.  Validated at runtime by the
   ``framework/locks.py`` watchdog (``FLAGS_lock_watchdog``).
+* :mod:`.collectives` — distributed-semantics pass family (PTA5xx) over
+  shard_map/pjit regions: unreduced mapped-axis values escaping
+  replicated outputs, collective axis mismatches/double reductions,
+  gather-then-slice mixing, quantized payloads summed by collectives,
+  donation across collective boundaries, collectives under divergent
+  conditionals.  Validated at runtime by the replica-parity probe
+  (``parallel/parity.py``, ``FLAGS_replica_parity``).
 
 CLI: ``python tools/prog_lint.py <module|path> [--format=json|text]``.
 Suppression: ``# pta: disable=PTA201`` inline (see diagnostics.py).
 """
 from paddle_tpu.framework.analysis.ast_passes import (  # noqa: F401
     lint_file, lint_source)
+from paddle_tpu.framework.analysis.collectives import (  # noqa: F401
+    analyze_collectives)
 from paddle_tpu.framework.analysis.concurrency import (  # noqa: F401
     analyze_files, analyze_sources, lint_threads_source)
 from paddle_tpu.framework.analysis.diagnostics import (  # noqa: F401
@@ -32,6 +41,6 @@ from paddle_tpu.framework.analysis.jaxpr_passes import (  # noqa: F401
     analyze_callable, analyze_jaxpr, analyze_model)
 
 __all__ = ["Diagnostic", "Report", "RULES", "Severity", "analyze_jaxpr",
-           "analyze_callable", "analyze_model", "analyze_files",
-           "analyze_sources", "lint_source", "lint_file",
+           "analyze_callable", "analyze_collectives", "analyze_model",
+           "analyze_files", "analyze_sources", "lint_source", "lint_file",
            "lint_threads_source"]

@@ -27,6 +27,16 @@ PTA105    dispatch-cache defeaters baked in as constants: large arrays
           scalar closures that retrace on every new Python value
 PTA106    per-eqn FLOP/byte estimates with a top-k heaviest-ops report
 ========  ==============================================================
+
+The distributed-semantics family (PTA501-506, collectives.py) runs as
+part of :func:`analyze_jaxpr` too — free on ordinary jit programs, and
+the cost pass is shard_map-aware: inside a manual region shapes are
+already per-device, higher-order wrapper eqns (pjit/shard_map) are not
+double-counted, and collective eqns are tagged with ANALYTIC wire bytes
+(``distributed/wire.py::wire_nbytes`` on the payload encoding, scaled
+by the ring/gather traffic factor for the mesh axis size) instead of
+host-memory-moved estimates — so ``perf_report attribute`` stops
+over-counting sharded programs.
 """
 from __future__ import annotations
 
@@ -35,6 +45,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_tpu.framework.analysis.collectives import (
+    COLLECTIVE_PRIMS, run_collective_passes)
 from paddle_tpu.framework.analysis.diagnostics import (
     Diagnostic, Report, Severity, register_rule)
 
@@ -223,6 +235,13 @@ def _pass_dead_code(jaxpr, name, invar_labels, report: Report):
             for v in eqn.invars:
                 if not isinstance(v, jax.core.Literal):
                     live.add(v)
+        elif eqn.primitive.name in ("broadcast_in_dim", "iota") and \
+                all(isinstance(v, jax.core.Literal) for v in eqn.invars):
+            # a dead LITERAL materialization is free: jax's own vjp
+            # rules leave these behind (e.g. relu's custom_jvp zeros)
+            # and XLA constant-folds them — flagging would teach users
+            # to ignore PTA102
+            continue
         else:
             report.add(Diagnostic(
                 "PTA102",
@@ -368,27 +387,113 @@ def _pass_consts(jaxpr, consts, name, report: Report):
                      "static if it is genuinely a config constant"))
 
 
+# ring/gather traffic per replica as a multiple of the local payload
+# bytes, by collective family (k = mesh axis size): a psum is a ring
+# all-reduce (2(k-1)/k), all_gather pulls every peer's shard (k-1),
+# reduce-scatter/all-to-all move (k-1)/k, ppermute one full payload
+def _collective_traffic_factor(pname: str, k: int) -> float:
+    if k <= 1:
+        return 0.0
+    if pname in ("psum", "pmax", "pmin"):
+        return 2.0 * (k - 1) / k
+    if pname == "all_gather":
+        return float(k - 1)
+    if pname in ("psum_scatter", "reduce_scatter", "all_to_all"):
+        return (k - 1) / k
+    return 1.0                        # ppermute / pbroadcast
+
+
+_WIRE_OF_DTYPE = {"float32": "f32", "bfloat16": "bf16",
+                  "float16": "f16", "int8": "int8", "uint8": "int8"}
+
+
+def _collective_wire_bytes(eqn, axis_sizes: Dict[str, int]) -> int:
+    """Analytic per-replica wire bytes of one collective eqn — the
+    payload encoded per ``distributed/wire.py::wire_nbytes``, scaled by
+    the traffic factor for the collective family and axis size."""
+    from paddle_tpu.distributed.wire import wire_nbytes
+    from paddle_tpu.framework.analysis.collectives import _collective_axes
+    k = 1
+    for a in _collective_axes(eqn):
+        k *= int(axis_sizes.get(a, 1) or 1)
+    factor = _collective_traffic_factor(eqn.primitive.name, k)
+    total = 0.0
+    for v in eqn.invars:
+        aval = _aval(v)
+        dt = _np_dtype(aval)
+        if dt is None:
+            continue
+        elems = int(np.prod(getattr(aval, "shape", ()), dtype=np.int64))
+        wire = _WIRE_OF_DTYPE.get(dt.name)
+        if wire is None:              # wider ints/floats account as f32
+            total += float(elems * dt.itemsize) * factor
+        else:
+            total += float(wire_nbytes(elems, wire)) * factor
+    return int(total)
+
+
 def _pass_cost(jaxpr, name, top_k, report: Report):
     rows: List[Tuple[int, int, str]] = []
-    total_f = total_b = 0
+    total_f = total_b = coll_b = 0
     by_op: dict = {}
-    for eqn, depth in iter_eqns(jaxpr):
-        f, b = eqn_cost(eqn)
+    state = {"manual": False}
+
+    def note(pname, f, b):
+        nonlocal total_f, total_b
         total_f += f
         total_b += b
-        rows.append((f, b, eqn.primitive.name))
-        agg = by_op.setdefault(eqn.primitive.name, [0, 0, 0])
+        rows.append((f, b, pname))
+        agg = by_op.setdefault(pname, [0, 0, 0])
         agg[0] += f
         agg[1] += b
         agg[2] += 1
+
+    def walk(jx, axis_sizes):
+        nonlocal coll_b
+        for eqn in jx.eqns:
+            pname = eqn.primitive.name
+            subs = _subjaxprs(eqn)
+            if pname == "shard_map":
+                # manual region: body shapes are already PER-DEVICE —
+                # count only the body, under the region's mesh sizes
+                state["manual"] = True
+                mesh = eqn.params.get("mesh")
+                try:
+                    sizes = {a: int(s) for a, s in
+                             dict(getattr(mesh, "shape", {})).items()}
+                except TypeError:
+                    sizes = axis_sizes
+                for sub in subs:
+                    walk(sub, sizes)
+                continue
+            if pname in COLLECTIVE_PRIMS:
+                b = _collective_wire_bytes(eqn, axis_sizes)
+                coll_b += b
+                note(pname, 0, b)
+                continue
+            if subs:
+                # higher-order wrapper (pjit/scan/cond/custom_*): its
+                # cost IS its bodies' — counting the wrapper's global
+                # outputs too is exactly the sharded-program over-count
+                for sub in subs:
+                    walk(sub, axis_sizes)
+                continue
+            f, b = eqn_cost(eqn)
+            note(pname, f, b)
+
+    walk(jaxpr, {})
     # structured twin of the PTA106 diagnostics: per-primitive
     # aggregates the span<->cost join (tools/perf_report.py attribute)
-    # consumes without parsing message strings
+    # consumes without parsing message strings.  per_device=True marks
+    # totals counted inside manual regions (shard-local shapes);
+    # collective rows carry analytic wire bytes, not FLOPs
     report.cost = {
         "name": name,
         "total_flops": int(total_f),
         "total_bytes": int(total_b),
         "n_eqns": len(rows),
+        "per_device": bool(state["manual"]),
+        "collective_wire_bytes": int(coll_b),
         "by_op": [{"op": op, "flops": int(f), "bytes": int(b),
                    "count": int(c)}
                   for op, (f, b, c) in sorted(
@@ -420,9 +525,14 @@ def _pass_cost(jaxpr, name, top_k, report: Report):
 def analyze_jaxpr(closed_jaxpr, name: str = "<traced>",
                   donate_argnums: Optional[Sequence[int]] = None,
                   invar_labels: Optional[Sequence[str]] = None,
+                  outvar_labels: Optional[Sequence[str]] = None,
                   top_k: int = 5, disable: Sequence[str] = (),
                   with_cost: bool = True) -> Report:
-    """Run every jaxpr pass over a ``jax.make_jaxpr`` result."""
+    """Run every jaxpr pass over a ``jax.make_jaxpr`` result —
+    the PTA1xx family plus the distributed-semantics PTA5xx passes
+    (collectives.py; no-ops on programs without shard_map regions).
+    ``outvar_labels`` name the program outputs so a PTA501 finding can
+    say WHICH leaf escapes unreduced."""
     jaxpr = closed_jaxpr.jaxpr
     consts = list(closed_jaxpr.consts)
     report = Report()
@@ -431,6 +541,10 @@ def analyze_jaxpr(closed_jaxpr, name: str = "<traced>",
     _pass_callbacks(jaxpr, name, report)
     _pass_donation(jaxpr, name, donate_argnums, invar_labels, report)
     _pass_consts(jaxpr, consts, name, report)
+    run_collective_passes(closed_jaxpr, name, report,
+                          donate_argnums=donate_argnums,
+                          invar_labels=invar_labels,
+                          outvar_labels=outvar_labels)
     if with_cost:
         _pass_cost(jaxpr, name, top_k, report)
     return report.filter(disable=disable)

@@ -98,6 +98,16 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         train loop is bit-identical to a collector-less
                         run; ``mode="latency"`` a slow collector the
                         sender thread absorbs off the training path
+``parity.observe``      head of every replica-parity probe observation
+                        (parallel/parity.py ParityProbe.observe, armed
+                        via FLAGS_replica_parity) — ``mode="error"`` is
+                        a broken probe the observation path must
+                        swallow and count
+                        (``parity_observe_errors_total``): the watcher
+                        must never perturb or crash the watched train
+                        step (the trajectory stays bit-identical);
+                        ``mode="latency"`` a slow probe the step simply
+                        absorbs
 =====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
@@ -139,7 +149,7 @@ FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "elastic.lease", "elastic.worker_hang",
                 "health.detector", "zero.collective",
                 "numerics.observe", "runlog.observe", "collector.rpc",
-                "locks.observe")
+                "locks.observe", "parity.observe")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

@@ -298,6 +298,27 @@ define_flag("numerics_scale_collapse_k", 4,
             "numerics.scale_collapse flight event every K consecutive "
             "decreases (a scale halving K times without an intervening "
             "good streak is a systematic overflow, not a transient)")
+# distributed-semantics tier (parallel/parity.py replica-parity probe):
+define_flag("replica_parity", False,
+            "arm the runtime replica-parity probe: the train-step "
+            "classes (TrainStep and its sharded/dp variants) fold a "
+            "per-leaf bitwise hash of every fully-replicated multi-"
+            "device param/opt-state leaf through a psum-based "
+            "agreement check every FLAGS_replica_parity_every steps; "
+            "a divergent leaf fires a parity.divergence flight event "
+            "naming the first divergent leaf (the same leaf a static "
+            "PTA501 finding names) and counts "
+            "parity_divergence_total.  The probe NEVER raises "
+            "(parity.observe chaos point + swallow-and-count).  Off "
+            "(default): one flag lookup per step — the step's own "
+            "compiled computation and signature-cache keys are "
+            "byte-identical to the probe-less seed")
+define_flag("replica_parity_every", 16,
+            "replica-parity probe cadence: hash-compare replicated "
+            "state every Nth step of each armed train-step object "
+            "(the probe is one tiny fused shard_map program; at the "
+            "default cadence its cost amortizes below the op_bench "
+            "--parity-probe 2% step-time gate)")
 # continuous-perf observatory (framework/runlog.py + tools/perf_report.py):
 define_flag("runlog_dir", "",
             "directory of the persistent run ledger "

@@ -630,6 +630,12 @@ class TrainStep:
                              jax.tree_util.tree_leaves(self._opt_states)),
             "buffers": sum(int(b._data.nbytes)
                            for b in named_buffers.values())})
+        # replica-parity probe (FLAGS_replica_parity): a SEPARATE tiny
+        # jitted check over replicated multi-device leaves — the step's
+        # own cache/signature stays byte-identical armed or not, and
+        # single-device state makes it a no-op after one flag lookup
+        from paddle_tpu.parallel import parity
+        parity.maybe_observe(self, mesh=getattr(self, "mesh", None))
         if self.optimizer._lr_scheduler is not None:
             pass  # user steps the scheduler explicitly, paddle-style
         return Tensor(loss)

@@ -300,6 +300,15 @@ class CompressedAllReduceTrainStep:
                 lambda p: loss_from(p, buffers, key, list(inputs)),
                 has_aux=True)(params)
             grads = jax.tree_util.tree_map(reduce_one, grads, params)
+            # float buffers (BN running stats) derive from the replica's
+            # OWN batch shard — averaging them is what makes the P()
+            # out_spec true (the PTA501 finding this pass family
+            # surfaced: zero.py already did this, this step did not)
+            new_buffers = {
+                n: (jax.lax.pmean(b.astype(jnp.float32),
+                                  "dp").astype(b.dtype)
+                    if jnp.issubdtype(b.dtype, jnp.floating) else b)
+                for n, b in new_buffers.items()}
             return jax.lax.pmean(loss, "dp"), new_buffers, grads
 
         in_specs = (P(), P(), P()) + (P("dp"),) * n_inputs
@@ -336,6 +345,11 @@ class CompressedAllReduceTrainStep:
             p._data = new_params[n]
         for n, b in named_buffers.items():
             b._data = new_buffers[n]
+        # replica-parity probe (FLAGS_replica_parity): params here are
+        # replicated over dp — the hash-agreement check catches a
+        # compressed reduce that drifted replicas apart
+        from paddle_tpu.parallel import parity
+        parity.maybe_observe(self, mesh=self.mesh)
         return Tensor(loss)
 
 

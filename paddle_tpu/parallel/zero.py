@@ -260,7 +260,7 @@ class ShardedUpdateTrainStep:
         return {"reduce_scatter": rs, "all_gather": ag}
 
     # -- compiled step ------------------------------------------------------
-    def _build(self, n_inputs, numerics_aux: bool = False):
+    def _build_mapped(self, n_inputs, numerics_aux: bool = False):
         mesh, dp, chunk, wire = self.mesh, self.dp, self.chunk, self.wire
         specs = self._specs
         opt = self.optimizer
@@ -361,10 +361,70 @@ class ShardedUpdateTrainStep:
         if numerics_aux:
             out_specs = out_specs + (
                 {k: P() for k in numerics.AUX_KEYS},)
-        mapped = shard_map_compat(local, mesh=mesh, in_specs=in_specs,
-                                  out_specs=out_specs)
+        return shard_map_compat(local, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs)
+
+    def _build(self, n_inputs, numerics_aux: bool = False):
+        mapped = self._build_mapped(n_inputs, numerics_aux=numerics_aux)
         donate = (0, 1, 2) if self.donate else ()
         return jax.jit(mapped, donate_argnums=donate)
+
+    def analyze(self, *example_inputs, **analyze_kwargs):
+        """Static analysis of the shard-mapped step (framework.analysis
+        jaxpr + PTA5xx collective passes) on aval stand-ins — no device
+        step runs.  The mapped function is traced UNJITTED so the
+        passes see the real collective equations (reduce-scatter /
+        all-gather legs, the clip psum), with input AND output labels
+        threaded through so a PTA501 finding names the parameter leaf
+        — the same leaf the runtime replica-parity probe
+        (``parallel/parity.py``) would name."""
+        import jax.tree_util as jtu
+
+        from paddle_tpu.framework import numerics
+        from paddle_tpu.framework.analysis import analyze_jaxpr
+        self._ensure_state()
+        params = {n: p._data for n, p in self.model.named_parameters()}
+        buffers = {n: b._data for n, b in self.model.named_buffers()
+                   if b is not None}
+        aval = lambda a: a if isinstance(a, jax.ShapeDtypeStruct) \
+            else jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)  # noqa:E731
+        arrs = [i._data if isinstance(i, Tensor)
+                else i if isinstance(i, jax.ShapeDtypeStruct)
+                else jnp.asarray(i)
+                for i in example_inputs]
+        tree_avals = [jtu.tree_map(aval, t)
+                      for t in (params, self._opt_shards, buffers)]
+        labels = []
+        for prefix, tree in zip(("params", "opt", "buffers"), tree_avals):
+            flat, _ = jtu.tree_flatten_with_path(tree)
+            labels += [prefix + jtu.keystr(path) for path, _ in flat]
+        n_donated = len(labels) if self.donate else 0
+        labels += ["rng_key", "lr"] + [f"input[{i}]"
+                                       for i in range(len(arrs))]
+        # output labels mirror the step's return structure: (new_params,
+        # new_states, new_buffers, loss[, numerics aux]) — dict trees
+        # flatten sorted, exactly as the traced outputs do.  Param
+        # outputs carry the BARE leaf name (e.g. `fc1.weight`), the
+        # name the runtime replica-parity probe uses too
+        out_labels = [n for n in sorted(params)]
+        for prefix, tree in zip(("opt", "buffers"),
+                                (tree_avals[1], tree_avals[2])):
+            flat, _ = jtu.tree_flatten_with_path(tree)
+            out_labels += [prefix + jtu.keystr(path) for path, _ in flat]
+        out_labels += ["loss"]
+        armed = numerics.enabled()
+        if armed:
+            out_labels += [f"numerics.{k}" for k in
+                           sorted(numerics.AUX_KEYS)]
+        mapped = self._build_mapped(len(arrs), numerics_aux=armed)
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
+        closed = jax.make_jaxpr(mapped)(
+            *tree_avals, key_aval, lr_aval, *[aval(x) for x in arrs])
+        return analyze_jaxpr(
+            closed, name="ShardedUpdateTrainStep", invar_labels=labels,
+            outvar_labels=out_labels,
+            donate_argnums=tuple(range(n_donated)), **analyze_kwargs)
 
     # -- chaos --------------------------------------------------------------
     def _collective_guard(self):
@@ -460,6 +520,11 @@ class ShardedUpdateTrainStep:
             "opt_state": self.opt_state_bytes_per_replica(),
             "buffers": sum(int(b._data.nbytes)
                            for b in named_buffers.values())})
+        # replica-parity probe (FLAGS_replica_parity): hash-agreement
+        # over the replicated leaves every K steps; disarmed = one flag
+        # lookup, and the step's own compiled fn is untouched either way
+        from paddle_tpu.parallel import parity
+        parity.maybe_observe(self, mesh=self.mesh)
         return Tensor(loss)
 
     # -- checkpoint interop -------------------------------------------------

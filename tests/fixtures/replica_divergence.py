@@ -1,0 +1,169 @@
+"""Seeded replica-divergence fixture — the distributed-semantics
+plane's acceptance artifact.
+
+A deliberately broken two-layer data-parallel train step under
+``shard_map`` over ``dp``: ``fixture.w1``'s gradient is ``psum``-ed
+(correct), ``fixture.w2``'s is applied **locally** (the missing-reduce
+bug).  The SAME committed file must be caught by BOTH halves of the
+plane, naming the SAME leaf:
+
+* **statically** — ``python tools/prog_lint.py --collectives
+  tests/fixtures/replica_divergence.py`` flags PTA501 on the
+  ``fixture.w2`` output (claimed replicated, still dp-varying) and
+  exits nonzero;
+* **dynamically** — ``FLAGS_replica_parity=1 python
+  tests/fixtures/replica_divergence.py`` runs the broken step on a
+  dp=2 virtual CPU mesh; the replica-parity probe's hash-agreement
+  check fires a ``parity.divergence`` flight event whose
+  ``first_bad_leaf`` is ``fixture.w2`` while ``fixture.w1`` stays
+  bit-identical, and the run completes normally (exit 0,
+  ``PARITY_DIVERGENCE fixture.w2`` on stdout).
+
+``--chaos`` runs the chaos leg instead: the same probed training with a
+``parity.observe`` error injected at every probe must produce a loss
+trajectory BIT-IDENTICAL to the clean probed run (the watcher can
+never perturb the watched; ``CHAOS_PARITY_BITIDENTICAL`` on stdout).
+
+The CI distributed-semantics lane runs all three and asserts they
+agree.  Deliberately a finding: do NOT "fix" the missing psum and do
+NOT pragma it.
+"""
+import os
+import sys
+
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax                                        # noqa: E402
+import jax.numpy as jnp                           # noqa: E402
+import numpy as np                                # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+LEAVES = ("fixture.w1", "fixture.w2")
+DP = 2
+LR = 0.1
+
+
+def _mesh():
+    from paddle_tpu.parallel.mesh import make_mesh
+    return make_mesh({"dp": DP}, devices=jax.devices()[:DP])
+
+
+def _mapped_step(mesh):
+    """The UNJITTED shard-mapped step: (w1, w2, x, y) -> (w1', w2',
+    loss) with a dp-sharded batch, w1's grad psum-averaged and w2's
+    grad applied LOCALLY (the seeded bug)."""
+    from paddle_tpu.parallel.mesh import shard_map_compat
+
+    def local(w1, w2, x, y):
+        def loss_of(ws):
+            a, b = ws
+            return jnp.mean((x @ a @ b - y) ** 2)
+        loss, (g1, g2) = jax.value_and_grad(loss_of)((w1, w2))
+        g1 = jax.lax.pmean(g1, "dp")         # correct: reduced on dp
+        new_w1 = w1 - LR * g1
+        new_w2 = w2 - LR * g2                # BUG: local grad, no psum
+        return new_w1, new_w2, jax.lax.pmean(loss, "dp")
+
+    return shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P()))
+
+
+def _broken_step(mesh):
+    return jax.jit(_mapped_step(mesh))
+
+
+def collectives_report():
+    """The static half: trace the broken step and run the PTA5xx
+    passes (prog_lint --collectives imports this hook)."""
+    from paddle_tpu.framework.analysis import analyze_collectives
+    closed = jax.make_jaxpr(_mapped_step(_mesh()))(
+        jax.ShapeDtypeStruct((4, 4), jnp.float32),
+        jax.ShapeDtypeStruct((4, 2), jnp.float32),
+        jax.ShapeDtypeStruct((8, 4), jnp.float32),
+        jax.ShapeDtypeStruct((8, 2), jnp.float32))
+    return analyze_collectives(
+        closed, name="fixture.divergence",
+        invar_labels=list(LEAVES) + ["x", "y"],
+        outvar_labels=list(LEAVES) + ["loss"])
+
+
+def run(steps: int = 3, chaos_probe_error: bool = False):
+    """Execute the broken step with the parity probe observing after
+    every step.  Returns (losses, parity records)."""
+    from paddle_tpu.framework import chaos
+    from paddle_tpu.parallel.parity import ParityProbe
+    mesh = _mesh()
+    step = _broken_step(mesh)
+    rng = np.random.default_rng(0)
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("dp"))
+    w1 = jax.device_put(
+        rng.standard_normal((4, 4)).astype(np.float32), repl)
+    w2 = jax.device_put(
+        rng.standard_normal((4, 2)).astype(np.float32), repl)
+    x = jax.device_put(
+        rng.standard_normal((8, 4)).astype(np.float32), data)
+    y = jax.device_put(
+        rng.standard_normal((8, 2)).astype(np.float32), data)
+    probe = ParityProbe(mesh=mesh, every=1)
+    losses, records = [], []
+    ctx = chaos.inject("parity.observe", mode="error", every=1) \
+        if chaos_probe_error else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        for i in range(steps):
+            w1, w2, loss = step(w1, w2, x, y)
+            losses.append(np.asarray(loss))
+            rec = probe.observe({LEAVES[0]: w1, LEAVES[1]: w2}, step=i)
+            if rec is not None:
+                records.append(rec)
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    return losses, records
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    from paddle_tpu.framework.observability import flight
+    if "--chaos" in argv:
+        # the chaos leg arms the probe itself (the injected fault must
+        # have a live probe to swallow)
+        set_flags({"replica_parity": True})
+        clean, _ = run(steps=3, chaos_probe_error=False)
+        chaotic, _ = run(steps=3, chaos_probe_error=True)
+        same = all(np.array_equal(a, b) for a, b in zip(clean, chaotic))
+        if not same or len(clean) != len(chaotic):
+            print("CHAOS_PARITY_DIVERGED", file=sys.stderr)
+            return 1
+        print("CHAOS_PARITY_BITIDENTICAL")
+        return 0
+    if not get_flags("replica_parity")["replica_parity"]:
+        print("replica parity disarmed (set FLAGS_replica_parity=1)",
+              file=sys.stderr)
+        return 2
+    _, records = run(steps=3)
+    bad = [r.first_divergent_leaf() for r in records
+           if not r.ok()]
+    events = flight.recent(8, kind="parity.divergence")
+    if not bad or not events:
+        print("NO_DIVERGENCE_DETECTED", file=sys.stderr)
+        return 1
+    print("PARITY_DIVERGENCE", bad[0])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,682 @@
+"""Distributed-semantics plane test suite.
+
+Static half (framework.analysis.collectives, PTA501-506): per-rule
+positive/negative fixtures over hand-built shard_map programs, the
+in-tree parallel-tier regression (zero/sharded/tp/ring traced clean at
+zero errors AND zero warnings), and the shard_map-aware PTA106 cost
+contract.  Runtime half (parallel.parity): dp=2 hash-agreement
+determinism, divergence naming, the disarmed-is-exactly-the-seed cache
+discipline, chaos swallow, and the fixture-pinned static+runtime
+same-leaf acceptance."""
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework.analysis import (RULES, Severity,
+                                           analyze_collectives)
+from paddle_tpu.framework.flags import get_flags, set_flags
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.mesh import shard_map_compat
+from paddle_tpu.parallel.parity import ParityProbe, maybe_observe
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                       "replica_divergence.py")
+
+
+def _mesh(dp=2):
+    return make_mesh({"dp": dp}, devices=jax.devices()[:dp])
+
+
+def rules_of(report):
+    return [d.rule for d in report.diagnostics]
+
+
+def _trace(fn, mesh, in_specs, out_specs, *avals, **kw):
+    mapped = shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+    return analyze_collectives(jax.make_jaxpr(mapped)(*avals), **kw)
+
+
+def f32(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_parity_flags():
+    saved = get_flags(["replica_parity", "replica_parity_every"])
+    yield
+    set_flags(saved)
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# per-rule positive/negative fixtures
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveRules:
+    def test_pta501_unreduced_output_positive(self):
+        mesh = _mesh()
+
+        def bad(w, x):
+            g = (x * w).sum(0)            # batch-sharded -> dp-varying
+            return w - 0.1 * g            # escapes a P() output
+
+        r = _trace(bad, mesh, (P(), P("dp")), P(), f32(4), f32(8, 4),
+                   outvar_labels=["w"])
+        d = [d for d in r.diagnostics if d.rule == "PTA501"]
+        assert d and d[0].severity == Severity.ERROR
+        assert "`w`" in d[0].message
+
+    def test_pta501_negative_psum_and_all_gather(self):
+        mesh = _mesh()
+
+        def good(w, x):
+            g = jax.lax.psum((x * w).sum(0), "dp")
+            chunk = jax.lax.psum_scatter(g, "dp", scatter_dimension=0,
+                                         tiled=True)
+            full = jax.lax.all_gather(chunk, "dp", tiled=True)
+            return w - 0.1 * full
+
+        r = _trace(good, mesh, (P(), P("dp")), P(), f32(4), f32(8, 4))
+        assert "PTA501" not in rules_of(r)
+
+    def test_pta501_sharded_output_is_allowed_to_vary(self):
+        mesh = _mesh()
+
+        def shardy(x):
+            return x * 2.0                # stays dp-sharded
+
+        r = _trace(shardy, mesh, (P("dp"),), P("dp"), f32(8))
+        assert "PTA501" not in rules_of(r)
+
+    def test_pta502_unknown_axis(self):
+        mesh = _mesh()
+
+        def f(x):
+            return jax.lax.psum(x, "dp")
+
+        mapped = shard_map_compat(f, mesh=mesh, in_specs=(P("dp"),),
+                                  out_specs=P("dp"))
+        closed = jax.make_jaxpr(mapped)(f32(8))
+        sm = closed.jaxpr.eqns[0]
+        psum_eqn = [e for e in sm.params["jaxpr"].eqns
+                    if e.primitive.name == "psum"][0]
+        psum_eqn.params["axes"] = ("dq",)       # transposed typo
+        r = analyze_collectives(closed)
+        d = [d for d in r.diagnostics if d.rule == "PTA502"]
+        assert d and d[0].severity == Severity.ERROR
+        assert "dq" in d[0].message
+
+    def test_pta502_double_reduce_vs_pmean(self):
+        mesh = _mesh()
+
+        def dbl(w):
+            return jax.lax.psum(w, "dp")      # w already replicated
+
+        r = _trace(dbl, mesh, (P(),), P(), f32(4))
+        d = [d for d in r.diagnostics if d.rule == "PTA502"]
+        assert d and d[0].severity == Severity.WARNING
+
+        def mean(w):
+            return jax.lax.pmean(w, "dp")     # identity on replicated
+
+        r = _trace(mean, mesh, (P(),), P(), f32(4))
+        assert "PTA502" not in rules_of(r)
+
+        def varying(x):
+            return jax.lax.psum(x.sum(), "dp")
+
+        r = _trace(varying, mesh, (P("dp"),), P(), f32(8))
+        assert "PTA502" not in rules_of(r)
+
+    def test_pta503_gather_then_static_slice(self):
+        mesh = _mesh()
+
+        def bad(x):
+            return jax.lax.all_gather(x, "dp")[0]   # chunk 0 everywhere
+
+        r = _trace(bad, mesh, (P("dp"),), P("dp"), f32(8))
+        assert "PTA503" in rules_of(r)
+
+        def good(x):
+            g = jax.lax.all_gather(x, "dp", tiled=True)
+            i = jax.lax.axis_index("dp")
+            return jax.lax.dynamic_slice(g, (i * x.shape[0],),
+                                         (x.shape[0],))
+
+        r = _trace(good, mesh, (P("dp"),), P("dp"), f32(8))
+        assert "PTA503" not in rules_of(r)
+
+    def test_pta504_quantized_sum(self):
+        mesh = _mesh()
+
+        def int8_sum(x):
+            q = jnp.clip(jnp.round(x), -127, 127).astype(jnp.int8)
+            return jax.lax.psum(q, "dp")
+
+        r = _trace(int8_sum, mesh, (P("dp"),), P("dp"), f32(8))
+        d = [d for d in r.diagnostics if d.rule == "PTA504"]
+        assert d and d[0].severity == Severity.ERROR
+
+        def bf16_sum(x):
+            return jax.lax.psum(x.astype(jnp.bfloat16), "dp")
+
+        r = _trace(bf16_sum, mesh, (P("dp"),), P("dp"), f32(8))
+        d = [d for d in r.diagnostics if d.rule == "PTA504"]
+        assert d and d[0].severity == Severity.WARNING
+
+        def idiom(x):
+            # the wire.py discipline: exchange encodings, sum decoded
+            q = jnp.clip(jnp.round(x.reshape(2, -1)), -127,
+                         127).astype(jnp.int8)
+            ex = jax.lax.all_to_all(q, "dp", split_axis=0,
+                                    concat_axis=0)
+            return ex.astype(jnp.float32).sum(0)
+
+        r = _trace(idiom, mesh, (P("dp"),), P("dp"), f32(8))
+        assert "PTA504" not in rules_of(r)
+
+    def test_pta505_donated_across_collective(self):
+        mesh = _mesh()
+
+        def bad(x, y):
+            return jax.lax.psum(x.sum() * y, "dp")[:2]
+
+        mapped = shard_map_compat(bad, mesh=mesh,
+                                  in_specs=(P("dp"), P("dp")),
+                                  out_specs=P("dp"))
+        closed = jax.make_jaxpr(mapped)(f32(8), f32(8))
+        # hand the pass the donation the jit would get
+
+        def donated_direct(x):
+            return jax.lax.psum(x, "dp")[:2]   # no aliasable output
+
+        mapped = shard_map_compat(donated_direct, mesh=mesh,
+                                  in_specs=(P("dp"),),
+                                  out_specs=P("dp"))
+        closed = jax.make_jaxpr(mapped)(f32(8))
+        r = analyze_collectives(closed, donate_argnums=(0,))
+        assert "PTA505" in rules_of(r)
+
+        def roundtrip(x):
+            return jax.lax.psum(x, "dp")       # same shape comes back
+
+        mapped = shard_map_compat(roundtrip, mesh=mesh,
+                                  in_specs=(P("dp"),),
+                                  out_specs=P("dp"))
+        closed = jax.make_jaxpr(mapped)(f32(8))
+        r = analyze_collectives(closed, donate_argnums=(0,))
+        assert "PTA505" not in rules_of(r)
+
+    def test_pta506_divergent_conditional(self):
+        mesh = _mesh()
+
+        def bad(x):
+            pred = x[0] > 0                   # dp-varying predicate
+            return jax.lax.cond(pred,
+                                lambda v: jax.lax.psum(v, "dp"),
+                                lambda v: v, x)
+
+        r = _trace(bad, mesh, (P("dp"),), P("dp"), f32(8))
+        d = [d for d in r.diagnostics if d.rule == "PTA506"]
+        assert d and d[0].severity == Severity.ERROR
+
+    def test_pta506_uniform_predicate_passes(self):
+        # the LocalSGD sync gate: replicated step counter drives the
+        # cond — every replica takes the same branch
+        mesh = _mesh()
+
+        def ok(x, t):
+            return jax.lax.cond(t > 0,
+                                lambda v: jax.lax.pmean(v, "dp"),
+                                lambda v: v, x)
+
+        r = _trace(ok, mesh, (P("dp"), P()), P("dp"), f32(8),
+                   jax.ShapeDtypeStruct((), jnp.int32))
+        assert "PTA506" not in rules_of(r)
+
+    def test_pta506_while_with_varying_carry(self):
+        mesh = _mesh()
+
+        def bad(x):
+            def body(c):
+                return jax.lax.psum(c, "dp") * 0.1
+
+            return jax.lax.while_loop(lambda c: c[0] < 1.0, body, x)
+
+        r = _trace(bad, mesh, (P("dp"),), P("dp"), f32(8))
+        assert "PTA506" in rules_of(r)
+
+    def test_collective_in_scan_is_fine(self):
+        # scan trips are schedule-uniform: the ring-attention shape
+        mesh = _mesh()
+
+        def ring(x):
+            def body(c, _):
+                return jax.lax.ppermute(
+                    c, "dp", [(0, 1), (1, 0)]), c.sum()
+
+            out, sums = jax.lax.scan(body, x, None, length=2)
+            return out
+
+        r = _trace(ring, mesh, (P("dp"),), P("dp"), f32(8))
+        assert "PTA506" not in rules_of(r)
+        assert r.errors == [], r.to_text()
+
+
+# ---------------------------------------------------------------------------
+# in-tree regression: the parallel tier is PTA5xx-clean
+# ---------------------------------------------------------------------------
+
+
+class TestInTreeClean:
+    def _zero_report(self, wire):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import optimizer
+        from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        opt = optimizer.Momentum(
+            learning_rate=0.01, momentum=0.9,
+            parameters=model.parameters(),
+            grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+        def loss_fn(m, x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        step = ShardedUpdateTrainStep(model, loss_fn, opt,
+                                      mesh=_mesh(), wire_dtype=wire)
+        return step.analyze(f32(8, 8), f32(8, 4), with_cost=False)
+
+    @pytest.mark.parametrize("wire", ["f32", "bf16", "int8"])
+    def test_zero_step_clean_per_wire(self, wire):
+        r = self._zero_report(wire)
+        assert r.errors == [] and r.warnings == [], r.to_text()
+
+    def test_compressed_allreduce_buffers_replicated(self):
+        # the in-tree PTA501 finding this plane surfaced: BN running
+        # stats derive from each replica's own batch shard; dp_meta now
+        # pmean-s float buffers (as zero.py always did) so the P()
+        # out_spec is true
+        import paddle_tpu.nn as nn
+        from paddle_tpu import optimizer
+        from paddle_tpu.parallel.dp_meta import (
+            CompressedAllReduceTrainStep)
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.BatchNorm1D(16),
+                              nn.ReLU(), nn.Linear(16, 4))
+        opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                 parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        step = CompressedAllReduceTrainStep(model, loss_fn, opt,
+                                            mesh=_mesh(),
+                                            compress_dtype="f32")
+        fn = step._build(2)
+        params = {n: p._data for n, p in model.named_parameters()}
+        buffers = {n: b._data for n, b in model.named_buffers()
+                   if b is not None}
+        states = opt.functional_init_states(params)
+        aval = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+            tuple(a.shape), a.dtype)
+        import jax.tree_util as jtu
+        closed = jax.make_jaxpr(fn)(
+            jtu.tree_map(aval, params), jtu.tree_map(aval, states),
+            jtu.tree_map(aval, buffers),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            f32(8, 8), f32(8, 4))
+        r = analyze_collectives(closed)
+        assert not [d for d in r.diagnostics if d.rule == "PTA501"], \
+            r.to_text()
+
+    def test_ring_attention_clean(self):
+        from paddle_tpu.framework.analysis import analyze_callable
+        from paddle_tpu.parallel.ring_attention import ring_attention
+        mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
+
+        def attn(q, k, v):
+            return ring_attention(q, k, v, causal=True, mesh=mesh)
+
+        r = analyze_callable(attn, *(f32(2, 8, 2, 4),) * 3,
+                             with_cost=False)
+        assert r.errors == [] and r.warnings == [], r.to_text()
+
+
+# ---------------------------------------------------------------------------
+# shard_map-aware PTA106 cost pass
+# ---------------------------------------------------------------------------
+
+
+class TestCostShardAware:
+    def test_wrapper_eqns_not_double_counted(self):
+        from paddle_tpu.framework.analysis import analyze_callable
+
+        def f(x, y):
+            return jax.jit(lambda a, b: a @ b)(x, y)
+
+        r = analyze_callable(f, jnp.ones((8, 32), jnp.float32),
+                             jnp.ones((32, 16), jnp.float32))
+        # 2*M*N*K exactly — the pjit wrapper adds nothing
+        assert r.cost["total_flops"] == 2 * 8 * 16 * 32
+
+    def test_manual_region_counts_per_device(self):
+        mesh = _mesh()
+
+        def local(x, w):
+            return x @ w                  # local shapes: (4, 32)
+
+        mapped = shard_map_compat(local, mesh=mesh,
+                                  in_specs=(P("dp"), P()),
+                                  out_specs=P("dp"))
+        from paddle_tpu.framework.analysis import analyze_jaxpr
+        closed = jax.make_jaxpr(mapped)(f32(8, 32), f32(32, 16))
+        r = analyze_jaxpr(closed)
+        assert r.cost["per_device"] is True
+        # per-device: the LOCAL batch (4 rows), not the global 8
+        assert r.cost["total_flops"] == 2 * 4 * 16 * 32
+
+    def test_collectives_tagged_with_wire_bytes(self):
+        mesh = _mesh()
+
+        def local(x):
+            s = jax.lax.psum(x, "dp")                   # 2(k-1)/k * n
+            g = jax.lax.all_gather(x, "dp", tiled=True)  # (k-1) * n
+            return s + g[:x.shape[0]]
+
+        mapped = shard_map_compat(local, mesh=mesh, in_specs=(P("dp"),),
+                                  out_specs=P("dp"))
+        from paddle_tpu.framework.analysis import analyze_jaxpr
+        closed = jax.make_jaxpr(mapped)(f32(8))
+        r = analyze_jaxpr(closed)
+        by = {row["op"]: row for row in r.cost["by_op"]}
+        local_bytes = 4 * 4                              # (4,) f32 local
+        assert by["psum"]["bytes"] == int(2 * (2 - 1) / 2 * local_bytes)
+        assert by["all_gather"]["bytes"] == (2 - 1) * local_bytes
+        assert by["psum"]["flops"] == 0
+        assert r.cost["collective_wire_bytes"] == \
+            by["psum"]["bytes"] + by["all_gather"]["bytes"]
+
+    def test_zero_step_cost_reports_collectives(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import optimizer
+        from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                 parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        step = ShardedUpdateTrainStep(model, loss_fn, opt, mesh=_mesh(),
+                                      wire_dtype="bf16")
+        r = step.analyze(f32(8, 8), f32(8, 4))
+        assert r.cost["per_device"] is True
+        assert r.cost["collective_wire_bytes"] > 0
+        ops = {row["op"] for row in r.cost["by_op"]}
+        assert "all_to_all" in ops and "all_gather" in ops
+
+
+# ---------------------------------------------------------------------------
+# runtime replica-parity probe (dp=2)
+# ---------------------------------------------------------------------------
+
+
+def _divergent_replicated(mesh, base=1.0):
+    """An array CLAIMING replication whose per-device buffers differ —
+    the runtime shape of the PTA501 bug (check_vma off)."""
+    def mk():
+        i = jax.lax.axis_index("dp")
+        return jnp.full((4,), base, jnp.float32) \
+            + i.astype(jnp.float32)
+
+    return jax.jit(shard_map_compat(mk, mesh=mesh, in_specs=(),
+                                    out_specs=P()))()
+
+
+def _replicated(mesh, arr):
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, P()))
+
+
+class TestParityProbe:
+    def test_hash_agreement_bitwise_deterministic(self):
+        mesh = _mesh()
+        probe = ParityProbe(mesh=mesh)
+        tree = {"a": _replicated(mesh, np.arange(8, dtype=np.float32)),
+                "b": _replicated(mesh, np.ones((3, 3), np.float32))}
+        r1 = probe.check(tree)
+        r2 = probe.check(tree)
+        assert np.array_equal(r1.hashes, r2.hashes)
+        assert r1.ok() and r2.ok()
+        assert r1.agree.all()
+
+    def test_hash_sensitive_to_single_bit(self):
+        mesh = _mesh()
+        probe = ParityProbe(mesh=mesh)
+        a = np.arange(8, dtype=np.float32)
+        h1 = probe.check({"a": _replicated(mesh, a)}).hashes
+        a2 = a.copy()
+        a2[3] = np.nextafter(a2[3], 2.0)      # one ulp
+        h2 = probe.check({"a": _replicated(mesh, a2)}).hashes
+        assert not np.array_equal(h1, h2)
+
+    def test_divergence_names_first_sorted_leaf(self):
+        mesh = _mesh()
+        probe = ParityProbe(mesh=mesh)
+        tree = {"w1": _replicated(mesh, np.ones(4, np.float32)),
+                "w2": _divergent_replicated(mesh)}
+        rec = probe.check(tree)
+        assert rec.divergent_leaves() == ["w2"]
+        assert rec.first_divergent_leaf() == "w2"
+        assert not rec.ok()
+
+    def test_sharded_and_single_device_leaves_skipped(self):
+        mesh = _mesh()
+        probe = ParityProbe(mesh=mesh)
+        sharded = jax.device_put(jnp.arange(8, dtype=jnp.float32),
+                                 NamedSharding(mesh, P("dp")))
+        single = jnp.arange(4, dtype=jnp.float32)
+        rec = probe.check({"s": sharded, "local": single,
+                           "r": _replicated(mesh,
+                                            np.ones(4, np.float32))})
+        assert rec.names == ["r"]
+
+    def test_observe_divergence_fires_flight_event(self):
+        from paddle_tpu.framework.observability import flight
+        mesh = _mesh()
+        set_flags({"replica_parity": True, "replica_parity_every": 1})
+        monitor.reset_all_stats()
+        probe = ParityProbe(mesh=mesh, every=1)
+        rec = probe.observe({"good": _replicated(mesh,
+                                                 np.ones(4, np.float32)),
+                             "bad": _divergent_replicated(mesh)},
+                            step=7)
+        assert rec is not None and not rec.ok()
+        assert monitor.get_stat("parity_divergence_total") == 1
+        ev = flight.recent(4, kind="parity.divergence")
+        assert ev and ev[-1]["attrs"]["first_bad_leaf"] == "bad"
+
+    def test_observe_cadence(self):
+        mesh = _mesh()
+        set_flags({"replica_parity": True})
+        monitor.reset_all_stats()
+        probe = ParityProbe(mesh=mesh, every=2)
+        tree = {"a": _replicated(mesh, np.ones(4, np.float32))}
+        out = [probe.observe(tree) for _ in range(4)]
+        assert [o is not None for o in out] == [False, True, False,
+                                               True]
+        assert monitor.get_stat("parity_checks_total") == 2
+
+    def test_chaos_swallow_and_count(self):
+        mesh = _mesh()
+        set_flags({"replica_parity": True})
+        monitor.reset_all_stats()
+        probe = ParityProbe(mesh=mesh, every=1)
+        tree = {"a": _replicated(mesh, np.ones(4, np.float32))}
+        with chaos.inject("parity.observe", mode="error", every=1):
+            out = probe.observe(tree)
+        assert out is None                     # swallowed, not raised
+        assert monitor.get_stat("parity_observe_errors_total") == 1
+        assert monitor.get_stat("parity_checks_total") == 0
+
+    def test_disarmed_probe_is_exactly_zero(self):
+        mesh = _mesh()
+        set_flags({"replica_parity": False})
+        monitor.reset_all_stats()
+        probe = ParityProbe(mesh=mesh, every=1)
+        tree = {"a": _replicated(mesh, np.ones(4, np.float32))}
+        assert probe.observe(tree) is None
+        assert probe._fns == {}                # nothing compiled
+        assert monitor.get_stat("parity_checks_total") == 0
+
+
+class TestParityInSteps:
+    def _zero_step(self, mesh):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import optimizer
+        from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(6, 12), nn.ReLU(),
+                              nn.Linear(12, 3))
+        opt = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                 parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        return ShardedUpdateTrainStep(model, loss_fn, opt, mesh=mesh,
+                                      wire_dtype="f32")
+
+    def _run(self, steps=4):
+        mesh = _mesh()
+        step = self._zero_step(mesh)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((8, 6))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((8, 3))
+                             .astype(np.float32))
+        losses = [float(step(x, y)) for _ in range(steps)]
+        params = {n: np.asarray(p._data)
+                  for n, p in step.model.named_parameters()}
+        return step, losses, params
+
+    def test_disarmed_signature_cache_identical_to_seed(self):
+        set_flags({"replica_parity": False})
+        step, _, _ = self._run()
+        assert set(step._fns) == {False}       # the seed's only key
+        assert getattr(step, "_parity_probe", None) is None
+
+    def test_armed_trajectory_bitwise_identical_and_checked(self):
+        set_flags({"replica_parity": False})
+        monitor.reset_all_stats()
+        _, clean_losses, clean_params = self._run()
+        set_flags({"replica_parity": True, "replica_parity_every": 1})
+        monitor.reset_all_stats()
+        step, armed_losses, armed_params = self._run()
+        assert clean_losses == armed_losses    # bitwise: float() equal
+        for n in clean_params:
+            assert np.array_equal(clean_params[n], armed_params[n])
+        # the step's OWN cache gained nothing from arming the probe
+        assert set(step._fns) == {False}
+        assert monitor.get_stat("parity_checks_total") == 4
+        assert not monitor.get_stat("parity_divergence_total")
+
+    def test_chaos_error_does_not_perturb_trajectory(self):
+        set_flags({"replica_parity": True, "replica_parity_every": 1})
+        monitor.reset_all_stats()
+        _, clean_losses, _ = self._run()
+        monitor.reset_all_stats()
+        with chaos.inject("parity.observe", mode="error", every=1):
+            _, chaotic_losses, _ = self._run()
+        assert clean_losses == chaotic_losses
+        assert monitor.get_stat("parity_observe_errors_total") == 4
+
+    def test_plain_trainstep_single_device_noop(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import jit, optimizer
+        set_flags({"replica_parity": True, "replica_parity_every": 1})
+        monitor.reset_all_stats()
+        paddle.seed(0)
+        model = nn.Linear(4, 4)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        step = jit.TrainStep(model, loss_fn, opt)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        step(x, x)
+        # single-device leaves: the probe attaches but checks nothing
+        assert monitor.get_stat("parity_checks_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# fixture-pinned acceptance: static and runtime name the SAME leaf
+# ---------------------------------------------------------------------------
+
+
+def _load_fixture():
+    spec = importlib.util.spec_from_file_location(
+        "replica_divergence_fixture", FIXTURE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFixtureAcceptance:
+    def test_static_flags_pta501_on_w2_only(self):
+        mod = _load_fixture()
+        r = mod.collectives_report()
+        d = [d for d in r.diagnostics if d.rule == "PTA501"]
+        assert len(d) == 1
+        assert "fixture.w2" in d[0].message
+        assert "fixture.w1" not in d[0].message
+
+    def test_runtime_names_the_same_leaf(self):
+        set_flags({"replica_parity": True, "replica_parity_every": 1})
+        mod = _load_fixture()
+        _, records = mod.run(steps=3)
+        bad = [r.first_divergent_leaf() for r in records if not r.ok()]
+        assert bad and bad[0] == "fixture.w2"
+        # w1's psum-ed update keeps it bit-identical across replicas
+        for r in records:
+            assert "fixture.w1" not in r.divergent_leaves()
+
+    def test_cli_flags_fixture(self):
+        from tools import prog_lint
+        rc = prog_lint.main(["--collectives", FIXTURE, "--format=json"])
+        assert rc == 1
+
+    def test_rule_registry_and_docs(self):
+        from tools.prog_lint import check_docs
+        for rid in ("PTA501", "PTA502", "PTA503", "PTA504", "PTA505",
+                    "PTA506"):
+            assert rid in RULES
+            assert RULES[rid].frontend == "collective"
+        assert check_docs() == []
+
+    def test_json_schema_carries_collective_findings(self):
+        mod = _load_fixture()
+        doc = json.loads(mod.collectives_report().to_json())
+        assert doc["version"] == 1
+        f = [x for x in doc["findings"] if x["rule"] == "PTA501"]
+        assert f and f[0]["frontend"] == "collective"

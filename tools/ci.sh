@@ -155,6 +155,46 @@ if ! grep -q "LOCK_CYCLE fixture.inversion.a fixture.inversion.b" \
 fi
 rm -f /tmp/pt_threads_fixture.json /tmp/pt_watchdog.txt
 
+echo "== distributed-semantics lane (PTA5xx static; runtime replica-parity probe) =="
+# static half: the whole package AST-lints clean at --strict AND the
+# parallel-tier zoo (zero/sharded/tp/ring traced on a virtual mesh)
+# carries zero PTA5xx errors/warnings; the committed divergence fixture
+# MUST be flagged PTA501 naming fixture.w2 (a pass suite that can't see
+# the seeded bug gates nothing)
+JAX_PLATFORMS=cpu python tools/prog_lint.py --collectives paddle_tpu \
+    --zoo zero_step --zoo sharded_step --zoo tp_layers \
+    --zoo ring_attention --strict --no-cost
+rc=0
+JAX_PLATFORMS=cpu python tools/prog_lint.py --collectives \
+    tests/fixtures/replica_divergence.py --format=json \
+    > /tmp/pt_collectives_fixture.json || rc=$?
+if [ "$rc" != 1 ] || ! grep -q '"PTA501"' /tmp/pt_collectives_fixture.json \
+    || ! grep -q 'fixture.w2' /tmp/pt_collectives_fixture.json; then
+  echo "distributed lane FAILED: divergence fixture not flagged (rc=$rc)" >&2
+  exit 1
+fi
+# dynamic half: executing the SAME fixture under FLAGS_replica_parity
+# must name the IDENTICAL leaf in a parity.divergence flight event
+# while the run completes normally (exit 0) — static model validated
+# by runtime
+JAX_PLATFORMS=cpu FLAGS_replica_parity=1 \
+    python tests/fixtures/replica_divergence.py | tee /tmp/pt_parity.txt
+if ! grep -q "PARITY_DIVERGENCE fixture.w2" /tmp/pt_parity.txt; then
+  echo "distributed lane FAILED: probe did not name fixture.w2" >&2
+  exit 1
+fi
+# chaos leg: an injected parity.observe error is swallowed+counted and
+# the probed training trajectory stays BIT-IDENTICAL to the clean run
+JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
+    python tests/fixtures/replica_divergence.py --chaos \
+    | tee /tmp/pt_parity_chaos.txt
+if ! grep -q "CHAOS_PARITY_BITIDENTICAL" /tmp/pt_parity_chaos.txt; then
+  echo "distributed lane FAILED: chaos leg perturbed the trajectory" >&2
+  exit 1
+fi
+rm -f /tmp/pt_collectives_fixture.json /tmp/pt_parity.txt \
+    /tmp/pt_parity_chaos.txt
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and
@@ -177,6 +217,16 @@ echo "== ZeRO collective byte gate (analytic wire MB per leg/dtype, dp=2) =="
 # fattens a collective (or breaks the bf16=0.5x / int8~0.25x encodings)
 # fails here; the fused-step wall clock is reported but NOT gated
 JAX_PLATFORMS=cpu python tools/op_bench.py --zero-collectives \
+    --compare tools/op_bench_baseline.json \
+    --thresholds tools/op_bench_thresholds.json
+
+echo "== replica-parity probe overhead gate (armed <= 2% step, disarmed exactly zero) =="
+# armed: the probe's amortized cost at the default cadence must stay
+# under 2% of the mlp1m step (in-function gate) and its analytic hash
+# wire bytes are deterministic (compare gate); disarmed: zero probe
+# invocations, zero compiled probe programs, step cache untouched
+# (in-function gate — "exactly zero", not "small")
+JAX_PLATFORMS=cpu python tools/op_bench.py --parity-probe \
     --compare tools/op_bench_baseline.json \
     --thresholds tools/op_bench_thresholds.json
 

@@ -79,6 +79,8 @@ MODULES = [
     "paddle_tpu.framework.collector",
     "paddle_tpu.framework.locks",
     "paddle_tpu.framework.analysis.concurrency",
+    "paddle_tpu.framework.analysis.collectives",
+    "paddle_tpu.parallel.parity",
     "paddle_tpu.distributed.fleet.metrics",
     "paddle_tpu.distributed.fleet.utils.fs",
     "paddle_tpu.utils.cpp_extension",

@@ -13,6 +13,7 @@ Usage:
     python tools/prog_lint.py --zoo resnet18           # jaxpr passes
     python tools/prog_lint.py --zoo all paddle_tpu.vision.models
     python tools/prog_lint.py --threads paddle_tpu     # PTA4xx passes
+    python tools/prog_lint.py --collectives paddle_tpu --zoo all
     python tools/prog_lint.py --list-rules [--format=json]
     python tools/prog_lint.py --list-rules --check-docs
 
@@ -22,6 +23,14 @@ abstract trace — no FLOPs spent) and runs the jaxpr IR passes on it.
 ``--threads`` switches the source front end to the concurrency pass
 family (PTA401-407): all target files form ONE whole-repo lock model,
 so cross-module acquisition edges and cycles are visible.
+``--collectives`` arms the distributed-semantics family (PTA501-506):
+zoo names resolve to the COLLECTIVES_ZOO (the parallel tier traced on
+a virtual multi-device mesh — abstract, no FLOPs spent), module/dir
+targets are AST-linted as usual (fault-point hygiene over the parity
+probe sources rides along), and a FILE target exposing a
+``collectives_report()`` hook is imported and its report used — the
+committed ``tests/fixtures/replica_divergence.py`` acceptance
+artifact.
 ``--list-rules`` prints the full rule table (id, severity, front end,
 title); with ``--check-docs`` it diffs the table against the README's
 rule rows and exits 1 on drift, so the docs cannot silently rot.
@@ -335,6 +344,180 @@ def _zoo_collector():
     return report
 
 
+# ---------------------------------------------------------------------------
+# --collectives zoo: the distributed tier traced on a virtual mesh and
+# run through the PTA5xx passes (plus the full PTA1xx stack).  Every
+# entry returns a finished Report and must stay clean at zero errors
+# AND zero warnings — the regression guard for the sharded-execution
+# paths' distributed semantics.
+# ---------------------------------------------------------------------------
+
+
+def _virtual_devices(n: int = 8):
+    """Force a CPU virtual device mesh BEFORE jax initializes (the
+    op_bench --zero-collectives idiom); no-op once jax is up."""
+    import sys as _sys
+    if "jax" not in _sys.modules:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in xf:
+            os.environ["XLA_FLAGS"] = (
+                xf + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def _require_devices(n: int, who: str):
+    import jax
+    if len(jax.devices()) < n:
+        raise SystemExit(
+            f"prog_lint: {who} needs >= {n} devices for its virtual "
+            "mesh (CPU hosts get one automatically unless jax was "
+            "already initialized single-device)")
+
+
+def _czoo_zero_step():
+    """Trace the ZeRO sharded-update step (dp=2, default wire, global-
+    norm clip armed so the clip-psum idiom is in the jaxpr) and run the
+    full jaxpr+PTA5xx stack via its analyze() hook."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+    _require_devices(2, "zoo:zero_step")
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.Momentum(
+        learning_rate=0.01, momentum=0.9,
+        parameters=model.parameters(),
+        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    step = ShardedUpdateTrainStep(model, loss_fn, opt, mesh=mesh)
+    return step.analyze(
+        jax.ShapeDtypeStruct((8, 8), jnp.float32),
+        jax.ShapeDtypeStruct((8, 4), jnp.float32))
+
+
+def _czoo_sharded_step():
+    """Trace the pjit hybrid step (dp=2 x sharding=2, stage-2 ZeRO
+    layout) through its inherited analyze() — the pjit-region walk of
+    the PTA5xx passes (XLA owns the collectives there; the passes must
+    stay silent)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer
+    from paddle_tpu.parallel import ShardedTrainStep, make_mesh
+    _require_devices(4, "zoo:sharded_step")
+    mesh = make_mesh({"dp": 2, "sharding": 2},
+                     devices=jax.devices()[:4])
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                             parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    step = ShardedTrainStep(model, loss_fn, opt, mesh=mesh,
+                            sharding_stage=2)
+    return step.analyze(np.zeros((8, 8), np.float32),
+                        np.zeros((8, 4), np.float32))
+
+
+def _czoo_tp_layers():
+    """Trace a column->row tensor-parallel block (mp=2) — the
+    sharding-constraint path the tp layers ride; the PTA5xx passes walk
+    the constrained pjit program and must stay silent."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.tp_layers import (ColumnParallelLinear,
+                                                  RowParallelLinear)
+    from paddle_tpu.framework.analysis import analyze_model
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.mesh import set_mesh
+    _require_devices(2, "zoo:tp_layers")
+    paddle.seed(0)
+    from paddle_tpu.parallel import mesh as mesh_mod
+    prev = mesh_mod._global_mesh
+    set_mesh(make_mesh({"mp": 2}, devices=jax.devices()[:2]))
+
+    class _Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = ColumnParallelLinear(8, 16, gather_output=False)
+            self.row = RowParallelLinear(16, 4, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.row(paddle.nn.functional.relu(self.col(x)))
+
+    try:
+        model = _Block()
+        model.eval()
+        return analyze_model(
+            model, jax.ShapeDtypeStruct((2, 8), jnp.float32),
+            name="zoo:tp_layers")
+    finally:
+        set_mesh(prev)
+
+
+def _czoo_ring_attention():
+    """Trace ring attention on an sp=2 mesh — the ppermute-in-scan
+    manual region (sequence parallelism); the PTA5xx passes must
+    accept the rotating-chunk schedule (outputs stay sp-sharded)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.analysis import analyze_callable
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    _require_devices(2, "zoo:ring_attention")
+    mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
+
+    def attn(q, k, v):
+        return ring_attention(q, k, v, causal=True, mesh=mesh)
+
+    shape = (2, 8, 2, 4)                  # (B, S, H, D), S sharded on sp
+    return analyze_callable(
+        attn, *(jax.ShapeDtypeStruct(shape, jnp.float32),) * 3,
+        name="zoo:ring_attention")
+
+
+COLLECTIVES_ZOO = {
+    "zero_step": _czoo_zero_step,
+    "sharded_step": _czoo_sharded_step,
+    "tp_layers": _czoo_tp_layers,
+    "ring_attention": _czoo_ring_attention,
+}
+
+
+def _collectives_file_report(path: str):
+    """Import a file target and return its ``collectives_report()``
+    Report, or None when the file declares no hook (it is then
+    AST-linted like any other target)."""
+    import importlib.util
+    name = "_prog_lint_collectives_" + \
+        os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    hook = getattr(mod, "collectives_report", None)
+    return hook() if callable(hook) else None
+
+
 def resolve_target(target: str):
     """A dotted module name or path -> list of .py files to lint."""
     if os.path.exists(target):
@@ -393,7 +576,7 @@ def check_docs(readme_path: str = None) -> list:
                 for m in row_re.finditer(text)}
     problems = []
     fe_alias = {"ast": "ast", "chaos": "ast", "jaxpr": "jaxpr",
-                "threads": "threads"}
+                "threads": "threads", "collective": "collective"}
     for rid, info in sorted(RULES.items()):
         if rid not in doc_rows:
             problems.append(f"{rid}: registered but missing from the "
@@ -432,6 +615,14 @@ def main(argv=None) -> int:
                     help="run the concurrency pass family (PTA401-407) "
                          "over the targets as one whole-repo lock "
                          "model, instead of the jit-safety lint")
+    ap.add_argument("--collectives", action="store_true",
+                    help="arm the distributed-semantics pass family "
+                         "(PTA501-506): zoo entries resolve to the "
+                         "traced parallel tier "
+                         f"({', '.join(sorted(COLLECTIVES_ZOO))}), "
+                         "file targets with a collectives_report() "
+                         "hook are imported, other targets AST-lint "
+                         "as usual")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the registered rule table and exit")
     ap.add_argument("--check-docs", action="store_true",
@@ -449,6 +640,13 @@ def main(argv=None) -> int:
     ap.add_argument("--no-cost", action="store_true",
                     help="skip the PTA106 cost report (quieter json)")
     a = ap.parse_args(argv)
+    if a.threads and a.collectives:
+        ap.error("--threads and --collectives are distinct front ends; "
+                 "run them as separate invocations")
+    if a.collectives:
+        # the collectives zoo traces dp/mp/sharding meshes: make the
+        # virtual CPU devices exist before jax initializes
+        _virtual_devices(8)
     if a.list_rules:
         print(list_rules(a.format))
         if a.check_docs:
@@ -482,22 +680,33 @@ def main(argv=None) -> int:
             for path in resolve_target(target):
                 rel = os.path.relpath(path, REPO) \
                     if path.startswith(REPO) else path
+                if a.collectives and os.path.isfile(target) and \
+                        path == target:
+                    # a single-file collectives target may carry the
+                    # traced-fixture hook (collectives_report) — the
+                    # committed divergence fixture's static half
+                    hooked = _collectives_file_report(path)
+                    if hooked is not None:
+                        hooked.files_seen = [rel]
+                        report.extend(hooked)
+                        continue
                 sub = lint_file(path, disable=disable)
                 sub.files_seen = [rel]
                 for d in sub.diagnostics:
                     d.file = rel
                 report.extend(sub)
 
+    zoo_map = COLLECTIVES_ZOO if a.collectives else ZOO
     zoo = a.zoo
     if "all" in zoo:
-        zoo = sorted(ZOO)
+        zoo = sorted(zoo_map)
     for entry in zoo:
-        if entry not in ZOO:
+        if entry not in zoo_map:
             raise SystemExit(f"prog_lint: unknown zoo entry {entry!r} "
-                             f"(have: {', '.join(sorted(ZOO))})")
+                             f"(have: {', '.join(sorted(zoo_map))})")
         from paddle_tpu.framework.analysis import Report as _Report
         from paddle_tpu.framework.analysis import analyze_model
-        out = ZOO[entry]()
+        out = zoo_map[entry]()
         if isinstance(out, _Report):     # pre-built report (elastic_step)
             if a.no_cost:                # honor --no-cost like the
                 out = out.filter(disable=["PTA106"])  # analyze_model path
