@@ -550,11 +550,27 @@ class PSTrainStep:
         self._announced.append(_np.asarray(
             ids.numpy() if isinstance(ids, Tensor) else ids, _np.int64))
 
+    @staticmethod
+    def _push_links(push):
+        """The causal edges a coalesced deferred push stamps onto the
+        RPC span that carries it (``PsClient._rpc links=``): one
+        ``deferred_push`` link per producing train.step span.  The
+        rendered edge says "this RPC carries step N's gradient", so
+        blame can tie a slow coalesced round-trip back to the step
+        that deferred into it.  None when nothing to link (local
+        tables, tracing off)."""
+        if push is None or len(push) < 4 or not push[3]:
+            return None
+        return [{"span": sid, "kind": "deferred_push"}
+                for sid in push[3]]
+
     def _prefetch_task(self, table, ids_np, push, span=None):
         """Background fan-out: unique the announced ids and run the
         coalesced push+pull round-trip (plain pull when no push is
         pending or the table has no coalesced op).  Runs under the
         prefetch span opened at issue time, so its RPCs parent to it."""
+        import time as _time
+
         from paddle_tpu.framework import chaos
         ctx = span.context() if span is not None else None
         with self._tracer().activate(ctx):
@@ -564,34 +580,46 @@ class PSTrainStep:
             uniq, inv, uniq_p = self._unique_prep(ids_np)
             if push is not None and hasattr(table, "push_pull"):
                 rows = table.push_pull(push[0], push[1], uniq_p,
-                                       seq=push[2])
+                                       seq=push[2],
+                                       links=self._push_links(push))
             else:
                 if push is not None:
                     self._replay_push(push)
                 rows = table.pull(uniq_p)
+            if span is not None:
+                # when the background work actually FINISHED (epoch us)
+                # — the span itself stays open until the consuming step
+                # settles it, so blame needs this to tell a hidden pull
+                # (done before the step began) from a blocking one
+                span.set_attr("done_ts", _time.time() * 1e6)
             return uniq, inv, uniq_p, rows
 
     def _take_pending_push(self):
-        """Drain the deferred-push queue into one ``(ids, grads, seq)``
-        payload.  Usually 0 or 1 entries; multiple (fault-degraded
-        stretches) concatenate — the table's duplicate-id merge
-        accumulates them exactly like separate pushes under sgd, and
-        within one batch-merge granularity under adagrad.  The dedup
-        ``seq`` is allocated HERE, once per payload, so a replay after
-        a failed/ambiguous first attempt re-sends the SAME stamp and
-        the server's dedup can actually absorb it."""
+        """Drain the deferred-push queue into one ``(ids, grads, seq,
+        producer_span_ids)`` payload.  Usually 0 or 1 entries; multiple
+        (fault-degraded stretches) concatenate — the table's
+        duplicate-id merge accumulates them exactly like separate
+        pushes under sgd, and within one batch-merge granularity under
+        adagrad.  The dedup ``seq`` is allocated HERE, once per
+        payload, so a replay after a failed/ambiguous first attempt
+        re-sends the SAME stamp and the server's dedup can actually
+        absorb it.  ``producer_span_ids`` are the train.step spans that
+        deferred each gradient — linked onto the carrying RPC span as
+        ``deferred_push`` causal edges."""
         import numpy as _np
         if not self._pending_push:
             return None
         if len(self._pending_push) == 1:
-            ids_p, g_p = self._pending_push[0]
+            ids_p, g_p = self._pending_push[0][:2]
         else:
             ids_p = _np.concatenate([p[0] for p in self._pending_push])
             g_p = _np.concatenate([p[1] for p in self._pending_push])
+        sids = [p[2] for p in self._pending_push
+                if len(p) > 2 and p[2] is not None]
         self._pending_push.clear()
         client = getattr(self.embedding.table, "client", None)
         seq = client._next_seq() if client is not None else None
-        return (ids_p, g_p, seq)
+        return (ids_p, g_p, seq, sids)
 
     def _replay_push(self, push):
         """Re-send a coalesced push whose first attempt failed or whose
@@ -600,7 +628,8 @@ class PSTrainStep:
         table = self.embedding.table
         client = getattr(table, "client", None)
         if client is not None and push[2] is not None:
-            table.push(push[0], push[1], seq=push[2])
+            table.push(push[0], push[1], seq=push[2],
+                       links=self._push_links(push))
         else:
             table.push(push[0], push[1])
 
@@ -669,10 +698,25 @@ class PSTrainStep:
                 self._replay_push(inf["push"])
             return None
 
-    def _consume_prefetch(self, ids_np):
+    @staticmethod
+    def _link_prefetch(inf, step_span, kind):
+        """Record the causal edge from a prefetch span to the step that
+        consumed (or fell back past) it: ``kind="prefetch"`` — the rows
+        arrived through the pipeline; ``kind="sync_fallback"`` — the
+        prefetch failed/was stale and the step re-pulled synchronously,
+        so the time burned waiting on the doomed task still attributes
+        to ``ps_wait`` in the blame vector instead of vanishing into
+        ``other``."""
+        sp = inf.get("span")
+        if sp is not None and step_span is not None:
+            step_span.link(sp.span_id, kind)
+
+    def _consume_prefetch(self, ids_np, step_span=None):
         """Take the head in-flight prefetch for this batch; ``None``
         means "pull synchronously" (nothing prefetched, the prefetch
-        failed, or a membership re-form made its rows stale)."""
+        failed, or a membership re-form made its rows stale).  The
+        consuming ``train.step`` span records the causal link either
+        way (``prefetch`` on a hit, ``sync_fallback`` on a miss)."""
         import numpy as _np
         if not self._inflight:
             # the head announcement may be THIS batch's own (the
@@ -686,12 +730,14 @@ class PSTrainStep:
         client = getattr(self.embedding.table, "client", None)
         got = self._settle_inflight(inf)
         if got is None:            # failed: span ended by the settle path
+            self._link_prefetch(inf, step_span, "sync_fallback")
             monitor.stat_add("ps_prefetch_misses_total")
             health.observe("ps_prefetch_miss", 1.0)
             return None
         if not _np.array_equal(inf["key"], ids_np):
             # stream reordered: rows are another batch's
             self._end_prefetch_span(inf, "error", reason="reordered")
+            self._link_prefetch(inf, step_span, "sync_fallback")
             monitor.stat_add("ps_prefetch_misses_total")
             health.observe("ps_prefetch_miss", 1.0)
             return None
@@ -700,10 +746,12 @@ class PSTrainStep:
             self._end_prefetch_span(inf, "error", reason="stale_epoch",
                                     issued_epoch=inf["epoch"],
                                     epoch=client.epoch)
+            self._link_prefetch(inf, step_span, "sync_fallback")
             monitor.stat_add("ps_prefetch_misses_total")
             health.observe("ps_prefetch_miss", 1.0)
             return None
         self._end_prefetch_span(inf, "ok")
+        self._link_prefetch(inf, step_span, "prefetch")
         monitor.stat_add("ps_prefetch_hits_total")
         health.observe("ps_prefetch_miss", 0.0)
         return got
@@ -753,23 +801,24 @@ class PSTrainStep:
     def __call__(self, ids, *inputs):
         import time as _time
         t_start = _time.perf_counter()
-        with self._tracer().start_span(
-                "train.step",
-                attrs={"step": int(getattr(self.optimizer,
-                                           "_global_step", 0))}):
-            loss = self._call_inner(ids, *inputs)
+        step_span = self._tracer().start_span(
+            "train.step",
+            attrs={"step": int(getattr(self.optimizer,
+                                       "_global_step", 0))})
+        with step_span:
+            loss = self._call_inner(ids, step_span, *inputs)
         step_ms = (_time.perf_counter() - t_start) * 1e3
         monitor.observe("train_step_ms", step_ms)
         monitor.stat_add("train_steps_total")
         health.observe("train_step_ms", step_ms)
         return loss
 
-    def _call_inner(self, ids, *inputs):
+    def _call_inner(self, ids, step_span, *inputs):
         import numpy as _np
         import ml_dtypes
         ids_np = _np.asarray(
             ids.numpy() if isinstance(ids, Tensor) else ids, _np.int64)
-        got = self._consume_prefetch(ids_np)
+        got = self._consume_prefetch(ids_np, step_span)
         pipelined = got is not None
         if got is None:
             # synchronous path (no/failed prefetch): still coalesce a
@@ -780,7 +829,8 @@ class PSTrainStep:
             table = self.embedding.table
             if push is not None and hasattr(table, "push_pull"):
                 rows_u = table.push_pull(push[0], push[1], uniq_p,
-                                         seq=push[2])
+                                         seq=push[2],
+                                         links=self._push_links(push))
             else:
                 if push is not None:
                     self._replay_push(push)
@@ -852,8 +902,10 @@ class PSTrainStep:
                                         or self._announced):
             # pipeline active: defer — the next issue (or the next
             # synchronous pull, or flush) coalesces this push into one
-            # round-trip with a pull
-            self._pending_push.append((uniq, grads_host))
+            # round-trip with a pull.  The step's span id rides along
+            # so the carrying RPC can link back to its producer
+            self._pending_push.append((uniq, grads_host,
+                                       step_span.span_id))
         else:
             # async host-side sparse update; overlaps the next device step
             self.embedding.communicator.push(uniq, grads_host)
@@ -869,7 +921,7 @@ class PSTrainStep:
             if self._settle_inflight(inf) is not None:
                 self._end_prefetch_span(inf, "ok", drained=True)
         while self._pending_push:
-            ids_p, g_p = self._pending_push.pop(0)
+            ids_p, g_p = self._pending_push.pop(0)[:2]
             self.embedding.table.push(ids_p, g_p)
         if self._prefetch_pool is not None:
             # don't leak a 'ps-prefetch' thread per PSTrainStep instance

@@ -784,7 +784,7 @@ class PsClient:
 
     # -- retrying transport -------------------------------------------------
     def _rpc(self, s: int, header: dict, bufs=(),
-             retries: Optional[int] = None):
+             retries: Optional[int] = None, links=None):
         conn, ep = self._conns[s], self.endpoints[s]
         op = header.get("op")
         if self.epoch is not None:
@@ -794,9 +794,14 @@ class PsClient:
         # one logical span per RPC; each ATTEMPT is a child with a fresh
         # span id under the same trace id (the retry contract), and the
         # attempt's context rides the header so the server's child span
-        # links to exactly the attempt that reached it
+        # links to exactly the attempt that reached it.  ``links`` are
+        # caller-declared causal edges stamped onto the logical span —
+        # the coalesced deferred push's "this RPC carries step N's
+        # gradient" edge (PSTrainStep threads it through push/push_pull)
         root = self.tracer.start_span(f"ps.{op}", detached=True,
                                       attrs={"endpoint": ep})
+        for lk in links or ():
+            root.link(lk.get("span"), lk.get("kind", "link"))
         for attempt in range(retries + 1):
             asp = self.tracer.start_span(
                 "ps.rpc", parent=root, detached=True,
@@ -919,11 +924,14 @@ class PsClient:
         return out.reshape(ids.shape + (first_dim,))
 
     def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
-             lr: Optional[float] = None, seq: Optional[int] = None):
+             lr: Optional[float] = None, seq: Optional[int] = None,
+             links=None):
         """``seq`` reuses a previously allocated stamp — the REPLAY path
         of a coalesced push whose first attempt may or may not have
         landed; the server's dedup then absorbs the copy that did.  A
-        fresh stamp is minted when None (the normal case)."""
+        fresh stamp is minted when None (the normal case).  ``links``
+        (``[{"span", "kind"}]``) stamp causal edges onto each shard
+        RPC's logical span — see :meth:`_rpc`."""
         ids = np.asarray(ids, np.int64)
         flat = ids.reshape(-1)
         g = np.asarray(grads, np.float32).reshape(flat.size, -1)
@@ -940,7 +948,8 @@ class PsClient:
                     self._rpc(s, {"op": "push", "table": table, "lr": lr,
                                   "wire": wire, "worker": self._push_ident,
                                   "seq": seq},
-                              [flat[mask]] + quantize_rows(g[mask], wire))
+                              [flat[mask]] + quantize_rows(g[mask], wire),
+                              links=links)
 
         list(self._pool.map(one, range(self.n)))
 
@@ -948,12 +957,15 @@ class PsClient:
                   push_grads: Optional[np.ndarray],
                   pull_ids: np.ndarray,
                   lr: Optional[float] = None,
-                  seq: Optional[int] = None) -> np.ndarray:
+                  seq: Optional[int] = None,
+                  links=None) -> np.ndarray:
         """Coalesced cycle: apply one batch's gradient rows AND fetch the
         next batch's rows in a single round-trip per shard (the
         DownpourWorker amortization — push(N) rides pull(N+1)'s RPC).
         ``push_ids``/``push_grads`` may be None for a pull-only call;
-        ``seq`` as in :meth:`push`.  Returns the rows for ``pull_ids``."""
+        ``seq`` as in :meth:`push`; ``links`` stamp causal edges
+        (``deferred_push``: the step span whose gradient this RPC
+        carries) onto each shard RPC's logical span."""
         pull_ids = np.asarray(pull_ids, np.int64)
         pflat = pull_ids.reshape(-1)
         powner = pflat % self.n
@@ -977,7 +989,8 @@ class PsClient:
                     self._rpc(s, {"op": "push", "table": table, "lr": lr,
                                   "wire": wire, "worker": self._push_ident,
                                   "seq": seq},
-                              [gids[gmask]] + quantize_rows(g[gmask], wire))
+                              [gids[gmask]] + quantize_rows(g[gmask], wire),
+                              links=links)
                     return s, pmask, None
                 wire = self._push_wire(s)
                 payload = quantize_rows(g[gmask], wire) if gmask.any() \
@@ -986,7 +999,8 @@ class PsClient:
                     s, {"op": "push_pull", "table": table, "lr": lr,
                         "wire": wire, "worker": self._push_ident,
                         "seq": seq, "n_push_bufs": len(payload)},
-                    [gids[gmask]] + payload + [pflat[pmask]])
+                    [gids[gmask]] + payload + [pflat[pmask]],
+                    links=links)
                 return s, pmask, self._decode_pull(table, reply, rows)
 
         first_dim = None
@@ -1126,17 +1140,21 @@ class RemoteEmbeddingTable:
         return self.client.pull(self.table, ids)
 
     def push(self, ids: np.ndarray, grads: np.ndarray,
-             lr: Optional[float] = None, seq: Optional[int] = None):
-        self.client.push(self.table, ids, grads, lr=lr, seq=seq)
+             lr: Optional[float] = None, seq: Optional[int] = None,
+             links=None):
+        self.client.push(self.table, ids, grads, lr=lr, seq=seq,
+                         links=links)
 
     def push_pull(self, push_ids, push_grads, pull_ids,
                   lr: Optional[float] = None,
-                  seq: Optional[int] = None) -> np.ndarray:
+                  seq: Optional[int] = None, links=None) -> np.ndarray:
         """Coalesced push+pull in one RPC round-trip per shard — the
         hook PSTrainStep's prefetch pipeline rides (duck-typed: tables
-        without it get a separate push then pull)."""
+        without it get a separate push then pull).  ``links`` stamp the
+        deferred push's causal edges onto the carrying RPC span."""
         return self.client.push_pull(self.table, push_ids, push_grads,
-                                     pull_ids, lr=lr, seq=seq)
+                                     pull_ids, lr=lr, seq=seq,
+                                     links=links)
 
 
 # ---------------------------------------------------------------------------

@@ -159,9 +159,22 @@ def _own_span_rows(path: str) -> List[dict]:
     with _span_lock:
         cur = _span_cursors.get(path)
         if cur is None:
-            cur = _span_cursors[path] = {"offset": 0, "names": {}}
+            cur = _span_cursors[path] = {"offset": 0, "names": {},
+                                         "ino": None}
         try:
             with open(path, "rb") as f:
+                st = os.fstat(f.fileno())
+                if st.st_ino != cur.get("ino") or \
+                        st.st_size < cur["offset"]:
+                    # segment rotated (FLAGS_trace_max_mb) or truncated:
+                    # this is a FRESH file — restart the byte cursor at
+                    # 0 (everything in it is new, so no double count;
+                    # spans of the rotated-away segment that were never
+                    # read are simply gone — the tracer counts them in
+                    # trace_spans_dropped_total).  The per-name
+                    # aggregates keep accumulating across segments
+                    cur["offset"] = 0
+                    cur["ino"] = st.st_ino
                 f.seek(cur["offset"])
                 chunk = f.read()
         except OSError:

@@ -174,6 +174,17 @@ define_flag("trace_dir", "",
             "spans to trace_<label>.jsonl there (label from "
             "PADDLE_TRACE_LABEL, set per child by the launcher).  Merge "
             "the per-process files with tools/trace_merge.py")
+define_flag("trace_max_mb", 0.0,
+            "size cap (MB) per tracer span-file segment: past it the "
+            "segment rotates to trace_<label>.jsonl.1 (exactly one "
+            "previous segment is kept — a week-long traced run costs "
+            "at most 2x the cap on disk) and a fresh segment opens "
+            "with a re-emitted process meta record.  Rotations count "
+            "into trace_rotations_total, spans lost with an "
+            "overwritten .1 segment into trace_spans_dropped_total; "
+            "the cluster collector's incremental span cursor detects "
+            "the segment change (inode/size) and resets without "
+            "double-counting.  0 (default) = unbounded")
 define_flag("flight_capacity", 512,
             "flight recorder ring size: the last N structured events "
             "(chaos trips, PS retries, NaN rollbacks, elastic "

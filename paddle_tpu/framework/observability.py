@@ -84,8 +84,8 @@ class Span:
     appear in the Profiling Report without double instrumentation."""
 
     __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
-                 "attrs", "_t0_wall", "_t0_perf", "_ended", "_rec",
-                 "status")
+                 "attrs", "links", "_t0_wall", "_t0_perf", "_ended",
+                 "_rec", "status")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
                  span_id: str, parent_id: Optional[str],
@@ -96,6 +96,7 @@ class Span:
         self.span_id = span_id
         self.parent_id = parent_id
         self.attrs = dict(attrs) if attrs else {}
+        self.links: List[dict] = []
         self._t0_wall = time.time()
         self._t0_perf = time.perf_counter()
         self._ended = False
@@ -107,6 +108,19 @@ class Span:
 
     def set_attr(self, key: str, value):
         self.attrs[key] = value
+
+    def link(self, span_id: Optional[str], kind: str = "link"):
+        """Record a CAUSAL edge: the work of span ``span_id`` (produced
+        on another thread/process — a prefetch task, an ingest fetch, a
+        deferred push) was consumed by THIS span.  Parent/child edges
+        say "ran inside"; links say "waited for".  ``tools/trace_merge``
+        renders links as chrome-trace flow events and
+        ``framework/blame.py`` walks them to rebuild the per-step
+        dependency DAG.  ``None`` span ids (tracing off at the producer)
+        are ignored."""
+        if span_id is None:
+            return
+        self.links.append({"span": str(span_id), "kind": str(kind)})
 
     def __enter__(self):
         self.tracer._push(self.context())
@@ -143,12 +157,16 @@ class _NullSpan:
 
     trace_id = span_id = parent_id = None
     attrs: dict = {}
+    links: tuple = ()
     status = "ok"
 
     def context(self):
         return None
 
     def set_attr(self, key, value):
+        pass
+
+    def link(self, span_id, kind: str = "link"):
         pass
 
     def __enter__(self):
@@ -181,7 +199,15 @@ class Tracer:
       file's timestamps to land them on the reference clock.
     * ``{"kind": "span", "name", "trace", "span", "parent", "ts",
       "dur", "status", "tid", "attrs"}`` — ``ts`` epoch microseconds,
-      ``dur`` microseconds.
+      ``dur`` microseconds; spans with causal links additionally carry
+      ``"links": [{"span": <producer span id>, "kind": <edge kind>}]``
+      (see :meth:`Span.link` / :meth:`link_next` — rendered as
+      chrome-trace flow events by ``tools/trace_merge.py`` and walked
+      by ``framework/blame.py``).
+
+    ``FLAGS_trace_max_mb`` > 0 bounds segment growth: a full segment
+    rotates to ``<path>.1`` (one kept) and a fresh one opens — see
+    :meth:`_rotate_locked`.
     """
 
     def __init__(self, trace_dir: Optional[str] = None,
@@ -195,6 +221,18 @@ class Tracer:
         self._checked_env = trace_dir is not None
         self.clock_offset = 0.0
         self.spans_written = 0
+        # -- segment rotation (FLAGS_trace_max_mb): bound span-file
+        # growth.  When the current segment exceeds the cap it is
+        # renamed to <path>.1 (overwriting — at most TWO segments ever
+        # exist, so a week-long traced run costs 2x the cap, not the
+        # disk) and a fresh segment opens with a re-emitted process
+        # meta record.  Rotations and the spans lost with an
+        # overwritten .1 segment are counted (trace_rotations_total /
+        # trace_spans_dropped_total)
+        self.rotations = 0
+        self.spans_dropped = 0
+        self._segment_spans = 0      # spans in the current segment
+        self._rotated_spans = 0      # spans sitting in the .1 segment
 
     # -- enablement ---------------------------------------------------------
     @property
@@ -217,6 +255,11 @@ class Tracer:
             self._checked_env = True
             if label:
                 self.label = label
+            # fresh target: the per-segment rotation accounting belongs
+            # to the previous dir/label — carrying it over would charge
+            # phantom drops against the new trace's first rotation
+            self._segment_spans = 0
+            self._rotated_spans = 0
         return self
 
     def disable(self):
@@ -226,6 +269,8 @@ class Tracer:
                 self._file = None
             self._dir = None
             self._checked_env = True
+            self._segment_spans = 0
+            self._rotated_spans = 0
 
     def path(self) -> Optional[str]:
         """The span file this tracer appends to (None when disabled)."""
@@ -271,13 +316,49 @@ class Tracer:
                 self._pop()
         return cm()
 
+    # -- causal links across async boundaries -------------------------------
+    _PENDING_CAP = 16
+
+    def link_next(self, span_id: Optional[str], kind: str):
+        """Declare that the NEXT consuming span started on this thread
+        causally depends on producer span ``span_id`` — the hand-off
+        idiom for code that releases work to a consumer it cannot see
+        (the ingest pipeline yielding a prefetched batch to whatever
+        train step runs next; code that hands work across an executor
+        it does not own passes ``links=`` explicitly instead — see
+        ``PsClient._rpc``).  Pending declarations attach to the next
+        :meth:`start_span` on this thread whose ``consume_links`` is
+        true (detached producer spans and the pipeline's own internal
+        spans skip them); the list is bounded — a consumer that never
+        opens a span cannot leak links without bound."""
+        if span_id is None or not self.enabled:
+            return
+        pending = getattr(self._local, "pending", None)
+        if pending is None:
+            pending = self._local.pending = []
+        pending.append({"span": str(span_id), "kind": str(kind)})
+        del pending[:-self._PENDING_CAP]
+
+    def _take_pending_links(self) -> List[dict]:
+        pending = getattr(self._local, "pending", None)
+        if not pending:
+            return []
+        out, pending[:] = list(pending), []
+        return out
+
     # -- span creation ------------------------------------------------------
     def start_span(self, name: str, parent=None, attrs: Optional[dict] = None,
-                   detached: bool = False) -> Span:
+                   detached: bool = False,
+                   consume_links: bool = True) -> Span:
         """New span under ``parent`` (a Span, SpanContext, or None for
         the thread's current span; a fresh trace when there is none).
         Context-manager use ends it automatically; ``detached=True``
-        spans are ended explicitly with :meth:`Span.end`."""
+        spans are ended explicitly with :meth:`Span.end`.  A
+        non-detached span with ``consume_links`` (the default) adopts
+        this thread's pending :meth:`link_next` declarations as causal
+        links; producers pass ``consume_links=False`` so a hand-off
+        waiting for its consumer is not swallowed by infrastructure
+        spans."""
         if not self.enabled:
             return _NULL_SPAN
         if isinstance(parent, Span):
@@ -288,7 +369,10 @@ class Tracer:
             trace_id, parent_id = _new_id(), None
         else:
             trace_id, parent_id = parent.trace_id, parent.span_id
-        return Span(self, name, trace_id, _new_id(), parent_id, attrs)
+        span = Span(self, name, trace_id, _new_id(), parent_id, attrs)
+        if not detached and consume_links:
+            span.links.extend(self._take_pending_links())
+        return span
 
     # -- wire propagation ---------------------------------------------------
     def inject(self, header: dict, span: Optional[Span] = None) -> dict:
@@ -335,15 +419,44 @@ class Tracer:
                     self._file.write(json.dumps(self._meta_record()) + "\n")
             self._file.write(json.dumps(record, default=str) + "\n")
             self._file.flush()
+            if record.get("kind") == "span":
+                self._segment_spans += 1
+            max_mb = float(flag("trace_max_mb"))
+            if max_mb > 0 and self._file.tell() > max_mb * (1 << 20):
+                self._rotate_locked()
+
+    def _rotate_locked(self):
+        """Roll the full current segment aside as ``<path>.1`` (one
+        previous segment is kept; an older one is overwritten and its
+        spans counted dropped) and open a fresh segment on the next
+        write.  Called under ``_file_lock``."""
+        self._file.close()
+        self._file = None
+        path = self.path()
+        dropped = self._rotated_spans
+        try:
+            os.replace(path, path + ".1")
+        except OSError:
+            return                  # rotation is best-effort: keep tracing
+        self._rotated_spans = self._segment_spans
+        self._segment_spans = 0
+        self.rotations += 1
+        monitor.stat_add("trace_rotations_total")
+        if dropped:
+            self.spans_dropped += dropped
+            monitor.stat_add("trace_spans_dropped_total", dropped)
 
     def _write_span(self, span: Span):
         dur = time.perf_counter() - span._t0_perf
-        self._write({
+        rec = {
             "kind": "span", "name": span.name, "trace": span.trace_id,
             "span": span.span_id, "parent": span.parent_id,
             "ts": span._t0_wall * 1e6, "dur": dur * 1e6,
             "status": span.status, "tid": threading.get_ident(),
-            "attrs": span.attrs})
+            "attrs": span.attrs}
+        if span.links:
+            rec["links"] = list(span.links)
+        self._write(rec)
         self.spans_written += 1
 
 
@@ -369,8 +482,13 @@ def span_summary(trace_dir: str, label: Optional[str] = None) -> List[dict]:
 
     durs: Dict[str, List[float]] = {}
     errors: Dict[str, int] = {}
+    categories: Dict[str, str] = {}
     pattern = "trace_*.jsonl" if label is None else f"trace_{label}.jsonl"
+    seg_paths = []
     for path in sorted(glob.glob(os.path.join(trace_dir, pattern))):
+        # a rotated previous segment is the same logical trace
+        seg_paths += [path + ".1", path]
+    for path in seg_paths:
         try:
             with open(path, encoding="utf-8", errors="replace") as f:
                 lines = f.readlines()
@@ -391,17 +509,27 @@ def span_summary(trace_dir: str, label: Optional[str] = None) -> List[dict]:
                 float(rec.get("dur", 0.0)) / 1e3)
             if rec.get("status") == "error":
                 errors[name] = errors.get(name, 0) + 1
+            cat = (rec.get("attrs") or {}).get("category")
+            if cat is not None and name not in categories:
+                categories[name] = str(cat)
     rows = []
     for name, ms in durs.items():
         ms.sort()
         n = len(ms)
-        p99 = ms[min(n - 1, max(0, int(0.99 * n + 0.5) - 1))]
-        rows.append({"name": name, "count": n,
-                     "total_ms": round(sum(ms), 3),
-                     "mean_ms": round(sum(ms) / n, 3),
-                     "p99_ms": round(p99, 3),
-                     "max_ms": round(ms[-1], 3),
-                     "errors": errors.get(name, 0)})
+        # single-sample group: the p99 IS that sample (the general
+        # nearest-rank formula agrees, but the contract is explicit —
+        # blame tooling consumes these rows)
+        p99 = ms[0] if n == 1 else \
+            ms[min(n - 1, max(0, int(0.99 * n + 0.5) - 1))]
+        row = {"name": name, "count": n,
+               "total_ms": round(sum(ms), 3),
+               "mean_ms": round(sum(ms) / n, 3),
+               "p99_ms": round(p99, 3),
+               "max_ms": round(ms[-1], 3),
+               "errors": errors.get(name, 0)}
+        if name in categories:
+            row["category"] = categories[name]
+        rows.append(row)
     rows.sort(key=lambda r: r["total_ms"], reverse=True)
     return rows
 

@@ -192,7 +192,8 @@ def capture(kind: str, label: Optional[str] = None,
             trace_dir: Optional[str] = None,
             labels=None, meta: Optional[dict] = None,
             include_snapshot: bool = True,
-            extra: Optional[dict] = None) -> dict:
+            extra: Optional[dict] = None,
+            blame_result: Optional[dict] = None) -> dict:
     """Assemble one RunRecord dict (no I/O — pair with
     :meth:`RunLedger.append`).
 
@@ -240,6 +241,28 @@ def capture(kind: str, label: Optional[str] = None,
                 rec["trace_summary"] = rows
         except Exception:          # noqa: BLE001 — capture never crashes
             rec["trace_summary"] = None
+        try:
+            # per-run blame vector (framework/blame.py): the causal
+            # critical-path split of the traced steps.  The
+            # blame_<cat>_ms per-step means join the summary series so
+            # `perf_report compare` can flag a bottleneck SHIFT
+            # (compute -> ps_wait at flat step time) cross-run by name.
+            # ``blame_result`` short-circuits the trace re-read for a
+            # caller that already computed it (health_check's report)
+            from paddle_tpu.framework import blame as _blame
+            res = blame_result if blame_result is not None else \
+                _blame.compute_blame(_blame.load_trace_dir(trace_dir))
+            if res.get("n_steps"):
+                rec["blame"] = {
+                    "n_steps": res["n_steps"],
+                    "totals_ms": res["totals_ms"],
+                    "per_step_ms": res["per_step_ms"],
+                    "shares": res["shares"],
+                    "top_category": res["top_category"],
+                    "unresolved_links": res["unresolved_links"]}
+                rec["summary"].update(_blame.summary(res))
+        except Exception:          # noqa: BLE001 — capture never crashes
+            pass
     if extra:
         rec.update(extra)
     return rec

@@ -24,7 +24,11 @@ production rebuild, three stages behind one iterator:
   core-starved hosts.
 
 Every stage is instrumented with the PR-5 observability plane: a tracer
-span per stage (``ingest.decode``, ``ingest.transfer``, ``ingest.wait``),
+span per stage (``ingest.fetch`` wrapping each batch's producer task,
+``ingest.decode``, ``ingest.transfer``, ``ingest.wait``) — each yielded
+batch additionally declares a causal ``ingest`` link
+(``Tracer.link_next``) that the consuming train step's span adopts, so
+``framework/blame.py`` can attribute input stalls to ``ingest_wait`` —
 per-stage time histograms (``ingest_decode_ms``, ``ingest_collate_ms``,
 ``ingest_transfer_ms``, ``ingest_wait_ms``), cache hit/miss counters,
 and ``input_stall_pct`` as a first-class exported gauge
@@ -417,7 +421,7 @@ class IngestPipeline:
         tr = self.tracer()
         with lock:
             seq = seq_box[0]
-            with tr.start_span("ingest.decode"):
+            with tr.start_span("ingest.decode", consume_links=False):
                 t0 = time.perf_counter()
                 try:
                     batch = next(it)
@@ -427,7 +431,7 @@ class IngestPipeline:
             stage = dict(getattr(self.loader, "last_stage_ms", None) or {})
             seq_box[0] += 1
         self._observe_stage_ms(stage, fetch_ms)
-        with tr.start_span("ingest.transfer"):
+        with tr.start_span("ingest.transfer", consume_links=False):
             t0 = time.perf_counter()
             dev = self.transfer(batch)
             monitor.observe("ingest_transfer_ms",
@@ -440,17 +444,38 @@ class IngestPipeline:
             health.memory.track("ingest", _nbytes(dev))
         return seq, dev
 
-    def _task(self, it, lock, seq_box):
-        """Background unit: chaos gate, then fetch+transfer.  The gate
-        fires BEFORE the loader is touched, so an injected error leaves
-        the iterator un-advanced and the consumer's synchronous
-        fallback fetches the exact batch this task would have."""
+    def _task(self, it, lock, seq_box, chaos_gate: bool = True):
+        """One producer unit — chaos gate (background tasks only), then
+        fetch+transfer — the whole thing under a detached
+        ``ingest.fetch`` producer span (the gate is INSIDE the span, so
+        injected ``data.pipeline`` latency widens the producer and
+        blame sees it as ``ingest_wait``).  The gate fires BEFORE the
+        loader is touched, so an injected error leaves the iterator
+        un-advanced and the consumer's synchronous fallback — this same
+        method with ``chaos_gate=False``, the fallback must not re-trip
+        the fault — fetches the exact batch this task would have.
+        Returns ``(seq, device_batch, producer_span_id)`` — the span id
+        is what the yield hand-off links into the consuming step."""
+        tr = self.tracer()
+        sp = tr.start_span("ingest.fetch", detached=True)
         try:
-            chaos.fault_point("data.pipeline",
-                              meta={"seq": seq_box[0]})
-        except chaos.InjectedFault:
-            return _FAULTED
-        return self._fetch_transfer(it, lock, seq_box)
+            if chaos_gate:
+                try:
+                    chaos.fault_point("data.pipeline",
+                                      meta={"seq": seq_box[0]})
+                except chaos.InjectedFault:
+                    sp.end(status="error", reason="chaos_fault")
+                    return _FAULTED
+            with tr.activate(sp.context()):
+                got = self._fetch_transfer(it, lock, seq_box)
+        except BaseException as e:
+            sp.end(status="error", exc=repr(e))
+            raise
+        if got is _DONE:
+            sp.end(status="ok", eos=True)
+            return _DONE
+        sp.end(status="ok", seq=got[0])
+        return (got[0], got[1], sp.span_id)
 
     # -- iteration ----------------------------------------------------------
     def __iter__(self):
@@ -462,18 +487,23 @@ class IngestPipeline:
     def _iter_sync(self):
         it = iter(self.loader)
         lock, seq_box = locks.lock("ingest.fetch"), [0]
+        tr = self.tracer()
         t_ret = None
         while True:
             if t_ret is not None:
                 self.downstream_ms_total += \
                     (time.perf_counter() - t_ret) * 1e3
             t0 = time.perf_counter()
-            got = self._fetch_transfer(it, lock, seq_box)
+            got = self._task(it, lock, seq_box, chaos_gate=False)
             if got is _DONE:
                 return
             self._note_wait((time.perf_counter() - t0) * 1e3)
             self._note_batch()
             t_ret = time.perf_counter()
+            if got[2] is not None:
+                # hand-off: the next span the consumer opens (its
+                # train step) causally links this batch's fetch
+                tr.link_next(got[2], "ingest")
             yield got[1]
 
     def _iter_pipelined(self):
@@ -509,7 +539,8 @@ class IngestPipeline:
                             "ingest pipeline wedged: nothing in flight "
                             f"while waiting for batch {expected}")
                     fut = inflight.popleft()
-                    with tr.start_span("ingest.wait"):
+                    with tr.start_span("ingest.wait",
+                                       consume_links=False):
                         t0 = time.perf_counter()
                         try:
                             got = fut.result(timeout=self.timeout)
@@ -526,18 +557,24 @@ class IngestPipeline:
                         # degraded batch: same-stream synchronous
                         # fetch+transfer (see class docstring)
                         monitor.stat_add("ingest_prefetch_misses_total")
-                        got = self._fetch_transfer(it, lock, seq_box)
+                        got = self._task(it, lock, seq_box,
+                                         chaos_gate=False)
                         if got is _DONE:
                             exhausted = True
                         else:
-                            ready[got[0]] = got[1]
+                            ready[got[0]] = (got[1], got[2])
                     else:
                         monitor.stat_add("ingest_prefetch_hits_total")
-                        ready[got[0]] = got[1]
-                dev = ready.pop(expected)
+                        ready[got[0]] = (got[1], got[2])
+                dev, producer_sid = ready.pop(expected)
                 expected += 1
                 self._note_batch()
                 t_ret = time.perf_counter()
+                if producer_sid is not None:
+                    # hand-off: the next span the consumer opens (its
+                    # train step) causally links this batch's fetch —
+                    # the edge blame walks to attribute ingest stalls
+                    tr.link_next(producer_sid, "ingest")
                 yield dev
         finally:
             self._active = None

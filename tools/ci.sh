@@ -127,6 +127,42 @@ if [ "$rc" -gt 1 ] || ! grep -q "^verdict:" "$OBSV/hist_verdict.txt"; then
 fi
 rm -rf "$OBSV"
 
+echo "== causal blame lane (span links -> critical path -> bottleneck-shift gate) =="
+# (1) clean traced PS mini-train: the per-step blame DAG must
+# reconstruct with ZERO unresolved links and its categories must sum
+# to within 5% of the measured step span (--check — the partition-
+# exactness acceptance).  (2) chaos leg: ps.rpc latency injected from
+# step 0 must make ps_wait the named TOP blame category (--expect-top
+# — "98% input stall vs PS wait" is now a computed verdict, not a
+# human reading merged traces).  (3) cross-run: two clean ledgered
+# runs + the latency run — each green on its OWN gates (the level
+# shift hides in warmup) — must be flagged by perf_report compare on
+# the blame_ps_wait_ms series BY NAME with rc 1 (a crashed comparator
+# also exits 1, hence the grep)
+BLAME=$(mktemp -d /tmp/pt_blame.XXXXXX)
+JAX_PLATFORMS=cpu python tools/perf_report.py blame --mini-train 12 \
+    --json "$BLAME/blame.json" --check
+JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
+    FLAGS_chaos_spec='{"ps.rpc": {"mode": "latency", "latency": 0.1, "every": 1}}' \
+    python tools/perf_report.py blame --mini-train 12 --check \
+    --expect-top ps_wait
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 12 --ps \
+    --ledger "$BLAME/ledger.jsonl" --max-anomalies 0
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 12 --ps \
+    --ledger "$BLAME/ledger.jsonl" --max-anomalies 0
+JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
+    FLAGS_chaos_spec='{"ps.rpc": {"mode": "latency", "latency": 0.1, "every": 1}}' \
+    python tools/health_check.py --mini-train 12 --ps \
+    --ledger "$BLAME/ledger.jsonl" --max-anomalies 0
+rc=0
+JAX_PLATFORMS=cpu python tools/perf_report.py compare \
+    --ledger "$BLAME/ledger.jsonl" | tee "$BLAME/verdict.txt" || rc=$?
+if [ "$rc" != 1 ] || ! grep -q "^REGRESSION .*blame_ps_wait" "$BLAME/verdict.txt"; then
+  echo "blame lane FAILED: bottleneck shift to ps_wait not named (rc=$rc)" >&2
+  exit 1
+fi
+rm -rf "$BLAME"
+
 echo "== concurrency lint + lock watchdog lane (PTA4xx static; runtime cycle naming) =="
 # static half: the in-tree sources must be PTA4xx-clean (zero errors AND
 # zero warnings — every accepted pattern carries an audited pragma), the

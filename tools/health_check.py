@@ -36,9 +36,11 @@ Inputs:
 Gates (any trip → exit 1): ``--max-anomalies`` (default 0),
 ``--max-steady-recompiles`` (default 0), ``--max-input-stall``
 (percent; off by default), ``--max-grad-anomalies`` (grad-norm
-detector trips; off by default), and — implicit with ``--nan-step`` —
-the NaN-provenance verdict (the seeded fault must be attributed to
-the poisoned leaf).
+detector trips; off by default), ``--max-blame category=pct``
+(repeatable; blame-share ceiling per causal category from
+``framework/blame.py`` — requires a trace), and — implicit with
+``--nan-step`` — the NaN-provenance verdict (the seeded fault must be
+attributed to the poisoned leaf).
 
 Usage::
 
@@ -63,7 +65,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 __all__ = ["load_metrics", "build_report", "evaluate_gates",
-           "format_report", "mini_train", "mini_train_ps", "main"]
+           "parse_max_blame", "format_report", "mini_train",
+           "mini_train_ps", "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -195,13 +198,41 @@ def build_report(snap: dict, trace_dir: Optional[str] = None,
         if paths:
             report["spans"] = trace_merge.summarize(
                 trace_merge.merge(paths))
+        from paddle_tpu.framework import blame
+        res = blame.compute_blame(blame.load_trace_dir(trace_dir))
+        if res["n_steps"]:
+            # the FULL result (edges trimmed): evaluate_gates reads
+            # shares/per_step_ms, and main() hands the same dict to
+            # runlog.capture(blame_result=) so the ledger record does
+            # not re-read and re-analyze the whole trace dir
+            report["blame"] = {**res, "edges": res["edges"][:5]}
     return report
+
+
+def parse_max_blame(specs) -> dict:
+    """Parse repeated ``--max-blame category=pct`` specs into
+    ``{category: pct}``; unknown categories and unparseable values are
+    errors (a typo'd gate that silently never trips gates nothing)."""
+    from paddle_tpu.framework.blame import CATEGORIES
+    out = {}
+    for spec in specs or ():
+        if "=" not in spec:
+            raise ValueError(
+                f"--max-blame expects category=pct, got {spec!r}")
+        cat, _, pct = spec.partition("=")
+        cat = cat.strip()
+        if cat not in CATEGORIES:
+            raise ValueError(f"--max-blame: unknown category {cat!r} "
+                             f"(one of {CATEGORIES})")
+        out[cat] = float(pct)
+    return out
 
 
 def evaluate_gates(report: dict, max_anomalies: int = 0,
                    max_steady_recompiles: int = 0,
                    max_input_stall: Optional[float] = None,
-                   max_grad_anomalies: Optional[int] = None) -> list:
+                   max_grad_anomalies: Optional[int] = None,
+                   max_blame: Optional[dict] = None) -> list:
     """Returns the list of tripped-gate descriptions (empty = healthy)."""
     tripped = []
     n_anom = report["anomalies"]["total"]
@@ -231,6 +262,20 @@ def evaluate_gates(report: dict, max_anomalies: int = 0,
             f"NaN provenance: expected first_bad_leaf="
             f"{prov.get('expected')!r}, got {prov.get('got')!r} "
             f"(nan_skips: {prov.get('nan_skips')})")
+    if max_blame:
+        bl = report.get("blame")
+        if bl is None:
+            tripped.append("blame gate set but no blame section "
+                           "(no trace dir, or no step spans traced)")
+        else:
+            for cat, limit in sorted(max_blame.items()):
+                pct = 100.0 * float((bl.get("shares") or {})
+                                    .get(cat, 0.0))
+                if pct > limit:
+                    tripped.append(
+                        f"blame share {cat}: {pct:.2f}% > {limit}% "
+                        f"({bl.get('per_step_ms', {}).get(cat)} "
+                        f"ms/step)")
     return tripped
 
 
@@ -293,6 +338,20 @@ def format_report(report: dict, tripped: list) -> str:
                          if kv[1] == kv[1] else float("inf")))[:5]
             lines.append("  top leaf grad norms: "
                          + "  ".join(f"{k}={v:.4g}" for k, v in top))
+    bl = report.get("blame")
+    if bl:
+        shares = bl.get("shares") or {}
+        per = bl.get("per_step_ms") or {}
+        parts = "  ".join(
+            f"{c}={100.0 * shares.get(c, 0.0):.1f}%"
+            f"({per.get(c, 0.0):.2f}ms)"
+            for c in sorted(shares, key=lambda c: -shares[c])
+            if shares.get(c, 0.0) > 0)
+        lines.append(f"blame ({bl.get('n_steps')} steps, top="
+                     f"{bl.get('top_category')}): {parts}")
+        if bl.get("unresolved_links"):
+            lines.append(
+                f"  UNRESOLVED LINKS: {bl['unresolved_links']}")
     if report.get("spans"):
         import trace_merge
         lines.append("-- span summary --")
@@ -547,7 +606,19 @@ def main(argv=None) -> int:
                     help="gate: tolerated grad-norm detector anomalies "
                          "(health_anomaly_grad_norm_total; off by "
                          "default)")
+    ap.add_argument("--max-blame", action="append", default=None,
+                    metavar="CATEGORY=PCT",
+                    help="gate (repeatable): tolerated blame share per "
+                         "category from the causal critical-path "
+                         "analysis, e.g. --max-blame ps_wait=30 — "
+                         "requires a trace (mini-train or "
+                         "--trace-dir); categories: compute, ps_wait, "
+                         "ingest_wait, collective, compile, other")
     a = ap.parse_args(argv)
+    try:
+        max_blame = parse_max_blame(a.max_blame)
+    except ValueError as e:
+        ap.error(str(e))
     if a.metrics is None and a.mini_train is None:
         ap.error("nothing to check: pass --metrics or --mini-train")
     if a.metrics is not None and a.mini_train is not None:
@@ -590,7 +661,8 @@ def main(argv=None) -> int:
         report, max_anomalies=a.max_anomalies,
         max_steady_recompiles=a.max_steady_recompiles,
         max_input_stall=a.max_input_stall,
-        max_grad_anomalies=a.max_grad_anomalies)
+        max_grad_anomalies=a.max_grad_anomalies,
+        max_blame=max_blame)
     report["tripped"] = tripped
     if a.ledger is not None:
         # one RunRecord per mini train, appended AFTER the gates ran so
@@ -600,6 +672,7 @@ def main(argv=None) -> int:
                                 "numerics" if a.numerics else "dense")
         rec = runlog.capture("health_check", label=label,
                              trace_dir=a.trace_dir,
+                             blame_result=report.get("blame"),
                              extra={"steps": a.mini_train,
                                     "tripped": tripped})
         runlog.RunLedger(a.ledger).append(rec)

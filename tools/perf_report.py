@@ -30,6 +30,20 @@ run ledger (``paddle_tpu/framework/runlog.py``) records for:
   WORSE direction are regressions (named, nonzero exit);
   better-direction anomalies are reported as improvements.
 
+* ``blame`` — causal critical-path attribution
+  (``framework/blame.py``): rebuild the per-step dependency DAG from a
+  trace's span links (prefetch -> step, ingest fetch -> step, deferred
+  push -> push_pull RPC), collapse the critical path into a per-step
+  blame vector over fixed categories (``compute`` / ``ps_wait`` /
+  ``ingest_wait`` / ``collective`` / ``compile`` / ``other``), and
+  report totals, shares and the top blocking edges.  ``--check`` gates
+  that every link resolves and the categories sum to within tolerance
+  of the measured step span; ``--expect-top ps_wait`` is the chaos
+  leg's assertion that injected RPC latency moved the bottleneck.
+  ``compare`` detects the same categories cross-run
+  (``blame_<cat>_ms`` series from each record's summary), so a
+  bottleneck SHIFT at flat step time is a named regression.
+
 * ``import`` — fold historical driver ``BENCH_r*.json`` artifacts into
   a ledger as ``imported_bench`` records, so the bench trajectory
   becomes a first-class compare series.
@@ -38,6 +52,8 @@ Usage::
 
     python tools/perf_report.py attribute --mini-train 3 --json prof.json --check
     python tools/perf_report.py attribute --trace-dir /tmp/tr --cost-json cost.json
+    python tools/perf_report.py blame --mini-train 12 --check
+    python tools/perf_report.py blame --trace-dir /tmp/tr --expect-top ps_wait
     python tools/perf_report.py compare --ledger runs/ledger.jsonl
     python tools/perf_report.py import BENCH_r0*.json --ledger runs/hist.jsonl
 """
@@ -301,6 +317,25 @@ SUMMARY_SIGNAL_CFG: Dict[str, dict] = {
                                 "z_threshold": 6.0},
     "cluster_report_gaps_total": {"worse": "up", "min_mad": 2.0,
                                   "rel_floor": 0.5},
+    # per-step blame series (framework/blame.py via runlog.capture):
+    # a run whose TOTAL step time is flat but whose blame shifted —
+    # compute fell, ps_wait rose — is a bottleneck shift, flagged by
+    # the category name.  Every category regresses UP (more blocked ms
+    # per step is worse whatever the resource); floors keep sub-ms
+    # localhost jitter quiet while an injected latency (tens of ms)
+    # clears them by an order of magnitude
+    "blame_compute_ms": {"worse": "up", "min_mad": 5.0,
+                         "rel_floor": 0.5},
+    "blame_ps_wait_ms": {"worse": "up", "min_mad": 2.0,
+                         "rel_floor": 0.5},
+    "blame_ingest_wait_ms": {"worse": "up", "min_mad": 2.0,
+                             "rel_floor": 0.5},
+    "blame_collective_ms": {"worse": "up", "min_mad": 2.0,
+                            "rel_floor": 0.5},
+    "blame_compile_ms": {"worse": "up", "min_mad": 10.0,
+                         "rel_floor": 1.0},
+    "blame_other_ms": {"worse": "up", "min_mad": 2.0,
+                       "rel_floor": 0.5},
 }
 
 
@@ -539,6 +574,52 @@ def _cmd_attribute(a) -> int:
     return 0
 
 
+def _cmd_blame(a) -> int:
+    from paddle_tpu.framework import blame
+    tmp = None
+    if a.mini_train is not None:
+        if a.trace_dir is None:
+            tmp = tempfile.TemporaryDirectory(prefix="perf_blame_")
+            a.trace_dir = tmp.name
+        import health_check
+        health_check.mini_train_ps(a.mini_train, a.trace_dir)
+    if a.trace_dir is None:
+        print("perf_report blame: need --mini-train or --trace-dir",
+              file=sys.stderr)
+        return 2
+    spans = blame.load_trace_dir(a.trace_dir)
+    if not spans:
+        print(f"perf_report blame: no trace_*.jsonl spans under "
+              f"{a.trace_dir}", file=sys.stderr)
+        return 2
+    result = blame.compute_blame(spans, step_span=a.step_span)
+    if a.json:
+        with open(a.json, "w") as f:
+            json.dump(result, f, indent=1, default=str)
+    print(blame.format_blame(result))
+    if a.check or a.expect_top:
+        # the sum/link-integrity gates arm only under --check:
+        # --expect-top alone must stay usable on input-stalled traces,
+        # whose cycle legitimately exceeds their step-span total
+        bad = blame.check(
+            result, tolerance=a.tolerance if a.check else None,
+            expect_top=a.expect_top)
+        if bad:
+            for b in bad:
+                print(f"CHECK FAILED: {b}", file=sys.stderr)
+            return 1
+        parts = [f"check ok: {result['n_steps']} step(s)"]
+        if a.check:
+            blame_sum = sum(result["totals_ms"].values())
+            parts.append(f"blame sum {blame_sum:.3f} ms vs step span "
+                         f"total {result['span_ms_total']:.3f} ms, "
+                         "0 unresolved links")
+        if a.expect_top:
+            parts.append(f"top category {result['top_category']}")
+        print(", ".join(parts))
+    return 0
+
+
 def _cmd_compare(a) -> int:
     from paddle_tpu.framework.runlog import RunLedger
     records = RunLedger(a.ledger).read()
@@ -605,6 +686,35 @@ def main(argv=None) -> int:
                     help="gate: every top-k op must have a positive "
                          "measured ms and finite achieved FLOP/s")
 
+    bl = sub.add_parser("blame",
+                        help="causal critical-path blame: rebuild the "
+                             "per-step dependency DAG from a trace "
+                             "(span links) and collapse it into "
+                             "per-category blocked-time vectors")
+    bl.add_argument("--trace-dir", default=None,
+                    help="directory of trace_*.jsonl span files")
+    bl.add_argument("--mini-train", type=int, default=None, metavar="N",
+                    help="self-contained mode: run the PS-backed "
+                         "traced N-step mini train "
+                         "(tools/health_check.py mini_train_ps) and "
+                         "blame its own trace")
+    bl.add_argument("--step-span", default="train.step",
+                    help="span name of the consuming step "
+                         "(default: train.step)")
+    bl.add_argument("--json", default=None, metavar="PATH",
+                    help="write the full blame result JSON here")
+    bl.add_argument("--check", action="store_true",
+                    help="gate: steps found, every link resolves, "
+                         "blame categories sum to within --tolerance "
+                         "of the measured step span")
+    bl.add_argument("--tolerance", type=float, default=0.05,
+                    help="blame-sum vs step-span tolerance for "
+                         "--check (default 0.05)")
+    bl.add_argument("--expect-top", default=None, metavar="CATEGORY",
+                    help="gate: the named category must carry the "
+                         "largest blame share (the chaos leg's "
+                         "ps_wait assertion)")
+
     cp = sub.add_parser("compare",
                         help="Detector-based cross-run regression "
                              "gate over a run ledger")
@@ -632,6 +742,8 @@ def main(argv=None) -> int:
     a = ap.parse_args(argv)
     if a.cmd == "attribute":
         return _cmd_attribute(a)
+    if a.cmd == "blame":
+        return _cmd_blame(a)
     if a.cmd == "compare":
         return _cmd_compare(a)
     return _cmd_import(a)

@@ -73,6 +73,7 @@ MODULES = [
     "paddle_tpu.framework.crypto",
     "paddle_tpu.framework.monitor",
     "paddle_tpu.framework.observability",
+    "paddle_tpu.framework.blame",
     "paddle_tpu.framework.health",
     "paddle_tpu.framework.numerics",
     "paddle_tpu.framework.runlog",

@@ -14,7 +14,12 @@ chrome://tracing / Perfetto-loadable JSON:
   to every span timestamp so all lanes share one timeline;
 * span args keep the trace/span/parent ids and status, so a client
   RPC and the server-side child it caused can be matched in the UI
-  (same ``trace``; child's ``parent`` == client span id).
+  (same ``trace``; child's ``parent`` == client span id);
+* **causal links** (``Span.link`` — prefetch -> consuming step, ingest
+  fetch -> step, deferred push -> push_pull RPC) render as flow-event
+  pairs (``ph: "s"``/``"f"``) so Perfetto draws the hand-off arrows;
+  ``validate_chrome_trace`` additionally gates link integrity (every
+  link resolves, the link graph is acyclic).
 
 Usage::
 
@@ -73,11 +78,30 @@ def load_span_file(path: str) -> Tuple[dict, List[dict]]:
 def merge(paths: List[str]) -> dict:
     """Merge span files into one chrome-trace dict.  Lane pids are the
     file index (stable and distinct even for in-process multi-role runs
-    that share one OS pid); real pids land in the lane metadata."""
+    that share one OS pid); real pids land in the lane metadata.
+
+    Causal span links (``Span.link`` — async hand-offs: prefetch ->
+    consuming step, ingest fetch -> step, deferred push -> push_pull
+    RPC) are kept in the consuming event's ``args["links"]`` AND
+    rendered as chrome-trace flow events (``ph: "s"`` at the producer
+    span's end, ``ph: "f"``/``bp: "e"`` at the consumer's start), so
+    Perfetto draws the arrow across lanes.  A link whose producer span
+    is absent from the merged set stays in args (no flow pair) —
+    :func:`validate_chrome_trace` flags it."""
     events: List[dict] = []
     lanes = []
+    span_events: List[dict] = []
+    span_index: Dict[str, dict] = {}    # span id -> its X event
     for lane, path in enumerate(paths):
+        # a rotated previous segment (<path>.1, FLAGS_trace_max_mb) is
+        # the same logical trace: fold it in first (older spans), with
+        # the current segment's process meta winning — so links into
+        # the previous segment resolve and summaries cover both
         meta, spans = load_span_file(path)
+        if os.path.exists(path + ".1"):
+            _, spans1 = load_span_file(path + ".1")
+            spans = spans1 + spans      # current segment's meta wins
+                                        # (a fresh segment re-emits it)
         lanes.append({"lane": lane, "file": os.path.basename(path),
                       "label": meta["label"], "os_pid": meta["pid"],
                       "clock_offset": meta["clock_offset"],
@@ -88,16 +112,40 @@ def merge(paths: List[str]) -> dict:
                                         f"(pid {meta['pid']})"}})
         shift_us = float(meta["clock_offset"]) * 1e6
         for sp in spans:
-            events.append({
+            args = {"trace": sp.get("trace"), "span": sp.get("span"),
+                    "parent": sp.get("parent"),
+                    "status": sp.get("status"),
+                    **(sp.get("attrs") or {})}
+            if sp.get("links"):
+                args["links"] = sp["links"]
+            ev = {
                 "name": sp.get("name", "?"), "ph": "X", "pid": lane,
                 "tid": sp.get("tid", 0),
                 "ts": float(sp.get("ts", 0.0)) + shift_us,
                 "dur": float(sp.get("dur", 0.0)),
                 "cat": sp.get("status", "ok"),
-                "args": {"trace": sp.get("trace"), "span": sp.get("span"),
-                         "parent": sp.get("parent"),
-                         "status": sp.get("status"),
-                         **(sp.get("attrs") or {})}})
+                "args": args}
+            events.append(ev)
+            span_events.append(ev)
+            sid = sp.get("span")
+            if sid is not None:
+                span_index[str(sid)] = ev
+    # second pass: one flow-event pair per RESOLVED link
+    flow_id = 0
+    for ev in span_events:
+        for link in ev["args"].get("links") or ():
+            src = span_index.get(str(link.get("span")))
+            if src is None:
+                continue
+            flow_id += 1
+            kind = str(link.get("kind", "link"))
+            events.append({"name": kind, "cat": "link", "ph": "s",
+                           "id": flow_id, "pid": src["pid"],
+                           "tid": src["tid"],
+                           "ts": src["ts"] + src["dur"]})
+            events.append({"name": kind, "cat": "link", "ph": "f",
+                           "bp": "e", "id": flow_id, "pid": ev["pid"],
+                           "tid": ev["tid"], "ts": ev["ts"]})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "metadata": {"files": lanes}}
 
@@ -107,14 +155,27 @@ def validate_chrome_trace(trace: dict) -> int:
     ``traceEvents`` list of well-formed events — every event has a str
     ``name``/``ph`` and int ``pid``; complete (``X``) events carry
     numeric non-negative ``ts``/``dur``; metadata (``M``) events carry
-    ``args``.  Returns the number of ``X`` span events; raises
-    ``ValueError`` on the first violation."""
+    ``args``; flow events (``s``/``f``) carry numeric ``ts`` and an
+    ``id``, and every flow id forms exactly one start/finish pair.
+
+    Link integrity (the causal layer's gate): every ``args["links"]``
+    entry on an X event must RESOLVE to an X event in the merge (a
+    dangling link means a producer span never closed or was lost — the
+    blame DAG would silently under-attribute), and the link graph must
+    be acyclic ("A waited for B waited for A" is not a causal history).
+
+    Returns the number of ``X`` span events; raises ``ValueError`` on
+    the first violation."""
     if not isinstance(trace, dict):
         raise ValueError("trace must be a JSON object")
     events = trace.get("traceEvents")
     if not isinstance(events, list):
         raise ValueError("missing traceEvents list")
     n_spans = 0
+    span_ids = set()
+    links: Dict[str, List[str]] = {}     # consumer span id -> producers
+    flow_starts: Dict[object, int] = {}
+    flow_ends: Dict[object, int] = {}
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
             raise ValueError(f"traceEvents[{i}]: not an object")
@@ -131,9 +192,70 @@ def validate_chrome_trace(trace: dict) -> int:
                         f"traceEvents[{i}]: X event needs numeric "
                         f"non-negative {k}")
             n_spans += 1
+            args = ev.get("args") or {}
+            sid = args.get("span")
+            if sid is not None:
+                span_ids.add(str(sid))
+            lks = args.get("links")
+            if lks is not None:
+                if not isinstance(lks, list):
+                    raise ValueError(
+                        f"traceEvents[{i}]: links must be a list")
+                for lk in lks:
+                    if not isinstance(lk, dict) or "span" not in lk:
+                        raise ValueError(
+                            f"traceEvents[{i}]: malformed link {lk!r}")
+                    if sid is not None:
+                        links.setdefault(str(sid), []).append(
+                            str(lk["span"]))
         elif ev["ph"] == "M":
             if not isinstance(ev.get("args"), dict):
                 raise ValueError(f"traceEvents[{i}]: M event needs args")
+        elif ev["ph"] in ("s", "f"):
+            if not isinstance(ev.get("ts"), (int, float)):
+                raise ValueError(
+                    f"traceEvents[{i}]: flow event needs numeric ts")
+            if "id" not in ev:
+                raise ValueError(f"traceEvents[{i}]: flow event needs id")
+            bucket = flow_starts if ev["ph"] == "s" else flow_ends
+            bucket[ev["id"]] = bucket.get(ev["id"], 0) + 1
+    # flow pairing: each id exactly one s and one f
+    for fid, n in flow_starts.items():
+        if n != 1 or flow_ends.get(fid, 0) != 1:
+            raise ValueError(f"flow id {fid!r}: not exactly one "
+                             "start/finish pair")
+    for fid in flow_ends:
+        if fid not in flow_starts:
+            raise ValueError(f"flow id {fid!r}: finish without start")
+    # every link resolves
+    for consumer, producers in links.items():
+        for p in producers:
+            if p not in span_ids:
+                raise ValueError(
+                    f"span {consumer}: link to unknown span {p}")
+    # no cycles in the link graph (iterative DFS, 3-color)
+    color: Dict[str, int] = {}
+    for root in links:
+        if color.get(root):
+            continue
+        stack = [(root, iter(links.get(root, ())))]
+        color[root] = 1
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                c = color.get(nxt, 0)
+                if c == 1:
+                    raise ValueError(
+                        f"link cycle through span {nxt}")
+                if c == 0:
+                    color[nxt] = 1
+                    stack.append((nxt, iter(links.get(nxt, ()))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = 2
+                stack.pop()
     return n_spans
 
 
@@ -144,25 +266,39 @@ def summarize(trace: dict) -> List[dict]:
     answer to "where did the time go" without opening Chrome."""
     durs: Dict[str, List[float]] = {}
     errors: Dict[str, int] = {}
+    categories: Dict[str, str] = {}
     for ev in trace.get("traceEvents", ()):
         if ev.get("ph") != "X":
             continue
         name = ev.get("name", "?")
         durs.setdefault(name, []).append(float(ev.get("dur", 0.0)) / 1e3)
-        status = (ev.get("args") or {}).get("status", ev.get("cat"))
+        args = ev.get("args") or {}
+        status = args.get("status", ev.get("cat"))
         if status == "error":
             errors[name] = errors.get(name, 0) + 1
+        cat = args.get("category")
+        if cat is not None and name not in categories:
+            categories[name] = str(cat)
     rows = []
     for name, ms in durs.items():
         ms.sort()
         n = len(ms)
-        p99 = ms[min(n - 1, max(0, int(0.99 * n + 0.5) - 1))]
-        rows.append({"name": name, "count": n,
-                     "total_ms": round(sum(ms), 3),
-                     "mean_ms": round(sum(ms) / n, 3),
-                     "p99_ms": round(p99, 3),
-                     "max_ms": round(ms[-1], 3),
-                     "errors": errors.get(name, 0)})
+        # single-sample group: the p99 IS that sample — pinned, since
+        # blame tooling consumes --summary-json rows directly
+        p99 = ms[0] if n == 1 else \
+            ms[min(n - 1, max(0, int(0.99 * n + 0.5) - 1))]
+        row = {"name": name, "count": n,
+               "total_ms": round(sum(ms), 3),
+               "mean_ms": round(sum(ms) / n, 3),
+               "p99_ms": round(p99, 3),
+               "max_ms": round(ms[-1], 3),
+               "errors": errors.get(name, 0)}
+        if name in categories:
+            # the span's blame category attr rides along so downstream
+            # consumers (framework/blame.py, perf_report) can bucket
+            # summary rows without re-reading the raw trace
+            row["category"] = categories[name]
+        rows.append(row)
     rows.sort(key=lambda r: r["total_ms"], reverse=True)
     return rows
 
