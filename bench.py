@@ -1051,6 +1051,11 @@ def main():
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.parallel import make_mesh, set_mesh
+    from paddle_tpu.framework.autopilot import maybe_apply_tuned_profile
+
+    # FLAGS_autotune_profile (tools/autotune.py output) retargets the
+    # wire/prefetch knobs before any bench constructs a train step
+    maybe_apply_tuned_profile(source="bench")
 
     on_accel = paddle.is_compiled_with_tpu()
     set_mesh(make_mesh({"dp": 1}, devices=jax.devices()[:1]))

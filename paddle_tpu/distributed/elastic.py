@@ -515,7 +515,8 @@ class ElasticAgent:
                  log: Callable[[str], None] = None,
                  member_names: Optional[Sequence[str]] = None,
                  endpoints: Optional[Dict[str, str]] = None,
-                 first_beat_deadline: Optional[float] = None):
+                 first_beat_deadline: Optional[float] = None,
+                 straggler_ttl: float = 60.0):
         self.store = store
         self.handles = list(handles)
         # member -> host:port, re-attached when the agent re-registers a
@@ -544,9 +545,17 @@ class ElasticAgent:
         self.log = log or (lambda m: None)
         self.events: List[tuple] = []
         #: latest cluster-reported straggler scores (collector hook —
-        #: see note_stragglers); empty until a collector reports
+        #: see note_stragglers); empty until a collector reports.  Raw
+        #: last-report values — read through straggler_view(), which
+        #: drops expired/evicted workers (collector worker_ttl idiom)
         self.straggler_scores: Dict[str, float] = {}
         self._straggling: set = set()
+        # staleness bookkeeping: last report time per scored worker and
+        # first continuously-flagged time per straggler — a dead
+        # worker's frozen score must never drive the shrink policy
+        self.straggler_ttl = float(straggler_ttl)
+        self._straggler_ts: Dict[str, float] = {}
+        self._straggler_since: Dict[str, float] = {}
         self._straggler_lock = locks.lock("elastic.stragglers")
         self._restarts: Dict[str, int] = {}
         self._alive_since: Dict[str, float] = {}
@@ -650,7 +659,7 @@ class ElasticAgent:
     _EVENT_SEVERITY = {
         "crashed": "error", "failed": "error", "hang_killed": "error",
         "lease_expired": "warn", "fenced": "warn", "shrunk": "warn",
-        "restart_scheduled": "warn",
+        "restart_scheduled": "warn", "straggler_killed": "warn",
     }
 
     def _schedule_or_shrink(self, h: WorkerHandle, now: float,
@@ -702,9 +711,18 @@ class ElasticAgent:
         named-straggler list (recomputed from ``threshold``, default
         ``FLAGS_collector_straggler_ratio``, when absent).  Newly
         flagged / recovered workers record ``elastic.straggler`` flight
-        events; the agent does NOT kill a straggler — shrink policy
-        stays an operator decision — but ``straggler_scores`` is live
-        state an autotuner or a future evict-the-slow policy reads.
+        events.
+
+        This call only RECORDS: the agent's actual shrink/replace
+        policy is :meth:`enforce_straggler_policy`, which acts on a
+        worker only after it has been flagged *continuously* for a
+        deadline — one slow interval never costs a worker its slot.
+        Scores are stamped with the agent's clock; reads
+        (:meth:`straggler_view`, :meth:`stragglers`,
+        :meth:`straggler_overdue`) drop scores older than
+        ``straggler_ttl`` or belonging to an evicted worker at READ
+        time (the collector's ``worker_ttl`` re-check idiom), so a
+        dead worker's frozen score can never drive a shrink.
         Thread-safe: the collector's handler threads call this while
         ``run()`` polls."""
         from paddle_tpu.framework.flags import flag as _flag
@@ -712,11 +730,19 @@ class ElasticAgent:
             thr = float(_flag("collector_straggler_ratio")) \
                 if threshold is None else float(threshold)
             flagged = [w for w, s in scores.items() if s >= thr]
+        now = self.clock()
         with self._straggler_lock:
             self.straggler_scores = dict(scores)
+            self._straggler_ts = {w: now for w in scores}
             newly = set(flagged) - self._straggling
             recovered = self._straggling - set(flagged)
             self._straggling = set(flagged)
+            # continuously-flagged since: kept across reports while the
+            # worker stays flagged, reset the moment it recovers
+            for w in newly:
+                self._straggler_since[w] = now
+            for w in recovered:
+                self._straggler_since.pop(w, None)
         for w in sorted(newly):
             self.log(f"elastic-agent: straggler {w} "
                      f"(score {scores.get(w, 0.0):.2f})")
@@ -727,10 +753,83 @@ class ElasticAgent:
                           worker=w, score=round(scores.get(w, 0.0), 3),
                           recovered=True)
 
-    def stragglers(self) -> List[str]:
-        """Currently flagged stragglers (collector-reported)."""
+    def _straggler_fresh_locked(self, name: str, now: float) -> bool:
+        # read-time staleness re-check (collector worker_ttl idiom):
+        # a score is live only if recently reported AND its worker is
+        # still a member the agent could act on
+        ts = self._straggler_ts.get(name)
+        if ts is None or now - ts > self.straggler_ttl:
+            return False
+        if name in self._gone:
+            return False
+        # membership applies only when the agent manages workers: an
+        # observer-mode agent (no handles) can't validate names, and
+        # enforce_straggler_policy re-checks _by_name before acting
+        return not self.handles or self._by_name(name) is not None
+
+    def straggler_view(self) -> Dict[str, float]:
+        """Live straggler scores: the raw collector report minus
+        expired (older than ``straggler_ttl``) and evicted workers,
+        re-evaluated at read time."""
+        now = self.clock()
         with self._straggler_lock:
-            return sorted(self._straggling)
+            return {w: s for w, s in self.straggler_scores.items()
+                    if self._straggler_fresh_locked(w, now)}
+
+    def stragglers(self) -> List[str]:
+        """Currently flagged stragglers (collector-reported), minus
+        expired/evicted workers (read-time re-check)."""
+        now = self.clock()
+        with self._straggler_lock:
+            return sorted(w for w in self._straggling
+                          if self._straggler_fresh_locked(w, now))
+
+    def straggler_overdue(self, deadline_s: float) -> List[str]:
+        """Stragglers flagged *continuously* for at least
+        ``deadline_s`` seconds (and still fresh/members) — the set
+        :meth:`enforce_straggler_policy` would act on right now."""
+        now = self.clock()
+        with self._straggler_lock:
+            return sorted(
+                w for w in self._straggling
+                if self._straggler_fresh_locked(w, now) and
+                now - self._straggler_since.get(w, now) >= deadline_s)
+
+    def enforce_straggler_policy(self, deadline_s: float) -> List[tuple]:
+        """Deadline-guarded shrink/replace for persistent stragglers.
+
+        A worker the collector has flagged continuously for
+        ``deadline_s`` seconds is treated like a hang: killed, its
+        lease dropped, then routed through the normal
+        restart-budget-then-shrink path (``_schedule_or_shrink``) — a
+        replace while budget lasts, a shrink-to-survive after.  The
+        staleness re-check means an already-dead or evicted worker is
+        never acted on.  Returns the events it appended (also recorded
+        as ``elastic.*`` flight events, same as ``poll_once``)."""
+        now = self.clock()
+        events: List[tuple] = []
+        for name in self.straggler_overdue(deadline_s):
+            h = self._by_name(name)
+            if h is None or name in self._gone or name in self._restart_at:
+                continue
+            score = self.straggler_scores.get(name, 0.0)
+            h.kill()
+            try:
+                self.store.leave(name)
+            except (LeaseExpired, chaos.InjectedFault, OSError):
+                pass                         # lease sweep owns cleanup
+            events.append(("straggler_killed", name, round(score, 3)))
+            self._schedule_or_shrink(h, now, events)
+            with self._straggler_lock:
+                self._straggling.discard(name)
+                self._straggler_since.pop(name, None)
+        self.events.extend(events)
+        for ev in events:
+            self.log(f"elastic-agent: {ev}")
+            flight.record("elastic." + ev[0],
+                          severity=self._EVENT_SEVERITY.get(ev[0], "info"),
+                          detail=list(ev[1:]), epoch=self.store.epoch())
+        return events
 
     def arm_hang_deadline(self, histogram: str = "train_step_ms",
                           multiplier: float = 50.0, floor: float = 5.0,

@@ -854,6 +854,18 @@ class PsClient:
             self._seq += 1
             return self._seq
 
+    def set_wire_dtype(self, wire_dtype: str) -> str:
+        """Flip the client's preferred wire encoding live (autopilot
+        actuator: bf16→f32 numerics retreat, f32→bf16 bandwidth
+        advance).  Clears the per-server negotiated push cache so the
+        next push to each server re-runs the ``hello`` handshake under
+        the new preference; in-flight RPCs finish under the old one.
+        Returns the previous preference."""
+        prev = self.wire_dtype
+        self.wire_dtype = normalize_wire(wire_dtype)
+        self._push_wires.clear()
+        return prev
+
     def _push_wire(self, s: int) -> str:
         """Negotiated dtype for rows this client SENDS to server ``s``
         (push gradients).  Resolved once per server via the ``hello``

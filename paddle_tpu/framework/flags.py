@@ -338,6 +338,78 @@ define_flag("runlog_dir", "",
             "RunRecord when an epoch range completes; bench.py and the "
             "tool CLIs (--ledger) take an explicit path and work either "
             "way.  Empty = implicit run recording off")
+# autopilot tier (framework/autopilot.py runtime controller +
+# tools/autotune.py offline knob search):
+define_flag("autopilot", False,
+            "arm the runtime autopilot controller "
+            "(framework/autopilot.py): telemetry the planes already "
+            "publish (health anomalies, blame summaries, straggler "
+            "scores, numerics.scale_collapse / train.nan_skip flight "
+            "events) maps through the declarative policy table onto "
+            "the bounded actuator registry (prefetch depth, wire "
+            "dtype, GradScaler growth, snapshot+restore, straggler "
+            "shrink).  Off (default): attach() returns None and the "
+            "train loop pays one flag lookup")
+define_flag("autopilot_dry_run", False,
+            "autopilot decisions are logged (flight events + ledger "
+            "action records) but NO actuator fires — the trajectory "
+            "is bitwise identical to an autopilot-off run")
+define_flag("autopilot_interval_steps", 8,
+            "steps between autopilot evaluation intervals: tick() is "
+            "called per train step, signals are read and policies "
+            "evaluated every Nth tick")
+define_flag("autopilot_hysteresis", 2,
+            "consecutive confirming evaluation intervals before a "
+            "policy's action fires (per-policy override in the "
+            "policy table); a one-interval blip never actuates")
+define_flag("autopilot_cooldown_s", 30.0,
+            "per-action cooldown: after an actuator fires (or is "
+            "reverted), the same action is suppressed for this many "
+            "seconds (injectable clock)")
+define_flag("autopilot_max_actions", 4,
+            "global action budget: at most this many actions taken "
+            "per autopilot_window_s rolling window; excess decisions "
+            "are suppressed and recorded (reason='budget')")
+define_flag("autopilot_window_s", 300.0,
+            "rolling window (s) for the autopilot_max_actions budget")
+define_flag("autopilot_rollback_intervals", 1,
+            "evaluation intervals after an action before the rollback "
+            "guard re-measures its objective (step interval mean + "
+            "anomaly/NaN rate) and reverts an action that made "
+            "things worse")
+define_flag("autopilot_rollback_tolerance", 0.25,
+            "relative objective worsening the rollback guard "
+            "tolerates before reverting (0.25 = step time may grow "
+            "25% before the action is judged harmful; any anomaly/"
+            "NaN-rate increase reverts regardless)")
+define_flag("autopilot_max_prefetch_depth", 4,
+            "ceiling the prefetch.deepen actuator will never push "
+            "PSTrainStep.prefetch_depth past")
+define_flag("autopilot_straggler_deadline", 60.0,
+            "seconds a collector-flagged straggler must stay flagged "
+            "(stale-checked) before the elastic.shrink actuator may "
+            "invoke ElasticAgent.enforce_straggler_policy")
+define_flag("autotune_profile", "",
+            "path of a tuned-knob profile JSON emitted by "
+            "tools/autotune.py; non-empty makes TrainStep/PSTrainStep/"
+            "bench.py apply the profile's knobs (ps_prefetch_depth, "
+            "ps_wire_dtype, zero_wire_dtype) via set_flags once per "
+            "process at first step construction — the runtime "
+            "controller then starts from a tuned operating point.  A "
+            "missing/corrupt profile degrades to a counted "
+            "autopilot.profile_error flight event, never a crash")
+# flight-recorder incident-storm guard (framework/observability.py):
+define_flag("flight_storm_window", 1.0,
+            "seconds within which identical (kind, attrs) flight "
+            "events are deduplicated once flight_storm_k of them "
+            "landed — a flapping signal during an incident cannot "
+            "wash the bounded ring of its root cause.  Suppressed "
+            "events still count into kind_totals and "
+            "flight_suppressed_total.  0 disables the guard")
+define_flag("flight_storm_k", 8,
+            "identical (kind, attrs) flight events tolerated per "
+            "flight_storm_window before further identical events are "
+            "suppressed (ring skipped, counters still bumped)")
 define_flag("profiler_max_spans", 100000,
             "cap on retained chrome-trace spans per profiling session; "
             "beyond it spans are dropped (counted — the Profiling "

@@ -232,6 +232,41 @@ class Detector:
             return Anomaly(self.signal, v, z, med, scale, self.n,
                            self.clock())
 
+    def last_value(self) -> Optional[float]:
+        """Most recent observed value, or ``None`` before the first
+        :meth:`observe` — the read half consumers (autopilot policies)
+        use instead of reaching into detector internals."""
+        with self._lock:
+            return self.last
+
+    def baseline(self) -> Optional[float]:
+        """Current robust baseline: the rolling-window median the z
+        score is computed against, or the EWMA while still warming
+        (too few samples for a median), or ``None`` before any data."""
+        with self._lock:
+            if self._values:
+                return float(np.median(np.asarray(self._values,
+                                                  np.float64)))
+            return self.ewma
+
+    def reset(self) -> None:
+        """Forget everything: window, EWMA, warmup progress, and all
+        counters — equivalent to a freshly constructed detector.
+        Distinct from the automatic rebaseline (which keeps lifetime
+        counters); callers use this at deliberate regime changes, e.g.
+        after an autopilot action rewrites the knob the signal
+        measures."""
+        with self._lock:
+            self._values.clear()
+            self._warm_left = self.warmup
+            self.ewma = None
+            self.last = None
+            self.last_z = 0.0
+            self.n = 0
+            self.anomalies = 0
+            self.consecutive = 0
+            self.rebaselines = 0
+
     def snapshot(self) -> dict:
         with self._lock:
             return {"n": self.n, "anomalies": self.anomalies,

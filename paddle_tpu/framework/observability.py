@@ -569,6 +569,11 @@ class FlightRecorder:
         # ring, not the sequence, so a post-clear event still sorts
         # after everything the collector already merged
         self._seq = 0
+        # incident-storm guard: per-(kind, attrs) [window_start, count]
+        # — a flapping signal repeating one identical event cannot wash
+        # the bounded ring of the root cause recorded before it
+        self._storm: Dict[tuple, list] = {}
+        self.suppressed = 0
 
     def _buf(self) -> "collections.deque":
         if self._ring is None:
@@ -583,14 +588,56 @@ class FlightRecorder:
         ev = {"ts": time.time(), "severity": severity, "kind": kind,
               "attrs": attrs}
         with self._lock:
+            # lifetime kind totals count EVERY event, suppressed or
+            # not — the run ledger's event mix stays truthful even
+            # when the storm guard keeps the ring readable
+            self._kind_totals[kind] = self._kind_totals.get(kind, 0) + 1
+            if self._storm_suppress_locked(kind, attrs, ev["ts"]):
+                self.suppressed += 1
+                monitor.stat_add("flight_suppressed_total")
+                ev["suppressed"] = True
+                return ev
             buf = self._buf()
             if len(buf) == buf.maxlen:
                 self.dropped += 1
             self._seq += 1
             ev["seq"] = self._seq
             buf.append(ev)
-            self._kind_totals[kind] = self._kind_totals.get(kind, 0) + 1
         return ev
+
+    def _storm_suppress_locked(self, kind: str, attrs: dict,
+                               now: float) -> bool:
+        """Incident-storm dedup (lock held): once ``flight_storm_k``
+        IDENTICAL ``(kind, attrs)`` events landed within
+        ``flight_storm_window`` seconds, further identical ones are
+        suppressed (ring skipped; ``flight_suppressed_total`` and the
+        lifetime kind totals still count them) until the window rolls.
+        Events differing in ANY attr (a retry's attempt number, a
+        chaos trip's call count) never dedup — only a truly flapping
+        signal is rate-limited."""
+        try:
+            window = float(flag("flight_storm_window"))
+            k = int(flag("flight_storm_k"))
+        except KeyError:           # flags not registered yet (early
+            return False           # import order) — guard off
+        if window <= 0 or k <= 0:
+            return False
+        try:
+            key = (kind, tuple(sorted(
+                (a, repr(v)) for a, v in attrs.items())))
+        except Exception:          # noqa: BLE001 — unorderable attrs:
+            return False           # never let the guard break record()
+        st = self._storm.get(key)
+        if st is None or now - st[0] > window:
+            if len(self._storm) >= 512:
+                # bound the guard's own memory: drop entries whose
+                # window already rolled (cheap sweep, rare)
+                self._storm = {kk: vv for kk, vv in self._storm.items()
+                               if now - vv[0] <= window}
+            self._storm[key] = [now, 1]
+            return False
+        st[1] += 1
+        return st[1] > k
 
     def last_seq(self) -> int:
         """The newest event's per-process seq id (0 = nothing recorded)
@@ -642,6 +689,8 @@ class FlightRecorder:
             self._buf().clear()
             self.dropped = 0
             self._kind_totals.clear()
+            self._storm.clear()
+            self.suppressed = 0
 
     def dump(self, path: str, worker: Optional[str] = None) -> str:
         """Write the ring to ``path`` as JSON, atomically (tmp+rename

@@ -327,6 +327,10 @@ class TrainStep:
                  amp_level: Optional[str] = None, amp_dtype="bfloat16",
                  accumulate_steps: int = 1, donate: bool = True,
                  recompute: bool = False):
+        # tuned startup profile (FLAGS_autotune_profile) lands before
+        # any flag-derived knob is read; no-op when unset
+        from paddle_tpu.framework.autopilot import maybe_apply_tuned_profile
+        maybe_apply_tuned_profile(source="TrainStep")
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer

@@ -1120,3 +1120,119 @@ class TestSigkillReform:
                 if p.poll() is None:
                     p.kill()
                     p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# straggler score staleness (collector worker_ttl idiom, read-time)
+# ---------------------------------------------------------------------------
+
+class TestStragglerStaleness:
+    """note_stragglers only records; every read (straggler_view /
+    stragglers / straggler_overdue / enforce_straggler_policy) drops
+    scores older than ``straggler_ttl`` or belonging to an evicted
+    worker AT READ TIME — a dead worker's frozen score can never drive
+    a shrink."""
+
+    def _agent(self, names, clock, ttl=5.0, **kw):
+        store = DictStore(ttl=60.0, clock=clock)
+        handles = []
+        for n in names:
+            store.register(n)
+            h = LocalHandle(n, lambda stop: stop.wait(10.0))
+            h.start()
+            handles.append(h)
+        return store, ElasticAgent(store, handles, clock=clock,
+                                   straggler_ttl=ttl, **kw)
+
+    def test_scores_expire_at_read_time(self):
+        clock = _Clock()
+        _, agent = self._agent(["a", "b"], clock, ttl=5.0)
+        try:
+            agent.note_stragglers({"a": 2.0, "b": 1.0}, flagged=["a"])
+            assert agent.straggler_view() == {"a": 2.0, "b": 1.0}
+            assert agent.stragglers() == ["a"]
+            clock.advance(5.1)
+            assert agent.straggler_view() == {}
+            assert agent.stragglers() == []
+            assert agent.straggler_overdue(0.0) == []
+            # the raw last-report dict is untouched — only reads filter
+            assert agent.straggler_scores == {"a": 2.0, "b": 1.0}
+        finally:
+            for h in agent.handles:
+                h.kill()
+
+    def test_unknown_or_evicted_worker_never_drives_policy(self):
+        clock = _Clock()
+        _, agent = self._agent(["a"], clock)
+        try:
+            # "ghost" was never a member the agent could act on
+            agent.note_stragglers({"a": 3.0, "ghost": 9.0},
+                                  flagged=["a", "ghost"])
+            assert "ghost" not in agent.straggler_view()
+            assert agent.stragglers() == ["a"]
+            agent._gone.add("a")        # evicted between report + read
+            assert agent.stragglers() == []
+            assert agent.enforce_straggler_policy(0.0) == []
+        finally:
+            for h in agent.handles:
+                h.kill()
+
+    def test_overdue_requires_continuous_flagging(self):
+        clock = _Clock()
+        _, agent = self._agent(["a"], clock, ttl=60.0)
+        try:
+            agent.note_stragglers({"a": 3.0}, flagged=["a"])
+            assert agent.straggler_overdue(10.0) == []
+            clock.advance(6.0)
+            agent.note_stragglers({"a": 3.0}, flagged=["a"])
+            assert agent.straggler_overdue(10.0) == []      # 6s < 10s
+            clock.advance(5.0)
+            agent.note_stragglers({"a": 3.0}, flagged=["a"])
+            assert agent.straggler_overdue(10.0) == ["a"]   # 11s
+            # one recovered report resets the continuous-flag clock
+            agent.note_stragglers({"a": 0.5}, flagged=[])
+            clock.advance(1.0)
+            agent.note_stragglers({"a": 3.0}, flagged=["a"])
+            assert agent.straggler_overdue(10.0) == []
+        finally:
+            for h in agent.handles:
+                h.kill()
+
+    def test_enforce_kills_then_shrinks_past_deadline(self):
+        from paddle_tpu.framework.observability import flight
+        flight.clear()
+        clock = _Clock()
+        _, agent = self._agent(["a", "b"], clock, ttl=60.0,
+                               elastic_retries=0, min_world=1)
+        try:
+            agent.note_stragglers({"a": 4.0, "b": 1.0}, flagged=["a"])
+            clock.advance(30.0)
+            agent.note_stragglers({"a": 4.0, "b": 1.0}, flagged=["a"])
+            evs = agent.enforce_straggler_policy(20.0)
+            names = [(e[0], e[1]) for e in evs]
+            assert ("straggler_killed", "a") in names
+            assert ("shrunk", "a") in names
+            assert not agent._by_name("a").alive()
+            # the straggler's state is consumed: enforcing again no-ops
+            assert agent.enforce_straggler_policy(0.0) == []
+            assert agent.stragglers() == []
+            kinds = [e["kind"] for e in flight.recent(30)]
+            assert "elastic.straggler_killed" in kinds
+        finally:
+            for h in agent.handles:
+                h.kill()
+
+    def test_enforce_replaces_while_budget_lasts(self):
+        clock = _Clock()
+        _, agent = self._agent(["a", "b"], clock, ttl=60.0,
+                               elastic_retries=1, min_world=1)
+        try:
+            agent.note_stragglers({"a": 4.0}, flagged=["a"])
+            clock.advance(30.0)
+            agent.note_stragglers({"a": 4.0}, flagged=["a"])
+            evs = agent.enforce_straggler_policy(20.0)
+            assert [(e[0], e[1]) for e in evs] == \
+                [("straggler_killed", "a"), ("restart_scheduled", "a")]
+        finally:
+            for h in agent.handles:
+                h.kill()

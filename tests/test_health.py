@@ -107,6 +107,36 @@ class TestDetector:
         with pytest.raises(ValueError, match="warmup"):
             health.Detector("t", warmup=1)
 
+    def test_read_api_last_value_and_baseline(self):
+        d = health.Detector("t", warmup=4, window=8)
+        assert d.last_value() is None and d.baseline() is None
+        for v in (10.0, 10.0, 12.0, 10.0, 11.0):
+            d.update(v)
+        assert d.last_value() == 11.0
+        # robust baseline = the window median the z-score judges against
+        assert d.baseline() == pytest.approx(10.0)
+        # an anomalous value updates last_value but never the baseline
+        for _ in range(8):
+            d.update(10.0)
+        a = d.update(500.0)
+        assert a is not None
+        assert d.last_value() == 500.0
+        assert d.baseline() == pytest.approx(10.0)
+
+    def test_reset_restores_fresh_detector(self):
+        d = health.Detector("t", warmup=4, window=8)
+        for v in (1.0, 1.0, 1.0, 1.0, 1.0, 100.0):
+            d.update(v)
+        assert d.anomalies == 1 and d.n == 6
+        d.reset()
+        assert d.last_value() is None and d.baseline() is None
+        assert d.n == 0 and d.anomalies == 0 and d.last_z == 0.0
+        # warmup restarts: a post-reset extreme is baseline, not anomaly
+        # (the deliberate regime-change semantics an autopilot action
+        # needs after rewriting the knob the signal measures)
+        assert d.update(1000.0) is None
+        assert d.last_value() == 1000.0
+
 
 # ---------------------------------------------------------------------------
 # HealthMonitor: registry, counters, chaos contract

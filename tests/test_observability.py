@@ -715,3 +715,69 @@ class TestTraceMergeTool:
                                   "tid": 0, "ts": -5.0, "dur": 1.0}]})
         with pytest.raises(ValueError):
             trace_merge.validate_chrome_trace([])
+
+
+# ---------------------------------------------------------------------------
+# flight incident-storm guard
+# ---------------------------------------------------------------------------
+
+class TestFlightStormGuard:
+    """k identical (kind, attrs) events in the window keep the ring
+    readable; lifetime kind totals stay truthful; anything differing in
+    any attr is a different incident and never dedups."""
+
+    def _flags(self, window, k):
+        from paddle_tpu.framework.flags import get_flags, set_flags
+        saved = get_flags(["flight_storm_window", "flight_storm_k"])
+        set_flags({"flight_storm_window": window, "flight_storm_k": k})
+        return lambda: set_flags(saved)
+
+    def test_identical_storm_suppressed_totals_truthful(self):
+        restore = self._flags(60.0, 3)
+        try:
+            monitor.reset_stat("flight_suppressed_total")
+            fr = FlightRecorder(capacity=64)
+            for _ in range(8):
+                fr.record("ps.retry", op="pull")
+            ring = [e for e in fr.recent(64) if e["kind"] == "ps.retry"]
+            assert len(ring) == 3                  # k kept, rest culled
+            assert fr.suppressed == 5
+            assert fr.kind_totals()["ps.retry"] == 8   # lifetime truth
+            assert monitor.get_stat("flight_suppressed_total") == 5
+        finally:
+            restore()
+
+    def test_distinct_attrs_never_dedup(self):
+        restore = self._flags(60.0, 2)
+        try:
+            fr = FlightRecorder(capacity=64)
+            for i in range(6):
+                fr.record("ps.retry", op="pull", attempt=i)
+            assert len(fr.recent(64)) == 6 and fr.suppressed == 0
+        finally:
+            restore()
+
+    def test_clear_resets_storm_state(self):
+        restore = self._flags(60.0, 2)
+        try:
+            fr = FlightRecorder(capacity=64)
+            for _ in range(5):
+                fr.record("k", a=1)
+            assert fr.suppressed == 3
+            fr.clear()
+            assert fr.suppressed == 0
+            for _ in range(2):
+                fr.record("k", a=1)
+            assert len(fr.recent(64)) == 2         # fresh window
+        finally:
+            restore()
+
+    def test_guard_off_when_disabled(self):
+        restore = self._flags(0.0, 0)
+        try:
+            fr = FlightRecorder(capacity=64)
+            for _ in range(20):
+                fr.record("k", a=1)
+            assert fr.suppressed == 0
+        finally:
+            restore()

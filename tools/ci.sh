@@ -231,6 +231,69 @@ fi
 rm -f /tmp/pt_collectives_fixture.json /tmp/pt_parity.txt \
     /tmp/pt_parity_chaos.txt
 
+echo "== autopilot lane (telemetry -> guarded recovery actions; offline autotune) =="
+# (1) clean leg: a healthy PS mini-train under the controller must take
+# ZERO actions (--max-actions 0 trips on any taken decision) — the
+# hysteresis/cooldown rails hold on clean telemetry.  (2) latency leg:
+# an n_times-bounded ps.rpc latency storm must drive the controller to
+# prefetch.deepen (--expect-action, gated BY NAME) and the post-storm
+# tail blame must come back compute-topped with ps_wait under 35% —
+# detection AND recovery are both computed verdicts.  (3) seeded-NaN
+# leg: a 5-step NaN storm must drive scaler.tighten + resilient.restore
+# and the run must still end with the correct provenance (the restore
+# actually reinstalled good weights).  (4) chaos leg: the same NaN
+# recipe with autopilot.act faulted — the actuator fault is swallowed
+# and counted (autopilot_act_errors_total), never raised; health_check
+# gates act_errors==0 on legs 1-3, so the counter is also proven wired.
+# (5) autotune smoke: measure a small knob grid into a ledger, search
+# it to a tuned profile, and verify a fresh run CONSUMES the profile at
+# startup (autopilot.profile_applied names the source); the same ledger
+# must still compare clean (knob sweeps live in extra, not summary).
+AUTO=$(mktemp -d /tmp/pt_autopilot.XXXXXX)
+JAX_PLATFORMS=cpu FLAGS_autopilot_interval_steps=4 \
+    python tools/health_check.py --mini-train 24 --ps --autopilot \
+    --max-actions 0 --max-anomalies 0 --ledger "$AUTO/ledger.jsonl"
+JAX_PLATFORMS=cpu FLAGS_autopilot_interval_steps=4 FLAGS_chaos_seed=1234 \
+    FLAGS_chaos_spec='{"ps.rpc": {"mode": "latency", "latency": 0.05, "every": 1, "n_times": 40}}' \
+    python tools/health_check.py --mini-train 60 --ps --autopilot \
+    --expect-action prefetch.deepen --blame-tail 20 \
+    --max-blame ps_wait=35 --max-anomalies 50 \
+    --ledger "$AUTO/ledger.jsonl"
+JAX_PLATFORMS=cpu FLAGS_autopilot_interval_steps=2 \
+    python tools/health_check.py --mini-train 30 --numerics \
+    --nan-step 10 --nan-storm 5 --autopilot \
+    --expect-action scaler.tighten --expect-action resilient.restore \
+    --max-anomalies 20 --max-grad-anomalies 20 \
+    --ledger "$AUTO/ledger.jsonl"
+# chaos leg: fault the actuator itself — the NaN recipe still exits 0
+# (fault swallowed), and the error counter names what happened
+rc=0
+JAX_PLATFORMS=cpu FLAGS_autopilot_interval_steps=2 FLAGS_chaos_seed=1234 \
+    FLAGS_chaos_spec='{"autopilot.act": {"mode": "error", "every": 1, "n_times": 1}}' \
+    python tools/health_check.py --mini-train 30 --numerics \
+    --nan-step 10 --nan-storm 5 --autopilot \
+    --max-anomalies 20 --max-grad-anomalies 20 \
+    | tee "$AUTO/chaos.txt" || rc=$?
+if [ "$rc" != 0 ] || ! grep -q "act_errors=1" "$AUTO/chaos.txt"; then
+  echo "autopilot lane FAILED: actuator fault not swallowed+counted (rc=$rc)" >&2
+  exit 1
+fi
+JAX_PLATFORMS=cpu python tools/autotune.py --ledger "$AUTO/tune.jsonl" \
+    --measure --steps 10 \
+    --grid "prefetch_depth=0,1;wire_dtype=f32;batch_size=8" \
+    --out "$AUTO/tuned.json"
+JAX_PLATFORMS=cpu FLAGS_autotune_profile="$AUTO/tuned.json" \
+    python tools/health_check.py --mini-train 8 --ps \
+    --max-anomalies 0 --ledger "$AUTO/tune.jsonl" \
+    | tee "$AUTO/tuned_run.txt"
+if ! grep -q "tuned profile applied: source=PSTrainStep" "$AUTO/tuned_run.txt"; then
+  echo "autopilot lane FAILED: tuned profile not consumed at startup" >&2
+  exit 1
+fi
+JAX_PLATFORMS=cpu python tools/perf_report.py compare \
+    --ledger "$AUTO/tune.jsonl"
+rm -rf "$AUTO"
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and
