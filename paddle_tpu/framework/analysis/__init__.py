@@ -25,6 +25,13 @@ surprises.  Two front ends share one diagnostic core:
   donation across collective boundaries, collectives under divergent
   conditionals.  Validated at runtime by the replica-parity probe
   (``parallel/parity.py``, ``FLAGS_replica_parity``).
+* :mod:`.pallas_kernels` — Pallas kernel pass family (PTA6xx): a kernel
+  model per ``pallas_call`` (grid, BlockSpec block shapes + index maps,
+  kernel-body AST) checked for grid/block tail bugs, low-precision
+  accumulation, output-block races, mis-anchored tail masks, analytic
+  VMEM overcommit, non-static kernel control flow.  Validated at
+  runtime by the interpret-vs-compiled-vs-reference differential
+  oracle (``ops/pallas/verify.py``, ``FLAGS_pallas_verify``).
 
 CLI: ``python tools/prog_lint.py <module|path> [--format=json|text]``.
 Suppression: ``# pta: disable=PTA201`` inline (see diagnostics.py).
@@ -39,8 +46,11 @@ from paddle_tpu.framework.analysis.diagnostics import (  # noqa: F401
     Diagnostic, Report, RULES, Severity)
 from paddle_tpu.framework.analysis.jaxpr_passes import (  # noqa: F401
     analyze_callable, analyze_jaxpr, analyze_model)
+from paddle_tpu.framework.analysis.pallas_kernels import (  # noqa: F401
+    analyze_kernels, trace_kernels)
 
 __all__ = ["Diagnostic", "Report", "RULES", "Severity", "analyze_jaxpr",
-           "analyze_callable", "analyze_collectives", "analyze_model",
-           "analyze_files", "analyze_sources", "lint_source", "lint_file",
-           "lint_threads_source"]
+           "analyze_callable", "analyze_collectives", "analyze_kernels",
+           "analyze_model", "analyze_files", "analyze_sources",
+           "lint_source", "lint_file", "lint_threads_source",
+           "trace_kernels"]

@@ -1,0 +1,846 @@
+"""Pallas kernel analysis — the fifth front end of the program analyzer.
+
+Hand-written kernels are where tiling off-by-ones, masked-tail bugs and
+silent low-precision accumulation live, and none of the existing front
+ends can see them: the jaxpr passes see one opaque ``pallas_call``
+equation, the AST lint sees ordinary Python.  This front end extracts a
+**kernel model** from every ``pallas_call`` site reached by a traced
+builder — the grid, each operand's BlockSpec block shape and index map,
+the kernel body's AST — and checks the invariants Mosaic will not check
+for you (out-of-bounds blocks read garbage and clipped writes silently
+drop data; nothing faults).
+
+Model extraction is capture-based: :func:`trace_kernels` patches
+``pl.pallas_call`` and abstractly evaluates the builder
+(``jax.eval_shape`` — no FLOPs, no device).  Index maps are plain
+arithmetic lambdas over grid coordinates, so the passes evaluate them on
+concrete grid points to decide coverage and write-revisit order
+analytically.  The kernel body is recovered via ``inspect`` and analyzed
+with the PTA2xx taint machinery re-scoped to kernel refs and
+``program_id``.
+
+Rules (stable IDs; see diagnostics.RULES):
+
+========  ==============================================================
+PTA601    grid/block tail bug: the grid's coverage (max block index ×
+          block) stops short of an output dim (tail rows never
+          written), or an input block overruns its dim with no iota
+          tail mask anywhere in the kernel body (garbage read)
+PTA602    low-precision accumulation: a dot/``@`` in a kernel touching
+          bf16/f16 operands without ``preferred_element_type``, or a
+          ``+=`` carry into a half-precision ref
+PTA603    output-block race: the output index_map ignores a grid axis
+          that is not innermost (revisits of one block interleave with
+          other blocks — last writer wins), or maps two distinct grid
+          points onto one block (non-injective)
+PTA604    tail mask off by the block origin: an iota compared against a
+          length without a ``program_id``-derived origin term while the
+          grid has more than one block — every block but the first is
+          mis-masked
+PTA605    analytic VMEM overcommit: 2× (double-buffered) in/out block
+          footprints + scratch exceed ``FLAGS_pallas_vmem_budget_kb``
+PTA606    non-static kernel control flow: Python ``if``/``while``/
+          ``for`` on a value derived from a ref load or ``program_id``
+          — trace-time concretization error waiting to happen
+========  ==============================================================
+
+Runtime half: ``ops/pallas/verify.py`` — the ``FLAGS_pallas_verify``
+differential oracle names a divergent operand with the SAME
+``<name>.<operand>`` label these passes use (see
+:func:`operand_labels`).
+
+Suppression: ``# pta: disable=PTA601`` on any line of the
+``pallas_call(...)`` call header suppresses call-anchored rules
+(601/603/605) there; body-anchored rules (602/604/606) take the pragma
+on the offending kernel-body line.  ``# pta: disable-file=`` in the
+first 10 lines works as everywhere else.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import re
+import textwrap
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.framework.analysis.ast_passes import _last_name, _Taint
+from paddle_tpu.framework.analysis.diagnostics import (
+    Diagnostic, Report, Severity, parse_suppressions, register_rule)
+
+__all__ = ["KernelModel", "OperandModel", "trace_kernels",
+           "analyze_kernels", "operand_labels"]
+
+register_rule("PTA601", "grid/block tail not covered or unmasked",
+              Severity.ERROR, "pallas")
+register_rule("PTA602", "low-precision accumulation in kernel",
+              Severity.WARNING, "pallas")
+register_rule("PTA603", "output-block race across grid axes",
+              Severity.ERROR, "pallas")
+register_rule("PTA604", "tail mask missing its block origin",
+              Severity.ERROR, "pallas")
+register_rule("PTA605", "analytic VMEM overcommit", Severity.WARNING,
+              "pallas")
+register_rule("PTA606", "non-static python control flow in kernel",
+              Severity.ERROR, "pallas")
+
+# how many grid points the analytic passes will enumerate exhaustively;
+# larger grids fall back to per-axis boundary sampling (index maps are
+# affine in practice, so boundaries decide coverage and dependence)
+_GRID_CAP = 4096
+# names of f32-accumulating dot helpers the PTA602 pass trusts (the
+# shared ops/pallas/common.py wrapper sets preferred_element_type)
+_SAFE_DOT_HELPERS = ("dot_nt",)
+_DOT_NAMES = {"dot", "dot_general", "matmul", "tensordot", "einsum"}
+_IOTA_NAMES = {"iota", "broadcasted_iota"}
+_PID_NAMES = {"program_id", "num_programs"}
+
+
+# ---------------------------------------------------------------------------
+# kernel model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OperandModel:
+    """One pallas_call operand: shape/dtype + its BlockSpec."""
+    label: str                         # param-derived short name
+    kind: str                          # "in" | "out"
+    shape: Tuple[int, ...]
+    dtype: Any
+    block_shape: Optional[Tuple[int, ...]]
+    index_map: Optional[Any]
+
+    def block_bytes(self) -> int:
+        shape = self.block_shape or self.shape
+        n = 1
+        for d in shape:
+            n *= int(d if d is not None else 1)
+        return n * np.dtype(self.dtype).itemsize
+
+
+@dataclass
+class KernelModel:
+    """Everything the passes know about one captured pallas_call."""
+    name: str                          # "<analysis name>" or "...[i]"
+    kernel_name: str
+    grid: Tuple[int, ...]
+    inputs: List[OperandModel]
+    outputs: List[OperandModel]
+    scratch: List[Tuple[Tuple[int, ...], Any]]
+    call_file: Optional[str] = None
+    call_line: Optional[int] = None
+    body_file: Optional[str] = None
+    body_tree: Optional[ast.AST] = None    # FunctionDef, real linenos
+    static_kwargs: Dict[str, Any] = field(default_factory=dict)
+    kernel_fn: Optional[Any] = None        # unwrapped callable (helper
+    #                                        resolution via __globals__)
+
+    @property
+    def operands(self) -> List[OperandModel]:
+        return self.inputs + self.outputs
+
+
+def _clean_param(name: str) -> str:
+    return re.sub(r"_(ref|scr|scratch)$", "", name).lstrip("_") or name
+
+
+def operand_labels(model: KernelModel) -> Tuple[List[str], List[str]]:
+    """(input labels, output labels) — ``<model.name>.<operand>``.
+
+    This is the shared label vocabulary: the runtime differential oracle
+    (ops/pallas/verify.py) reports its first divergent operand with the
+    same strings, so a static finding and a runtime divergence point at
+    one name.
+    """
+    return ([f"{model.name}.{op.label}" for op in model.inputs],
+            [f"{model.name}.{op.label}" for op in model.outputs])
+
+
+def _unwrap_kernel(kernel):
+    kw: Dict[str, Any] = {}
+    base = kernel
+    while isinstance(base, functools.partial):
+        kw.update(base.keywords or {})
+        base = base.func
+    return base, kw
+
+
+def _kernel_body(base) -> Tuple[Optional[str], Optional[ast.AST]]:
+    """(source file, FunctionDef with real line numbers) of the kernel,
+    or (None, None) when the source is unrecoverable (lambdas, exec)."""
+    try:
+        path = inspect.getsourcefile(base)
+        lines, lnum = inspect.getsourcelines(base)
+        src = textwrap.dedent("".join(lines))
+        tree = ast.parse(src)
+        fn = next(n for n in tree.body
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)))
+        ast.increment_lineno(fn, lnum - 1)
+        return path, fn
+    except Exception:                  # noqa: BLE001 — analysis is best-effort
+        return None, None
+
+
+def _param_names(body: Optional[ast.AST]) -> Optional[List[str]]:
+    """Positional parameter names of the kernel def, or None for
+    ``*args`` kernels (labels fall back to in0/out0/...)."""
+    if body is None:
+        return None
+    a = body.args
+    names = [p.arg for p in
+             list(getattr(a, "posonlyargs", [])) + list(a.args)]
+    if not names and a.vararg is not None:
+        return None
+    return names or None
+
+
+def _spec_list(specs, n: int) -> list:
+    if specs is None:
+        return [None] * n
+    if not isinstance(specs, (list, tuple)):
+        return [specs]
+    return list(specs)
+
+
+def _normalize_block(spec, shape):
+    if spec is None:
+        return None, None
+    blk = getattr(spec, "block_shape", None)
+    imap = getattr(spec, "index_map", None)
+    if blk is None:
+        return None, imap
+    return tuple(int(d) if d is not None else int(s)
+                 for d, s in zip(blk, shape)), imap
+
+
+def _scratch_entry(s):
+    shape = tuple(int(d) for d in getattr(s, "shape", ()))
+    dtype = getattr(s, "dtype", np.float32)
+    return shape, dtype
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+
+def trace_kernels(fn, *args, **kwargs) -> List[KernelModel]:
+    """Abstractly evaluate ``fn(*args)`` with ``pl.pallas_call`` patched
+    to record a :class:`KernelModel` per call site instead of running.
+
+    ``args`` may be arrays or ``jax.ShapeDtypeStruct``s; nothing is
+    executed (``jax.eval_shape``), so shapes are free — pass the real
+    model shapes, including the awkward non-divisible ones.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    captured: List[KernelModel] = []
+    real = pl.pallas_call
+
+    def fake(kernel, *, grid=None, in_specs=None, out_specs=None,
+             out_shape=None, scratch_shapes=(), **kw):
+        frame = inspect.currentframe().f_back
+        call_file = frame.f_code.co_filename if frame else None
+        call_line = frame.f_lineno if frame else None
+        base, static_kw = _unwrap_kernel(kernel)
+        body_file, body = _kernel_body(base)
+        grid_t = (int(grid),) if isinstance(grid, int) else \
+            tuple(int(g) for g in (grid or ()))
+
+        single_out = not isinstance(out_shape, (list, tuple))
+        out_structs = [out_shape] if single_out else list(out_shape)
+        outspecs = _spec_list(out_specs, len(out_structs))
+        scratch = [_scratch_entry(s) for s in (scratch_shapes or ())]
+
+        def runner(*ops):
+            inspecs = _spec_list(in_specs, len(ops))
+            names = _param_names(body)
+            n_in, n_out = len(ops), len(out_structs)
+            if names and len(names) >= n_in + n_out:
+                in_names = [_clean_param(n) for n in names[:n_in]]
+                out_names = [_clean_param(n)
+                             for n in names[n_in:n_in + n_out]]
+            else:
+                in_names = [f"in{i}" for i in range(n_in)]
+                out_names = [f"out{i}" for i in range(n_out)]
+            inputs, outputs = [], []
+            for i, op in enumerate(ops):
+                shape = tuple(int(d) for d in op.shape)
+                blk, imap = _normalize_block(
+                    inspecs[i] if i < len(inspecs) else None, shape)
+                inputs.append(OperandModel(in_names[i], "in", shape,
+                                           op.dtype, blk, imap))
+            for i, st in enumerate(out_structs):
+                shape = tuple(int(d) for d in st.shape)
+                blk, imap = _normalize_block(
+                    outspecs[i] if i < len(outspecs) else None, shape)
+                outputs.append(OperandModel(out_names[i], "out", shape,
+                                            st.dtype, blk, imap))
+            captured.append(KernelModel(
+                name="", kernel_name=getattr(base, "__name__", "<kernel>"),
+                grid=grid_t, inputs=inputs, outputs=outputs,
+                scratch=scratch, call_file=call_file, call_line=call_line,
+                body_file=body_file, body_tree=body,
+                static_kwargs=static_kw, kernel_fn=base))
+            outs = [jnp.zeros(st.shape, st.dtype) for st in out_structs]
+            return outs[0] if single_out else outs
+
+        return runner
+
+    pl.pallas_call = fake
+    try:
+        jax.eval_shape(functools.partial(fn, **kwargs), *args)
+    finally:
+        pl.pallas_call = real
+    return captured
+
+
+# ---------------------------------------------------------------------------
+# grid evaluation helpers
+# ---------------------------------------------------------------------------
+
+
+def _grid_points(grid: Tuple[int, ...]) -> List[Tuple[int, ...]]:
+    """Concrete grid coordinates to evaluate index maps on: the full
+    product when small, else per-axis boundary samples (first, second,
+    middle, last-1, last) crossed — index maps are affine in practice,
+    so boundaries decide coverage and axis dependence."""
+    if not grid:
+        return [()]
+    total = 1
+    for g in grid:
+        total *= max(g, 1)
+    if total <= _GRID_CAP:
+        pts = [()]
+        for g in grid:
+            pts = [p + (i,) for p in pts for i in range(max(g, 1))]
+        return pts
+    axes = []
+    for g in grid:
+        g = max(g, 1)
+        axes.append(sorted({0, 1 if g > 1 else 0, g // 2,
+                            g - 2 if g > 1 else 0, g - 1}))
+    pts = [()]
+    for ax in axes:
+        pts = [p + (i,) for p in pts for i in ax]
+    return pts
+
+
+def _eval_map(imap, point):
+    try:
+        out = imap(*point)
+    except Exception:                  # noqa: BLE001 — non-arithmetic map
+        return None
+    if not isinstance(out, tuple):
+        out = (out,)
+    try:
+        return tuple(int(v) for v in out)
+    except Exception:                  # noqa: BLE001 — traced values
+        return None
+
+
+def _axis_dependence(imap, grid) -> Optional[List[bool]]:
+    """depends[a] = varying grid axis a changes the block index."""
+    base = tuple(0 for _ in grid)
+    ref = _eval_map(imap, base)
+    if ref is None:
+        return None
+    depends = []
+    for a, g in enumerate(grid):
+        dep = False
+        for probe in {1 if g > 1 else 0, g - 1}:
+            if probe == 0:
+                continue
+            pt = tuple(probe if i == a else 0
+                       for i in range(len(grid)))
+            got = _eval_map(imap, pt)
+            if got is None:
+                return None
+            if got != ref:
+                dep = True
+        depends.append(dep)
+    return depends
+
+
+# ---------------------------------------------------------------------------
+# body AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _calls_named(node: ast.AST, names) -> List[ast.Call]:
+    return [n for n in ast.walk(node)
+            if isinstance(n, ast.Call) and _last_name(n.func) in names]
+
+
+def _body_has_iota_compare(body: Optional[ast.AST]) -> bool:
+    """Does this function body compare anything iota-derived?  Coarse:
+    any Compare whose subtree mentions an iota call or an iota-assigned
+    name counts as 'masks its tail'."""
+    if body is None:
+        return False
+    iota_names = set()
+    for n in ast.walk(body):
+        if isinstance(n, ast.Assign) and _calls_named(n.value, _IOTA_NAMES):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    iota_names.add(t.id)
+    for n in ast.walk(body):
+        if not isinstance(n, ast.Compare):
+            continue
+        for side in [n.left] + list(n.comparators):
+            if _calls_named(side, _IOTA_NAMES):
+                return True
+            if any(isinstance(x, ast.Name) and x.id in iota_names
+                   for x in ast.walk(side)):
+                return True
+    return False
+
+
+def _has_tail_guard(model: "KernelModel") -> bool:
+    """Tail-mask detection for PTA601: the kernel body itself, or any
+    module-level helper it calls (one level — masking is routinely
+    factored into ``_rebuild_p``-style helpers shared across kernels)."""
+    body = model.body_tree
+    if _body_has_iota_compare(body):
+        return True
+    fn = model.kernel_fn
+    if body is None or fn is None:
+        return False
+    helpers = {_last_name(n.func) for n in ast.walk(body)
+               if isinstance(n, ast.Call)}
+    modglobals = getattr(fn, "__globals__", {})
+    for name in helpers:
+        h = modglobals.get(name) if name else None
+        if not callable(h) or isinstance(h, type):
+            continue
+        _, hbody = _kernel_body(h)
+        if _body_has_iota_compare(hbody):
+            return True
+    return False
+
+
+class _KernelTaint(_Taint):
+    """PTA2xx taint re-scoped to a kernel body: refs (the positional
+    params) and ``program_id`` results are the taint sources; static
+    kwargs bound via functools.partial stay clean."""
+
+    def __call__(self, node):
+        if isinstance(node, ast.Call) and \
+                _last_name(node.func) in _PID_NAMES:
+            return True
+        return super().__call__(node)
+
+
+# ---------------------------------------------------------------------------
+# the passes
+# ---------------------------------------------------------------------------
+
+
+class _Ctx:
+    def __init__(self, report: Report, model: KernelModel):
+        self.report = report
+        self.model = model
+        self._sups: Dict[str, Any] = {}
+        self._spans: Dict[Tuple[str, int], Tuple[int, int]] = {}
+
+    # -- suppression ------------------------------------------------------
+
+    def _sup_for(self, path: Optional[str]):
+        if not path:
+            return None
+        if path not in self._sups:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self._sups[path] = parse_suppressions(f.read())
+            except OSError:
+                self._sups[path] = None
+        return self._sups[path]
+
+    def _call_span(self) -> Tuple[Optional[int], Optional[int]]:
+        """Line span of the ``pallas_call(...)`` expression enclosing the
+        recorded call line — the 'call header' a pragma may ride."""
+        m = self.model
+        key = (m.call_file or "", m.call_line or 0)
+        if key in self._spans:
+            return self._spans[key]
+        span = (m.call_line, m.call_line)
+        try:
+            with open(m.call_file, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+            best = None
+            for n in ast.walk(tree):
+                if not (isinstance(n, ast.Call)
+                        and _last_name(n.func) == "pallas_call"):
+                    continue
+                lo, hi = n.lineno, n.end_lineno or n.lineno
+                if lo <= m.call_line <= hi and \
+                        (best is None or (hi - lo) < (best[1] - best[0])):
+                    best = (lo, hi)
+            if best is not None:
+                span = best
+        except Exception:              # noqa: BLE001 — span is best-effort
+            pass
+        self._spans[key] = span
+        return span
+
+    def emit_call(self, rule: str, message: str, severity: Severity,
+                  hint: Optional[str] = None):
+        sup = self._sup_for(self.model.call_file)
+        if sup is not None:
+            lo, hi = self._call_span()
+            if lo is not None and not all(
+                    sup.allows(rule, ln) for ln in range(lo, hi + 1)):
+                return
+        self.report.add(Diagnostic(
+            rule, message, severity, file=self.model.call_file,
+            line=self.model.call_line, hint=hint))
+
+    def emit_body(self, rule: str, node: ast.AST, message: str,
+                  severity: Severity, hint: Optional[str] = None):
+        line = getattr(node, "lineno", None)
+        sup = self._sup_for(self.model.body_file)
+        if sup is not None and not sup.allows(rule, line):
+            return
+        self.report.add(Diagnostic(
+            rule, message, severity, file=self.model.body_file,
+            line=line, hint=hint))
+
+
+def _pass_tail_coverage(ctx: _Ctx):
+    """PTA601: grid coverage vs operand dims, tail masks vs overruns."""
+    m = ctx.model
+    guarded = _has_tail_guard(m)
+    pts = _grid_points(m.grid)
+    for op in m.operands:
+        if op.block_shape is None or op.index_map is None:
+            continue
+        idxs = [v for v in (_eval_map(op.index_map, p) for p in pts)
+                if v is not None]
+        if not idxs or len(idxs[0]) != len(op.block_shape):
+            continue
+        for d, blk in enumerate(op.block_shape):
+            dim = op.shape[d]
+            if blk <= 0:
+                continue
+            covered = (max(i[d] for i in idxs) + 1) * blk
+            label = f"{m.name}.{op.label}"
+            if op.kind == "out" and covered < dim:
+                ctx.emit_call(
+                    "PTA601",
+                    f"{label}: grid covers only {covered} of {dim} "
+                    f"rows along dim {d} (block {blk}, max block index "
+                    f"{covered // blk - 1}) — the tail is never "
+                    f"written and reads back as garbage",
+                    Severity.ERROR,
+                    hint="size the grid with pl.cdiv(dim, block) and "
+                         "mask the tail block, or pad the operand to a "
+                         "block multiple")
+            elif op.kind == "in" and covered > dim and not guarded:
+                ctx.emit_call(
+                    "PTA601",
+                    f"{label}: block {blk} does not divide dim {d} "
+                    f"({dim}) and no iota tail mask guards the load — "
+                    f"the overrun block reads garbage",
+                    Severity.ERROR,
+                    hint="mask with origin + broadcasted_iota < length "
+                         "before reducing, or pad the operand")
+
+
+def _pass_output_race(ctx: _Ctx):
+    """PTA603: write-revisit order and injectivity of output maps."""
+    m = ctx.model
+    pts = _grid_points(m.grid)
+    for op in m.outputs:
+        if op.index_map is None:
+            continue
+        depends = _axis_dependence(op.index_map, m.grid)
+        if depends is None:
+            continue
+        ignored = [a for a, (dep, g) in enumerate(zip(depends, m.grid))
+                   if not dep and g > 1]
+        used = [a for a, dep in enumerate(depends) if dep]
+        label = f"{m.name}.{op.label}"
+        if ignored and used and max(used) > min(ignored):
+            ctx.emit_call(
+                "PTA603",
+                f"{label}: output index_map ignores grid axis "
+                f"{min(ignored)} (size {m.grid[min(ignored)]}) while "
+                f"axis {max(used)} varies inside it — revisits of one "
+                f"output block interleave with other blocks, so two "
+                f"grid points race on one write (last writer wins)",
+                Severity.ERROR,
+                hint="make reduced axes the innermost grid axes (then "
+                     "accumulate in scratch and write on the last "
+                     "visit), or include the axis in the index_map")
+            continue
+        seen: Dict[Tuple[int, ...], Tuple[int, ...]] = {}
+        for p in pts:
+            proj = tuple(p[a] for a in used)
+            out = _eval_map(op.index_map, p)
+            if out is None:
+                break
+            if proj in seen:
+                continue
+            if out in seen.values():
+                ctx.emit_call(
+                    "PTA603",
+                    f"{label}: output index_map is not injective — "
+                    f"grid points with distinct coordinates on its "
+                    f"used axes map onto block {out}, two grid points "
+                    f"write one block",
+                    Severity.ERROR,
+                    hint="an output block must have exactly one "
+                         "producing grid point per sweep of the "
+                         "reduced axes")
+                break
+            seen[proj] = out
+
+
+def _pass_low_precision(ctx: _Ctx):
+    """PTA602: dots without an f32 accumulator; += into half refs."""
+    m = ctx.model
+    body = m.body_tree
+    if body is None:
+        return
+    half = {"bfloat16", "float16"}
+    halfprec = any(np.dtype(op.dtype).name in ("float16",)
+                   or str(op.dtype) in half for op in m.operands)
+    # name -> dtype for resolvable (named-param) kernels
+    names = _param_names(body)
+    dtypes: Dict[str, Any] = {}
+    if names:
+        slots = [op.dtype for op in m.operands] + \
+            [dt for _, dt in m.scratch]
+        for n, dt in zip(names, slots):
+            dtypes[n] = dt
+    for node in ast.walk(body):
+        if isinstance(node, ast.Call) and \
+                _last_name(node.func) in _DOT_NAMES:
+            fname = _last_name(node.func) or ""
+            if any(h in fname for h in _SAFE_DOT_HELPERS):
+                continue
+            kws = {k.arg for k in node.keywords}
+            if "preferred_element_type" not in kws and halfprec:
+                ctx.emit_body(
+                    "PTA602", node,
+                    f"{m.name}: `{fname}` on a kernel with bf16/f16 "
+                    f"operands and no preferred_element_type — the "
+                    f"product accumulates at input precision",
+                    Severity.WARNING,
+                    hint="pass preferred_element_type=jnp.float32 (or "
+                         "use ops.pallas.common.dot_nt)")
+        elif isinstance(node, ast.BinOp) and \
+                isinstance(node.op, ast.MatMult) and halfprec:
+            ctx.emit_body(
+                "PTA602", node,
+                f"{m.name}: `@` matmul in a kernel with bf16/f16 "
+                f"operands accumulates at input precision",
+                Severity.WARNING,
+                hint="use jax.lax.dot_general with "
+                     "preferred_element_type=jnp.float32")
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.target, ast.Subscript) and \
+                isinstance(node.target.value, ast.Name):
+            dt = dtypes.get(node.target.value.id)
+            if dt is not None and str(dt) in half:
+                ctx.emit_body(
+                    "PTA602", node,
+                    f"{m.name}: `+=` carry into half-precision ref "
+                    f"`{node.target.value.id}` — repeated adds round "
+                    f"to nothing",
+                    Severity.WARNING,
+                    hint="accumulate in an f32 VMEM scratch and cast "
+                         "once on the final write")
+
+
+def _pass_tail_origin(ctx: _Ctx):
+    """PTA604: iota compared against a length without the block origin."""
+    m = ctx.model
+    body = m.body_tree
+    if body is None or not any(g > 1 for g in m.grid):
+        return
+    taint = _KernelTaint(set())        # pid taint via _KernelTaint.Call
+    pid_names, iota_unanchored = set(), set()
+    for n in ast.walk(body):
+        if not isinstance(n, ast.Assign):
+            continue
+        anchored = bool(_calls_named(n.value, _PID_NAMES)) or any(
+            isinstance(x, ast.Name) and x.id in pid_names
+            for x in ast.walk(n.value))
+        if anchored:
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    pid_names.add(t.id)
+                    iota_unanchored.discard(t.id)
+            continue
+        if _calls_named(n.value, _IOTA_NAMES) or any(
+                isinstance(x, ast.Name) and x.id in iota_unanchored
+                for x in ast.walk(n.value)):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    iota_unanchored.add(t.id)
+    del taint
+
+    def _unanchored_iota(side) -> bool:
+        has_iota = bool(_calls_named(side, _IOTA_NAMES)) or any(
+            isinstance(x, ast.Name) and x.id in iota_unanchored
+            for x in ast.walk(side))
+        if not has_iota:
+            return False
+        anchored = bool(_calls_named(side, _PID_NAMES)) or any(
+            isinstance(x, ast.Name) and x.id in pid_names
+            for x in ast.walk(side))
+        return not anchored
+
+    for n in ast.walk(body):
+        if not isinstance(n, ast.Compare):
+            continue
+        for side in [n.left] + list(n.comparators):
+            if _unanchored_iota(side):
+                ctx.emit_body(
+                    "PTA604", n,
+                    f"{m.name}: iota compared against a length without "
+                    f"a program_id-derived block origin while the grid "
+                    f"has multiple blocks — every block but the first "
+                    f"is mis-masked",
+                    Severity.ERROR,
+                    hint="compare `axis_block_index * block + iota` "
+                         "against the length, not the bare iota")
+                break
+
+
+def _pass_vmem(ctx: _Ctx, budget_kb: int):
+    """PTA605: 2×(in+out blocks) + scratch vs the VMEM budget flag."""
+    m = ctx.model
+    blocks = sum(op.block_bytes() for op in m.operands) * 2
+    scratch = sum(int(np.prod(s, dtype=np.int64))
+                  * np.dtype(dt).itemsize for s, dt in m.scratch)
+    total = blocks + scratch
+    if budget_kb > 0 and total > budget_kb * 1024:
+        ctx.emit_call(
+            "PTA605",
+            f"{m.name}: analytic VMEM footprint {total // 1024} KB "
+            f"(2× double-buffered blocks {blocks // 1024} KB + scratch "
+            f"{scratch // 1024} KB) exceeds the "
+            f"{budget_kb} KB budget (FLAGS_pallas_vmem_budget_kb)",
+            Severity.WARNING,
+            hint="shrink block shapes or scratch; raise the flag only "
+                 "if the target core really has the headroom")
+
+
+def _pass_static_flow(ctx: _Ctx):
+    """PTA606: Python control flow on ref-/program_id-derived values."""
+    m = ctx.model
+    body = m.body_tree
+    if body is None:
+        return
+    tainted = set()
+    a = body.args
+    for p in list(getattr(a, "posonlyargs", [])) + list(a.args):
+        tainted.add(p.arg)             # positional params are refs
+    if a.vararg is not None:
+        tainted.add(a.vararg.arg)
+    taint = _KernelTaint(tainted)
+
+    def walk(stmts):
+        for st in stmts:
+            if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = getattr(st, "value", None)
+                if value is not None and taint(value):
+                    targets = st.targets if isinstance(st, ast.Assign) \
+                        else [st.target]
+                    for t in targets:
+                        for x in ast.walk(t):
+                            if isinstance(x, ast.Name):
+                                tainted.add(x.id)
+            if isinstance(st, ast.If):
+                if taint(st.test):
+                    ctx.emit_body(
+                        "PTA606", st,
+                        f"{m.name}: Python `if` on a ref-/program_id-"
+                        f"derived value inside the kernel body — the "
+                        f"trace concretizes (or crashes) on a tracer",
+                        Severity.ERROR,
+                        hint="use pl.when(...) or jnp.where; Python "
+                             "branches may only test static kwargs")
+                walk(st.body)
+                walk(st.orelse)
+            elif isinstance(st, ast.While):
+                if taint(st.test):
+                    ctx.emit_body(
+                        "PTA606", st,
+                        f"{m.name}: Python `while` bounded by a traced "
+                        f"kernel value",
+                        Severity.ERROR,
+                        hint="use jax.lax control flow; kernel loops "
+                             "must have static trip counts")
+                walk(st.body)
+            elif isinstance(st, ast.For):
+                if taint(st.iter):
+                    ctx.emit_body(
+                        "PTA606", st,
+                        f"{m.name}: Python `for` bounded by a traced "
+                        f"kernel value (e.g. range over a ref load)",
+                        Severity.ERROR,
+                        hint="loop bounds inside a kernel must be "
+                             "static (grid axes or static kwargs)")
+                walk(st.body)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(st.body)          # pl.when callees are kernel code
+            elif isinstance(st, ast.With):
+                walk(st.body)
+            elif isinstance(st, ast.Try):
+                walk(st.body)
+                for h in st.handlers:
+                    walk(h.body)
+                walk(st.finalbody)
+
+    walk(body.body)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def analyze_kernels(fn, *args, name: str = "kernels",
+                    disable: Sequence[str] = (),
+                    vmem_budget_kb: Optional[int] = None,
+                    **kwargs) -> Report:
+    """Trace ``fn(*args)``, extract a kernel model per ``pallas_call``,
+    run the PTA6xx passes, return a :class:`Report`.
+
+    ``name`` prefixes every operand label (``<name>.<operand>``) — use
+    the same name when arming the runtime oracle so both halves of the
+    plane speak about one operand with one string.  A builder that
+    reaches no ``pallas_call`` yields an empty (clean) report — the
+    passes are a no-op on plain XLA programs.
+    """
+    if vmem_budget_kb is None:
+        try:
+            from paddle_tpu.framework.flags import flag
+            vmem_budget_kb = int(flag("pallas_vmem_budget_kb"))
+        except Exception:              # noqa: BLE001 — analyzable without flags
+            vmem_budget_kb = 16384
+    models = trace_kernels(fn, *args, **kwargs)
+    report = Report()
+    for i, m in enumerate(models):
+        m.name = name if len(models) == 1 else \
+            f"{name}.{m.kernel_name.strip('_') or i}"
+        ctx = _Ctx(report, m)
+        _pass_tail_coverage(ctx)
+        _pass_output_race(ctx)
+        _pass_low_precision(ctx)
+        _pass_tail_origin(ctx)
+        _pass_vmem(ctx, vmem_budget_kb)
+        _pass_static_flow(ctx)
+    if disable:
+        report = report.filter(disable=disable)
+    return report

@@ -118,6 +118,16 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         step (the trajectory stays bit-identical);
                         ``mode="latency"`` a slow probe the step simply
                         absorbs
+``pallas.verify``       head of every Pallas differential-oracle check
+                        (ops/pallas/verify.py verify_call, armed via
+                        FLAGS_pallas_verify) — ``mode="error"`` is a
+                        broken oracle the verification path must
+                        swallow and count
+                        (``pallas_verify_errors_total``): the watcher
+                        must never perturb or crash the watched kernel
+                        call (its output stays bit-identical);
+                        ``mode="latency"`` a slow oracle the call
+                        simply absorbs
 =====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
@@ -159,7 +169,8 @@ FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "elastic.lease", "elastic.worker_hang",
                 "health.detector", "zero.collective",
                 "numerics.observe", "runlog.observe", "collector.rpc",
-                "locks.observe", "parity.observe", "autopilot.act")
+                "locks.observe", "parity.observe", "autopilot.act",
+                "pallas.verify")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)

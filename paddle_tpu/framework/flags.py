@@ -330,6 +330,26 @@ define_flag("replica_parity_every", 16,
             "(the probe is one tiny fused shard_map program; at the "
             "default cadence its cost amortizes below the op_bench "
             "--parity-probe 2% step-time gate)")
+# pallas kernel verification tier (ops/pallas/verify.py differential
+# oracle — the runtime half of the PTA6xx static passes):
+define_flag("pallas_verify", False,
+            "arm the Pallas differential oracle: verify_call() runs a "
+            "kernel in interpret=True mode against its compiled form "
+            "and against the pure-jnp reference on the call's shapes "
+            "(flash_autotune additionally sweeps the boundary-shape "
+            "corpus per tiling candidate before timing it); a "
+            "disagreeing output fires a pallas.divergence flight "
+            "event naming the first divergent operand with the SAME "
+            "<name>.<operand> label the static PTA6xx pass uses and "
+            "counts pallas_divergence_total.  The oracle NEVER raises "
+            "(pallas.verify chaos point + swallow-and-count, "
+            "pallas_verify_errors_total).  Off (default): one flag "
+            "lookup — the kernel callables are not even invoked")
+define_flag("pallas_vmem_budget_kb", 16384,
+            "analytic VMEM budget (KB) for the static PTA605 pass: "
+            "2x the double-buffered in/out block footprints plus "
+            "scratch must fit; the 16 MB default is the v5e/v6e "
+            "per-core VMEM.  <=0 disables the check")
 # continuous-perf observatory (framework/runlog.py + tools/perf_report.py):
 define_flag("runlog_dir", "",
             "directory of the persistent run ledger "

@@ -55,6 +55,16 @@ def _key(sq, sk, d, dtype, causal, biased, direction="fwd") -> str:
     return base if direction == "fwd" else base + ":" + direction
 
 
+def _entry_blocks(hit):
+    """Entry value → (bq, bk).  Entries are either the legacy bare
+    ``[bq, bk]`` list or the stamped ``{"blocks": [...], "verified":
+    true}`` dict written when the differential oracle validated the
+    candidate before it was timed."""
+    if isinstance(hit, dict):
+        hit = hit.get("blocks")
+    return tuple(hit) if hit else None
+
+
 def lookup(sq, sk, d, dtype, causal, biased, direction="fwd"):
     forced = _FORCE.get(direction, _FORCE.get("both"))
     if forced is not None:
@@ -64,13 +74,15 @@ def lookup(sq, sk, d, dtype, causal, biased, direction="fwd"):
     if hit is None and direction != "fwd":
         # fall back to the direction-less (fwd) measurement
         hit = c.get(_key(sq, sk, d, str(dtype), causal, biased))
-    return tuple(hit) if hit else None
+    return _entry_blocks(hit)
 
 
 def record(sq, sk, d, dtype, causal, biased, blocks, persist=True,
-           direction="fwd"):
+           direction="fwd", verified=False):
     c = _load()
-    c[_key(sq, sk, d, str(dtype), causal, biased, direction)] = list(blocks)
+    entry = {"blocks": list(blocks), "verified": True} if verified \
+        else list(blocks)
+    c[_key(sq, sk, d, str(dtype), causal, biased, direction)] = entry
     if persist:
         try:
             with _lock, open(_PATH, "w") as f:
@@ -122,15 +134,31 @@ def _bench_inputs(sq, sk, d, dtype, biased, batch, heads):
     return q, k, v, bias
 
 
-def _sweep(sq, sk, make_fn, args, iters, direction="both", verbose=False):
+def _sweep(sq, sk, make_fn, args, iters, direction="both", verbose=False,
+           oracle=None, rejected=None):
     """Time make_fn() per viable (bq, bk) candidate with that candidate
-    forced for ``direction``; returns {(bq, bk): seconds}."""
+    forced for ``direction``; returns {(bq, bk): seconds}.
+
+    ``oracle(bq, bk) -> list-of-failures`` (the armed differential
+    oracle, ops/pallas/verify.py) runs BEFORE a candidate is timed: a
+    failing candidate is never measured — a fast wrong kernel must not
+    win — and its failures land in the caller's ``rejected`` dict.
+    """
     import time
 
     results = {}
     for bq, bk in CANDIDATES:
         if bq > sq or bk > sk or sq % bq or sk % bk:
             continue
+        if oracle is not None:
+            bad = oracle(bq, bk)
+            if bad:
+                if rejected is not None:
+                    rejected[(bq, bk)] = bad
+                if verbose:
+                    print(f"  {direction} ({bq},{bk}): REJECTED by "
+                          f"oracle — {bad[0]}")
+                continue
         try:
             with force_blocks(bq, bk, direction=direction):
                 f = make_fn()
@@ -150,6 +178,21 @@ def _sweep(sq, sk, make_fn, args, iters, direction="both", verbose=False):
     return results
 
 
+def _candidate_oracle(d, dtype, causal, biased):
+    """The armed differential oracle as a per-candidate gate, or None
+    when FLAGS_pallas_verify is off (zero overhead: the sweep never
+    calls into verify)."""
+    from paddle_tpu.ops.pallas import verify
+    if not verify.armed():
+        return None
+
+    def check(bq, bk):
+        return verify.check_flash_candidate(
+            bq, bk, d=d, dtype=str(dtype), causal=causal, biased=biased)
+
+    return check
+
+
 def _loss_fn(causal, bias):
     import jax.numpy as jnp
 
@@ -163,25 +206,33 @@ def _loss_fn(causal, bias):
 
 
 def measure(sq, sk, d, dtype="bfloat16", causal=False, biased=False,
-            batch=1, heads=8, iters=3, persist=True, verbose=False):
-    """Time fwd+bwd per candidate on the current device; record winner."""
+            batch=1, heads=8, iters=3, persist=True, verbose=False,
+            rejected=None):
+    """Time fwd+bwd per candidate on the current device; record winner.
+    With FLAGS_pallas_verify armed, candidates failing the differential
+    oracle are rejected (collected in ``rejected``) instead of timed,
+    and the recorded winner is stamped ``verified: true``."""
     import jax
 
     q, k, v, bias = _bench_inputs(sq, sk, d, dtype, biased, batch, heads)
     loss = _loss_fn(causal, bias)
+    oracle = _candidate_oracle(d, dtype, causal, biased)
     results = _sweep(sq, sk,
                      lambda: jax.jit(jax.value_and_grad(
                          loss, argnums=(0, 1, 2))),
-                     (q, k, v), iters, verbose=verbose)
+                     (q, k, v), iters, verbose=verbose, oracle=oracle,
+                     rejected=rejected)
     if not results:
         return None
     best = min(results, key=results.get)
-    record(sq, sk, d, dtype, causal, biased, best, persist=persist)
+    record(sq, sk, d, dtype, causal, biased, best, persist=persist,
+           verified=oracle is not None)
     return best, results
 
 
 def measure_split(sq, sk, d, dtype="bfloat16", causal=False, biased=False,
-                  batch=1, heads=8, iters=3, persist=True, verbose=False):
+                  batch=1, heads=8, iters=3, persist=True, verbose=False,
+                  rejected=None):
     """Tune fwd and bwd block sizes independently.
 
     Pass 1 times the forward alone per candidate and records the "fwd"
@@ -194,24 +245,27 @@ def measure_split(sq, sk, d, dtype="bfloat16", causal=False, biased=False,
 
     q, k, v, bias = _bench_inputs(sq, sk, d, dtype, biased, batch, heads)
     loss = _loss_fn(causal, bias)
+    oracle = _candidate_oracle(d, dtype, causal, biased)
 
     fwd_res = _sweep(sq, sk, lambda: jax.jit(loss), (q, k, v), iters,
-                     direction="fwd", verbose=verbose)
+                     direction="fwd", verbose=verbose, oracle=oracle,
+                     rejected=rejected)
     if not fwd_res:
         return None
     fwd_best = min(fwd_res, key=fwd_res.get)
     record(sq, sk, d, dtype, causal, biased, fwd_best, persist=persist,
-           direction="fwd")
+           direction="fwd", verified=oracle is not None)
 
     with force_blocks(*fwd_best, direction="fwd"):
         bwd_res = _sweep(sq, sk,
                          lambda: jax.jit(jax.value_and_grad(
                              loss, argnums=(0, 1, 2))),
                          (q, k, v), iters, direction="bwd",
-                         verbose=verbose)
+                         verbose=verbose, oracle=oracle,
+                         rejected=rejected)
     if not bwd_res:
         return (fwd_best, fwd_res), None
     bwd_best = min(bwd_res, key=bwd_res.get)
     record(sq, sk, d, dtype, causal, biased, bwd_best, persist=persist,
-           direction="bwd")
+           direction="bwd", verified=oracle is not None)
     return (fwd_best, fwd_res), (bwd_best, bwd_res)

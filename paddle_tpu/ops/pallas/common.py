@@ -5,6 +5,20 @@ import jax
 import jax.numpy as jnp
 
 
+def no_x64():
+    """Context manager forcing 32-bit trace semantics for a kernel call.
+
+    The package enables jax_enable_x64 globally (paddle parity), but
+    Pallas TPU kernels are written for 32-bit refs; ``jax.enable_x64``
+    was removed upstream, so route through the experimental manager.
+    """
+    try:
+        from jax.experimental import disable_x64
+        return disable_x64()
+    except ImportError:
+        return jax.enable_x64(False)
+
+
 def dot_nt(a, b):
     """a (m, d) · b (n, d) → (m, n): contraction over the trailing dim with
     f32 accumulation — keeps bf16 inputs on the MXU's fast path instead of

@@ -65,7 +65,7 @@ _INTERPRET = False
 
 _NEG_INF = float("-inf")
 
-from paddle_tpu.ops.pallas.common import dot_nt as _dot_nt  # noqa: E402
+from paddle_tpu.ops.pallas.common import dot_nt as _dot_nt, no_x64  # noqa: E402
 
 
 def _backend_is_tpu() -> bool:
@@ -123,14 +123,19 @@ def supported(q_shape, k_shape, no_mask: bool = True, causal: bool = False,
     if bias_shape is not None and \
             _canon_bias_shape(bias_shape, b, h, sq, sk) is None:
         return False
-    # the grid floors seq/block: a remainder would leave trailing queries
-    # unwritten and trailing keys ignored, so block divisibility is required
-    block_q = _pick_block(BLOCK_Q, sq)
-    block_k = _pick_block(BLOCK_K, sk)
-    if sq % block_q or sk % block_k:
-        return False
-    return sq % _MIN_BLOCK == 0 and sk % _MIN_BLOCK == 0 and sq >= _MIN_BLOCK \
-        and sk >= _MIN_BLOCK
+    if bias_shape is not None or segments:
+        # the bias/segment tile specs are not tail-masked, so the mask
+        # path keeps the block-divisibility requirement
+        block_q = _pick_block(BLOCK_Q, sq)
+        block_k = _pick_block(BLOCK_K, sk)
+        if sq % block_q or sk % block_k:
+            return False
+        return sq % _MIN_BLOCK == 0 and sk % _MIN_BLOCK == 0 \
+            and sq >= _MIN_BLOCK and sk >= _MIN_BLOCK
+    # no mask: non-divisible sequences ride cdiv grids with tail-masked
+    # blocks (out-of-range keys scored -inf, tail q/do rows zeroed in the
+    # backward contractions); sub-block sequences still fall back to XLA
+    return sq >= _MIN_BLOCK and sk >= _MIN_BLOCK
 
 
 
@@ -183,7 +188,7 @@ def _mask_tile(s, bias_ref, qs_ref, ks_ref):
 
 
 def _fwd_kernel(*args, scale, causal, block_k, block_q, n_kb, off,
-                has_bias, has_segs):
+                has_bias, has_segs, sk, tail_k):
     from jax.experimental import pallas as pl
 
     n_in = 3 + (1 if has_bias else 0) + (2 if has_segs else 0)
@@ -225,11 +230,20 @@ def _fwd_kernel(*args, scale, causal, block_k, block_q, n_kb, off,
         # to the f32 product
         s = _dot_nt(q, k) * scale                      # (bq, bk) f32
         s = _mask_tile(s, bias_ref, qs_ref, ks_ref)
+        if causal or tail_k:
+            k_idx = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+        if tail_k:
+            # the last kv block overruns sk: out-of-range key columns
+            # score -inf (exp to 0) and their value rows are zeroed so
+            # padding garbage never reaches the p·v accumulate
+            s = jnp.where(k_idx < sk, s, -jnp.inf)
+            v_row = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, v.shape, 0)
+            v = jnp.where(v_row < sk, v, 0)
         if causal:
             q_idx = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
-            k_idx = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
             s = jnp.where(q_idx + off >= k_idx, s, -jnp.inf)
         m_prev = m_scr[...]                            # (bq, 1)
         l_prev = l_scr[...]
@@ -326,7 +340,8 @@ def _flash_fwd_folded(qt, kt, vt, bias, qseg, kseg, scale, causal, h):
     has_segs = qseg is not None
     block_q, block_k = _blocks_for(sq, sk, d, qt.dtype, causal,
                                    has_bias or has_segs)
-    n_kb = sk // block_k
+    n_qb = -(-sq // block_q)
+    n_kb = -(-sk // block_k)
     if has_bias:
         bb, hb, sqb, _ = bias.shape
         g_map = _bias_g_map(bb, hb, h)
@@ -336,13 +351,14 @@ def _flash_fwd_folded(qt, kt, vt, bias, qseg, kseg, scale, causal, h):
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, block_q=block_q, n_kb=n_kb,
                                off=sk - sq, has_bias=has_bias,
-                               has_segs=has_segs)
+                               has_segs=has_segs, sk=sk,
+                               tail_k=bool(sk % block_k))
     # Mosaic rejects 64-bit types; the framework enables x64 globally, so
     # pin 32-bit mode for the kernel trace (index maps would emit i64)
-    with jax.enable_x64(False):
+    with no_x64():
         out, lse = pl.pallas_call(
             kernel,
-            grid=(bh, sq // block_q, n_kb),
+            grid=(bh, n_qb, n_kb),
             in_specs=[
                 pl.BlockSpec((1, block_q, d),
                              lambda bh, qi, kb: (bh, qi, 0)),
@@ -378,7 +394,8 @@ def _flash_fwd_folded(qt, kt, vt, bias, qseg, kseg, scale, causal, h):
 
 
 def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off,
-               bias_ref=None, qs_ref=None, ks_ref=None):
+               bias_ref=None, qs_ref=None, ks_ref=None, sk=0,
+               tail_k=False):
     """Recompute the (bq, bk) probability tile from saved lse.  q/k stay in
     input dtype (bf16 on chip); the product accumulates f32.
 
@@ -393,9 +410,14 @@ def _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k, off,
     trace here, inspect the inputs/bias, not this kernel."""
     s = _dot_nt(q, k) * scale
     s = _mask_tile(s, bias_ref, qs_ref, ks_ref)
+    if causal or tail_k:
+        k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if tail_k:
+        # out-of-range key columns of the tail kv block (callers zero the
+        # matching k/v rows, so these columns are 0·q dots, not garbage)
+        s = jnp.where(k_idx < sk, s, -jnp.inf)
     if causal:
         q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(q_idx + off >= k_idx, s, -jnp.inf)
     p = jnp.exp(s - lse)
     # masked entries (s=-inf, lse finite) already exp to 0; the only nan
@@ -421,8 +443,14 @@ def _split_bwd_args(args, has_bias, has_segs, n_out):
             bias_ref, qs_ref, ks_ref, outs, scratch)
 
 
+def _tail_zero(x, origin, limit):
+    """Zero rows of a (rows, d) tile whose global index >= limit."""
+    row = origin + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(row < limit, x, 0)
+
+
 def _bwd_dq_kernel(*args, scale, causal, block_q, block_k, n_kb, off,
-                   has_bias, has_segs):
+                   has_bias, has_segs, sk, tail_k):
     from jax.experimental import pallas as pl
 
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, qs_ref,
@@ -449,8 +477,14 @@ def _bwd_dq_kernel(*args, scale, causal, block_q, block_k, n_kb, off,
         do = do_ref[0]
         lse = lse_ref[0]                               # (bq, 1)
         delta = delta_ref[0]
+        if tail_k:
+            # zero the overrun k/v rows: ds's zero tail columns must
+            # contract against zeros, not padding garbage (0·garbage is
+            # NaN-poisoned in interpret mode)
+            k = _tail_zero(k, kb * block_k, sk)
+            v = _tail_zero(v, kb * block_k, sk)
         p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
-                       off, bias_ref, qs_ref, ks_ref)
+                       off, bias_ref, qs_ref, ks_ref, sk=sk, tail_k=tail_k)
         dp = _dot_nt(do, v)                            # (bq, bk) f32
         ds = p * (dp - delta)
         acc_scr[...] += jnp.dot(ds.astype(k.dtype), k,
@@ -462,7 +496,7 @@ def _bwd_dq_kernel(*args, scale, causal, block_q, block_k, n_kb, off,
 
 
 def _bwd_dkv_kernel(*args, scale, causal, block_q, block_k, n_qb, off,
-                    has_bias, has_segs):
+                    has_bias, has_segs, sq, tail_q, sk, tail_k):
     from jax.experimental import pallas as pl
 
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref, qs_ref,
@@ -490,14 +524,29 @@ def _bwd_dkv_kernel(*args, scale, causal, block_q, block_k, n_qb, off,
         do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
+        if tail_q:
+            # the tail q block's overrun rows carry garbage q/do/lse/
+            # delta; they are contracted INTO every dk/dv entry here, so
+            # both operands of each contraction must be zeroed rows
+            q = _tail_zero(q, qi * block_q, sq)
+            do = _tail_zero(do, qi * block_q, sq)
+        if tail_k:
+            k = _tail_zero(k, kb * block_k, sk)
+            v = _tail_zero(v, kb * block_k, sk)
         p = _rebuild_p(q, k, lse, scale, causal, qi, kb, block_q, block_k,
-                       off, bias_ref, qs_ref, ks_ref)
+                       off, bias_ref, qs_ref, ks_ref, sk=sk, tail_k=tail_k)
+        if tail_q:
+            # p rows from garbage lse are NaN — zero them explicitly
+            p = _tail_zero(p, qi * block_q, sq)
         # contract the query axis: pT@do and dsT@q with bf16 operands
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = _dot_nt(do, v)
         ds = p * (dp - delta)
+        if tail_q:
+            # garbage delta rows poison ds even where p is 0 (0·NaN)
+            ds = _tail_zero(ds, qi * block_q, sq)
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -572,8 +621,10 @@ def _flash_bwd_folded(qt, kt, vt, bias, qseg, kseg, ot, lse, do, scale,
     has_segs = qseg is not None
     block_q, block_k = _blocks_for(sq, sk, d, qt.dtype, causal,
                                    has_bias or has_segs, direction="bwd")
-    n_qb = sq // block_q
-    n_kb = sk // block_k
+    n_qb = -(-sq // block_q)
+    n_kb = -(-sk // block_k)
+    tail_q = bool(sq % block_q)
+    tail_k = bool(sk % block_k)
     off = sk - sq
 
     if has_bias:
@@ -598,11 +649,12 @@ def _flash_bwd_folded(qt, kt, vt, bias, qseg, kseg, ot, lse, do, scale,
 
     mask_ins = _mask_inputs(bias, qseg, kseg)
 
-    with jax.enable_x64(False):
+    with no_x64():
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k, n_kb=n_kb,
-                              off=off, has_bias=has_bias, has_segs=has_segs),
+                              off=off, has_bias=has_bias, has_segs=has_segs,
+                              sk=sk, tail_k=tail_k),
             grid=(bh, n_qb, n_kb),
             in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
             + _mask_specs(pl, b, h, sqb, g_map, block_q, block_k,
@@ -616,7 +668,8 @@ def _flash_bwd_folded(qt, kt, vt, bias, qseg, kseg, ot, lse, do, scale,
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k, n_qb=n_qb,
-                              off=off, has_bias=has_bias, has_segs=has_segs),
+                              off=off, has_bias=has_bias, has_segs=has_segs,
+                              sq=sq, tail_q=tail_q, sk=sk, tail_k=tail_k),
             grid=(bh, n_kb, n_qb),
             in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
                       row_spec_t]

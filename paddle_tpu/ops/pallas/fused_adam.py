@@ -27,6 +27,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.ops.pallas.common import no_x64
+
 BLOCK_ROWS = 1024
 _LANES = 128
 
@@ -96,7 +98,7 @@ def fused_adam_update(p, g, m, v, *, lr_t, beta1, beta2, eps, wd_lr=0.0):
     ]).reshape(8, 1)
 
     row_spec = pl.BlockSpec((block, _LANES), lambda i: (i, 0))
-    with jax.enable_x64(False):
+    with no_x64():
         po, mo, vo = pl.pallas_call(
             _adam_kernel,
             grid=(rows_p // block,),

@@ -50,10 +50,15 @@ def _backend_is_tpu() -> bool:
 
 
 def supported(n: int, h: int) -> bool:
-    """Can the fused kernel serve this (N tokens, H hidden) head?"""
+    """Can the fused kernel serve this (N tokens, H hidden) head?
+
+    Any token count works: like the vocab axis, a non-divisible N rides
+    zero-padded rows (padded loss/grad rows are exactly zero and sliced
+    off), and N=0 short-circuits before the kernels.
+    """
     if not (_backend_is_tpu() or _INTERPRET):
         return False
-    return n % _MIN_BLOCK == 0 and n >= _MIN_BLOCK and h % 128 == 0
+    return n >= 0 and h % 128 == 0
 
 
 def _pick(pref: int, size: int) -> int:
@@ -63,7 +68,7 @@ def _pick(pref: int, size: int) -> int:
     return max(b, _MIN_BLOCK)
 
 
-from paddle_tpu.ops.pallas.common import dot_nt as _dot_nt  # noqa: E402
+from paddle_tpu.ops.pallas.common import dot_nt as _dot_nt, no_x64  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -108,7 +113,7 @@ def _ce_logz(h, w_pad, v):
     n_vb = v_pad // block_v
 
     kernel = functools.partial(_fwd_kernel, block_v=block_v, n_vb=n_vb, v=v)
-    with jax.enable_x64(False):
+    with no_x64():
         logz = pl.pallas_call(
             kernel,
             grid=(n // block_n, n_vb),
@@ -188,7 +193,7 @@ def _ce_bwd_kernels(h, w_pad, logz, g, v):
 
     block_n = _pick(BLOCK_N_BWD, n)
     block_v = _pick(BLOCK_V, v_pad)
-    with jax.enable_x64(False):
+    with no_x64():
         dh = pl.pallas_call(
             functools.partial(_dh_kernel, block_v=block_v,
                               n_vb=v_pad // block_v, v=v),
@@ -238,6 +243,19 @@ def _pad_w(w):
     return w
 
 
+def _pad_n(x):
+    """Zero-pad the token axis to a _MIN_BLOCK multiple — the grids
+    floor n/block, so a remainder would silently drop trailing tokens
+    (the PTA601 finding).  Zero rows are exact: the fwd's padded logz
+    rows are sliced off, and the bwd pads g with zeros so every padded
+    p·g tile is exactly 0 (no dw perturbation)."""
+    n = x.shape[0]
+    n_pad = max(_MIN_BLOCK, -(-n // _MIN_BLOCK) * _MIN_BLOCK)
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n),) + ((0, 0),) * (x.ndim - 1))
+    return x
+
+
 @jax.custom_vjp
 def _fused_ce(h, w, labels_f):
     loss, _ = _fused_ce_fwd(h, w, labels_f)
@@ -246,9 +264,13 @@ def _fused_ce(h, w, labels_f):
 
 def _fused_ce_fwd(h, w, labels_f):
     v = w.shape[0]
+    n = h.shape[0]
     lab = labels_f.astype(jnp.int32)
+    if n == 0:
+        logz = jnp.zeros((0,), jnp.float32)
+        return logz, (h, w, lab, logz)
     w_pad = _pad_w(w)
-    logz = _ce_logz(h, w_pad, v)[:, 0]                  # (n,)
+    logz = _ce_logz(_pad_n(h), w_pad, v)[:n, 0]         # (n,)
     gold_w = jnp.take(w, jnp.clip(lab, 0, v - 1), axis=0)
     gold = jnp.sum(h.astype(jnp.float32) * gold_w.astype(jnp.float32),
                    axis=-1)
@@ -260,8 +282,16 @@ def _fused_ce_bwd(res, g):
     h, w, lab, logz = res
     v, hd = w.shape
     n = h.shape[0]
+    if n == 0:
+        return (jnp.zeros_like(h), jnp.zeros_like(w),
+                jnp.zeros_like(res[2], dtype=jnp.float32))
     w_pad = _pad_w(w)
-    dh, dw_pad = _ce_bwd_kernels(h, w_pad, logz.reshape(n, 1), g, v)
+    # padded rows carry g=0, so their p·g tiles are exactly 0 in both
+    # kernels; logz pads with zeros (any finite value works under g=0)
+    dh, dw_pad = _ce_bwd_kernels(
+        _pad_n(h), w_pad, _pad_n(logz.reshape(n, 1)),
+        _pad_n(g.reshape(n, 1)), v)
+    dh = dh[:n]
     dw = dw_pad[:v]
     # one-hot (gold) terms, O(N·H) XLA gather/scatter
     gf = g.reshape(n, 1).astype(jnp.float32)

@@ -1,0 +1,151 @@
+"""Seeded Pallas tiling-bug fixture — the kernel analysis plane's
+acceptance artifact.
+
+A deliberately broken ``pallas_call``: a (300, 128) doubling kernel
+tiled with 128-row blocks on a FLOORED grid (``300 // 128 = 2`` — the
+44-row tail is never visited) whose output index_map also IGNORES one
+varying grid axis (two grid points write block 0).  The SAME committed
+file must be caught by BOTH halves of the plane, naming the SAME
+operand:
+
+* **statically** — ``python tools/prog_lint.py --pallas
+  tests/fixtures/pallas_oob.py`` imports the ``pallas_report()`` hook,
+  flags PTA601 (grid covers only 256 of 300 rows of ``fixture.out``)
+  and PTA603 (the output index_map ignores a varying grid axis) at the
+  ``pallas_call`` site, and exits nonzero;
+* **dynamically** — ``FLAGS_pallas_verify=1 python
+  tests/fixtures/pallas_oob.py`` runs the differential oracle
+  (interpret leg vs the pure-jnp reference): the unvisited tail rows
+  surface as NaNs in the interpreter, the oracle records a
+  ``pallas.divergence`` flight event, and the run completes normally
+  (exit 0, ``PALLAS_DIVERGENCE fixture.out`` on stdout).
+
+``--chaos`` runs the chaos leg instead: the same armed check with a
+``pallas.verify`` error injected must swallow-and-count
+(``pallas_verify_errors_total``) while the kernel's own output is
+untouched (``CHAOS_PALLAS_SWALLOWED`` on stdout, exit 0).
+
+The CI pallas lane runs all three and asserts they agree.  Deliberately
+a finding: do NOT "fix" the grid or the index_map and do NOT pragma
+them.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+
+ROWS, COLS, BLOCK = 300, 128, 128
+
+# flipped to True by ops.pallas.verify.interpreted() for the oracle's
+# interpreter leg (the same toggle the real kernel modules carry)
+_INTERPRET = False
+
+
+def _double_kernel(x_ref, out_ref):
+    out_ref[...] = x_ref[...] * 2.0
+
+
+def run_kernel(x):
+    """The broken tiling: floored grid (tail rows never written) and an
+    output index_map that ignores grid axis 0 while axis 1 varies."""
+    return pl.pallas_call(
+        _double_kernel,
+        grid=(2, ROWS // BLOCK),             # BUG: floor drops the tail
+        in_specs=[pl.BlockSpec((BLOCK, COLS), lambda r, i: (i, 0))],
+        out_specs=pl.BlockSpec((BLOCK, COLS),
+                               lambda r, i: (i, 0)),  # BUG: ignores r
+        out_shape=jax.ShapeDtypeStruct((ROWS, COLS), jnp.float32),
+        interpret=_INTERPRET,
+    )(x)
+
+
+def run_reference(x):
+    return x * 2.0
+
+
+def _input():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.standard_normal((ROWS, COLS)), jnp.float32)
+
+
+def pallas_report():
+    """The static half: trace the broken pallas_call and run the PTA6xx
+    passes (prog_lint --pallas imports this hook)."""
+    from paddle_tpu.framework.analysis import analyze_kernels
+    return analyze_kernels(run_kernel, _input(), name="fixture")
+
+
+def run(chaos_verify_error: bool = False):
+    """Execute the armed differential oracle on the broken kernel
+    (interpret vs reference — the CPU legs).  Returns the
+    VerifyResult, or None when the oracle was swallowed/disarmed."""
+    from paddle_tpu.framework import chaos
+    from paddle_tpu.ops.pallas import verify
+
+    # verify.interpreted() flips module attributes; proxy this module's
+    # globals so the toggle works however the file was imported (path
+    # import via importlib leaves __name__ out of sys.modules)
+    class _Self:
+        def __init__(self):
+            self.__dict__ = globals()
+
+    mod = _Self()
+    ctx = chaos.inject("pallas.verify", mode="error", every=1) \
+        if chaos_verify_error else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        return verify.verify_call(
+            "fixture", run_kernel, run_reference, (_input(),),
+            interpret_modules=(mod,), out_labels=["fixture.out"],
+            skip_compiled=True)
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    from paddle_tpu.framework.observability import flight
+    if "--chaos" in argv:
+        # the chaos leg arms the oracle itself (the injected fault must
+        # have a live check to swallow)
+        set_flags({"pallas_verify": True})
+        before = monitor.get_stat("pallas_verify_errors_total")
+        res = run(chaos_verify_error=True)
+        after = monitor.get_stat("pallas_verify_errors_total")
+        if res is not None or after != before + 1:
+            print("CHAOS_PALLAS_NOT_SWALLOWED", file=sys.stderr)
+            return 1
+        # the watched kernel itself still runs, untouched by the fault
+        out = np.asarray(run_reference(_input()))
+        if not np.isfinite(out).all():
+            print("CHAOS_PALLAS_PERTURBED_WATCHED", file=sys.stderr)
+            return 1
+        print("CHAOS_PALLAS_SWALLOWED")
+        return 0
+    if not get_flags("pallas_verify")["pallas_verify"]:
+        print("pallas verify disarmed (set FLAGS_pallas_verify=1)",
+              file=sys.stderr)
+        return 2
+    res = run()
+    events = flight.recent(8, kind="pallas.divergence")
+    if res is None or not res.divergent or not events:
+        print("NO_DIVERGENCE_DETECTED", file=sys.stderr)
+        return 1
+    print("PALLAS_DIVERGENCE", res.operand)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,3 +59,57 @@ def test_distinct_mask_class_keys():
     autotune.record(2048, 2048, 64, "bfloat16", False, True, (256, 512))
     assert autotune.lookup(2048, 2048, 64, "bfloat16", False,
                            False) is None
+
+
+def test_verified_record_is_stamped_dict():
+    autotune.record(512, 512, 64, "bfloat16", True, False, (256, 256),
+                    verified=True)
+    with open(autotune._PATH) as f:
+        data = json.load(f)
+    assert data["512x512:d64:bfloat16:causal:nobias"] == \
+        {"blocks": [256, 256], "verified": True}
+    # lookup unwraps the stamped form, also across a disk reload
+    assert autotune.lookup(512, 512, 64, "bfloat16", True, False) == \
+        (256, 256)
+    autotune._cache = None
+    assert autotune.lookup(512, 512, 64, "bfloat16", True, False) == \
+        (256, 256)
+
+
+def test_sweep_rejects_oracle_failures(monkeypatch):
+    """A candidate failing the differential oracle is never timed and
+    lands in the caller's rejected dict; passing candidates still run."""
+    monkeypatch.setattr(autotune, "CANDIDATES", [(256, 256), (256, 512)])
+    timed = []
+
+    def make_fn():
+        def f():
+            timed.append(autotune._FORCE.get("both"))
+            return 0.0
+        return f
+
+    def oracle(bq, bk):
+        if (bq, bk) == (256, 256):
+            return [{"sq": 384, "sk": 384, "dtype": "bfloat16",
+                     "operand": "flash[256x256].dq"}]
+        return []
+
+    rejected = {}
+    results = autotune._sweep(512, 512, make_fn, (), iters=1,
+                              oracle=oracle, rejected=rejected)
+    assert (256, 256) not in results and (256, 512) in results
+    assert list(rejected) == [(256, 256)]
+    assert rejected[(256, 256)][0]["operand"] == "flash[256x256].dq"
+    assert all(t == (256, 512) for t in timed)
+
+
+def test_candidate_oracle_disarmed_is_none():
+    from paddle_tpu.framework.flags import flag, set_flags
+    assert not flag("pallas_verify")
+    assert autotune._candidate_oracle(64, "bfloat16", True, False) is None
+    set_flags({"pallas_verify": True})
+    try:
+        assert autotune._candidate_oracle(
+            64, "bfloat16", True, False) is not None
+    finally:
+        set_flags({"pallas_verify": False})

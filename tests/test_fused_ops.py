@@ -148,3 +148,42 @@ def test_optimizer_use_fused_converges_like_unfused(cls):
     l_plain = train(False)
     assert l_fused < 0.05
     assert abs(l_fused - l_plain) < 1e-3, (l_fused, l_plain)
+
+
+# -- non-divisible / zero-length token axis ---------------------------------
+
+@pytest.mark.parametrize("n", [300, 257, 1])
+def test_ce_non_divisible_tokens_match_xla(n):
+    """N that doesn't divide the block rides zero-padded rows (the
+    PTA601 fix) — loss and both grads pinned against the reference."""
+    H, V = 128, 1000
+    h = jnp.asarray(rng.standard_normal((n, H)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((V, H)).astype(np.float32))
+    lab = jnp.asarray(rng.integers(0, V, size=(n,)), dtype=jnp.int32)
+    assert fused_ce.supported(n, H)
+    out = fused_ce.fused_linear_cross_entropy(h, w, lab)
+    ref = fused_ce.xla_reference(h, w, lab)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    gf = jax.grad(lambda h, w: fused_ce.fused_linear_cross_entropy(
+        h, w, lab).mean(), argnums=(0, 1))(h, w)
+    gr = jax.grad(lambda h, w: fused_ce.xla_reference(
+        h, w, lab).mean(), argnums=(0, 1))(h, w)
+    for a, b, name in zip(gf, gr, ["dh", "dw"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4, err_msg=name)
+
+
+def test_ce_zero_length_rows():
+    """N=0 short-circuits before the kernels: empty loss, zero grads."""
+    H, V = 128, 260
+    h = jnp.zeros((0, H), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((V, H)).astype(np.float32))
+    lab = jnp.zeros((0,), jnp.int32)
+    assert fused_ce.supported(0, H)
+    out = fused_ce.fused_linear_cross_entropy(h, w, lab)
+    assert out.shape == (0,)
+    gf = jax.grad(lambda h, w: fused_ce.fused_linear_cross_entropy(
+        h, w, lab).sum(), argnums=(0, 1))(h, w)
+    assert gf[0].shape == (0, H)
+    np.testing.assert_array_equal(np.asarray(gf[1]), 0.0)

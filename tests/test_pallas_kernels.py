@@ -301,3 +301,68 @@ class TestMaskedInterpret:
                                 q_seg=segs._data, kv_seg=segs._data)
         np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestNonDivisibleTails:
+    """Non-divisible sequence lengths ride cdiv grids with tail-masked
+    blocks (the PTA601/PTA604 invariants) — pinned against the XLA
+    reference so a regressed mask shows up as a numeric diff, exactly
+    what the ops/pallas/verify.py oracle checks at runtime."""
+
+    def setup_method(self):
+        fa._INTERPRET = True
+        self._saved = (fa.BLOCK_Q, fa.BLOCK_K, fa._MIN_BLOCK)
+        # small blocks so the tail blocks are multi-block at test sizes
+        fa.BLOCK_Q = fa.BLOCK_K = 128
+        fa._MIN_BLOCK = 32
+
+    def teardown_method(self):
+        fa._INTERPRET = False
+        fa.BLOCK_Q, fa.BLOCK_K, fa._MIN_BLOCK = self._saved
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("sq,sk", [(80, 80), (80, 112), (200, 200),
+                                       (130, 260)])
+    def test_forward_tail_matches_xla(self, causal, sq, sk):
+        rng = np.random.default_rng(3)
+        B, H, D = 1, 2, 64
+        q = jnp.asarray(rng.standard_normal((B, sq, H, D)).astype(np.float32))
+        k = jnp.asarray(rng.standard_normal((B, sk, H, D)).astype(np.float32))
+        v = jnp.asarray(rng.standard_normal((B, sk, H, D)).astype(np.float32))
+        scale = 1.0 / np.sqrt(D)
+        assert fa.supported(q.shape, k.shape, True, causal=causal)
+        out, _ = fa._flash_fwd(q, k, v, None, None, None, scale, causal)
+        ref = fa._xla_reference(q, k, v, scale, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("sq,sk", [(80, 112), (200, 200)])
+    def test_backward_tail_matches_xla(self, causal, sq, sk):
+        rng = np.random.default_rng(4)
+        B, H, D = 1, 2, 64
+        q = jnp.asarray(rng.standard_normal((B, sq, H, D)).astype(np.float32))
+        k = jnp.asarray(rng.standard_normal((B, sk, H, D)).astype(np.float32))
+        v = jnp.asarray(rng.standard_normal((B, sk, H, D)).astype(np.float32))
+        scale = 1.0 / np.sqrt(D)
+
+        def loss_flash(q, k, v):
+            return (fa.flash_attention(q, k, v, causal, scale) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (fa._xla_reference(q, k, v, scale, causal) ** 2).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-4,
+                                       err_msg=f"d{name}")
+
+    def test_masked_paths_keep_divisibility_gate(self):
+        # bias/segment tiles are not tail-masked: non-divisible shapes
+        # with a mask must keep falling back to XLA
+        assert not fa.supported((1, 200, 2, 64), (1, 200, 2, 64), True,
+                                bias_shape=(1, 1, 200, 200))
+        assert not fa.supported((1, 200, 2, 64), (1, 200, 2, 64), True,
+                                segments=True)

@@ -231,6 +231,47 @@ fi
 rm -f /tmp/pt_collectives_fixture.json /tmp/pt_parity.txt \
     /tmp/pt_parity_chaos.txt
 
+echo "== pallas kernel lane (PTA6xx static; interpret-mode differential oracle) =="
+# static half: every in-tree pallas_call (package sources + the kernel
+# zoo traced at tail-bearing shapes) must be PTA6xx-clean at --strict —
+# zero errors AND zero warnings; the committed floored-grid fixture
+# MUST be flagged PTA601 + PTA603 naming fixture.out (a pass suite
+# that can't see the seeded tiling bug gates nothing)
+JAX_PLATFORMS=cpu python tools/prog_lint.py --pallas \
+    paddle_tpu/ops/pallas paddle_tpu/parallel/ring_attention.py \
+    --zoo all --strict
+rc=0
+JAX_PLATFORMS=cpu python tools/prog_lint.py --pallas \
+    tests/fixtures/pallas_oob.py --format=json \
+    > /tmp/pt_pallas_fixture.json || rc=$?
+if [ "$rc" != 1 ] || ! grep -q '"PTA601"' /tmp/pt_pallas_fixture.json \
+    || ! grep -q '"PTA603"' /tmp/pt_pallas_fixture.json \
+    || ! grep -q 'fixture.out' /tmp/pt_pallas_fixture.json; then
+  echo "pallas lane FAILED: tiling fixture not flagged (rc=$rc)" >&2
+  exit 1
+fi
+# dynamic half: the SAME fixture under FLAGS_pallas_verify must make
+# the differential oracle (interpret leg vs pure-jnp reference — the
+# CPU legs) name the IDENTICAL operand in a pallas.divergence flight
+# event while the run completes normally (exit 0) — the static model
+# validated by runtime
+JAX_PLATFORMS=cpu FLAGS_pallas_verify=1 \
+    python tests/fixtures/pallas_oob.py | tee /tmp/pt_pallas.txt
+if ! grep -q "PALLAS_DIVERGENCE fixture.out" /tmp/pt_pallas.txt; then
+  echo "pallas lane FAILED: oracle did not name fixture.out" >&2
+  exit 1
+fi
+# chaos leg: an injected pallas.verify error is swallowed+counted
+# (pallas_verify_errors_total) and the watched computation is untouched
+JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
+    python tests/fixtures/pallas_oob.py --chaos \
+    | tee /tmp/pt_pallas_chaos.txt
+if ! grep -q "CHAOS_PALLAS_SWALLOWED" /tmp/pt_pallas_chaos.txt; then
+  echo "pallas lane FAILED: verify fault not swallowed+counted" >&2
+  exit 1
+fi
+rm -f /tmp/pt_pallas_fixture.json /tmp/pt_pallas.txt /tmp/pt_pallas_chaos.txt
+
 echo "== autopilot lane (telemetry -> guarded recovery actions; offline autotune) =="
 # (1) clean leg: a healthy PS mini-train under the controller must take
 # ZERO actions (--max-actions 0 trips on any taken decision) — the

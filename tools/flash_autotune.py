@@ -37,23 +37,33 @@ def main(argv=None) -> int:
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument("--split", action="store_true",
                     help="tune fwd and bwd block sizes independently")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip the differential oracle pre-timing gate "
+                         "(candidates are then recorded unstamped)")
     a = ap.parse_args(argv)
 
+    from paddle_tpu.framework.flags import set_flags
     from paddle_tpu.ops.pallas import autotune
     from paddle_tpu.ops.pallas.flash_attention import _backend_is_tpu
     if not _backend_is_tpu():
         print("no TPU attached — autotune must run on real hardware",
               file=sys.stderr)
         return 1
+    if not a.no_verify:
+        # every candidate passes the interpret-vs-compiled-vs-reference
+        # oracle before it is timed; winners are stamped verified: true
+        set_flags({"pallas_verify": True})
 
     configs = [(a.sq, a.sk, a.d, a.dtype, a.causal, a.biased)] \
         if a.sq else DEFAULT_CONFIGS
     for sq, sk, d, dt, causal, biased in configs:
         print(f"config sq={sq} sk={sk} d={d} {dt} "
               f"causal={causal} biased={biased}")
+        rejected = {}
         if a.split:
             out = autotune.measure_split(sq, sk, d, dt, causal, biased,
-                                         iters=a.iters, verbose=True)
+                                         iters=a.iters, verbose=True,
+                                         rejected=rejected)
             if out is None:
                 print("  no viable candidate")
             else:
@@ -62,12 +72,17 @@ def main(argv=None) -> int:
                       (f", bwd {bwd[0]}" if bwd else ""))
         else:
             out = autotune.measure(sq, sk, d, dt, causal, biased,
-                                   iters=a.iters, verbose=True)
+                                   iters=a.iters, verbose=True,
+                                   rejected=rejected)
             if out is None:
                 print("  no viable candidate")
             else:
                 best, _ = out
                 print(f"  -> {best}")
+        for (bq, bk), fails in sorted(rejected.items()):
+            ops = ", ".join(sorted({f["operand"] for f in fails}))
+            print(f"  rejected ({bq},{bk}): {len(fails)} corpus "
+                  f"divergence(s) [{ops}]")
     return 0
 
 

@@ -82,6 +82,8 @@ MODULES = [
     "paddle_tpu.framework.locks",
     "paddle_tpu.framework.analysis.concurrency",
     "paddle_tpu.framework.analysis.collectives",
+    "paddle_tpu.framework.analysis.pallas_kernels",
+    "paddle_tpu.ops.pallas.verify",
     "paddle_tpu.parallel.parity",
     "paddle_tpu.distributed.fleet.metrics",
     "paddle_tpu.distributed.fleet.utils.fs",

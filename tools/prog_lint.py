@@ -14,6 +14,7 @@ Usage:
     python tools/prog_lint.py --zoo all paddle_tpu.vision.models
     python tools/prog_lint.py --threads paddle_tpu     # PTA4xx passes
     python tools/prog_lint.py --collectives paddle_tpu --zoo all
+    python tools/prog_lint.py --pallas paddle_tpu/ops/pallas --zoo all
     python tools/prog_lint.py --list-rules [--format=json]
     python tools/prog_lint.py --list-rules --check-docs
 
@@ -31,6 +32,12 @@ probe sources rides along), and a FILE target exposing a
 ``collectives_report()`` hook is imported and its report used — the
 committed ``tests/fixtures/replica_divergence.py`` acceptance
 artifact.
+``--pallas`` arms the Pallas kernel family (PTA601-606): zoo names
+resolve to the PALLAS_ZOO (the hand-written kernel tier traced through
+the pallas_call intercept — abstract, no FLOPs spent), module/dir
+targets are AST-linted as usual, and a FILE target exposing a
+``pallas_report()`` hook is imported and its report used — the
+committed ``tests/fixtures/pallas_oob.py`` acceptance artifact.
 ``--list-rules`` prints the full rule table (id, severity, front end,
 title); with ``--check-docs`` it diffs the table against the README's
 rule rows and exits 1 on drift, so the docs cannot silently rot.
@@ -502,20 +509,126 @@ COLLECTIVES_ZOO = {
 }
 
 
-def _collectives_file_report(path: str):
-    """Import a file target and return its ``collectives_report()``
-    Report, or None when the file declares no hook (it is then
-    AST-linted like any other target)."""
+# ---------------------------------------------------------------------------
+# --pallas zoo: the hand-written kernel tier.  Each entry traces its
+# public entry point through trace_kernels (the pallas_call intercept
+# under eval_shape — abstract, no FLOPs spent) and runs the PTA6xx
+# passes on every captured kernel model.  Every entry returns a
+# finished Report and must stay clean at zero errors AND zero warnings
+# — the regression guard for the kernels' tiling/masking/precision
+# invariants.
+# ---------------------------------------------------------------------------
+
+
+def _pzoo_flash_attention():
+    """Trace the flash-attention fwd+bwd kernels at a NON-divisible
+    causal shape (sq=sk=1300: tail blocks on both grid axes) — the
+    configuration the PTA601/PTA604 tail-mask passes exist for — with
+    grads so the dq/dkv kernels are captured too."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.analysis import analyze_kernels
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    def loss(q, k, v):
+        return fa.flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    sds = jax.ShapeDtypeStruct((1, 1300, 2, 64), jnp.bfloat16)
+    return analyze_kernels(jax.grad(loss, argnums=(0, 1, 2)),
+                           sds, sds, sds, name="zoo:flash")
+
+
+def _pzoo_fused_adam():
+    """Trace the fused Adam elementwise kernel on an odd (non-tile-
+    aligned) flat parameter size — the pad/reshape path."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.analysis import analyze_kernels
+    from paddle_tpu.ops.pallas.fused_adam import fused_adam_update
+
+    sds = jax.ShapeDtypeStruct((100003,), jnp.float32)
+    return analyze_kernels(
+        lambda p, g, m, v: fused_adam_update(
+            p, g, m, v, lr_t=1e-3, beta1=0.9, beta2=0.999, eps=1e-8),
+        sds, sds, sds, sds, name="zoo:fused_adam")
+
+
+def _pzoo_fused_ce():
+    """Trace the fused linear-cross-entropy kernels (logz + dh/dw) at a
+    non-divisible token count (n=300) with grads — the padded-tail
+    configuration its PTA601 fix covers."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.analysis import analyze_kernels
+    from paddle_tpu.ops.pallas.fused_ce import fused_linear_cross_entropy
+
+    def loss(h, w, labels):
+        return fused_linear_cross_entropy(h, w, labels).sum()
+
+    h = jax.ShapeDtypeStruct((300, 128), jnp.float32)
+    w = jax.ShapeDtypeStruct((1000, 128), jnp.float32)
+    lab = jax.ShapeDtypeStruct((300,), jnp.int32)
+    return analyze_kernels(jax.grad(loss, argnums=(0, 1)), h, w, lab,
+                           name="zoo:fused_ce")
+
+
+def _pzoo_ring_attention():
+    """Trace ring attention on an sp=2 virtual mesh.  The ppermute
+    schedule is currently pure jnp — the trace captures zero
+    pallas_call models and the report is empty by construction — but
+    the entry pins the coverage surface: the planned ragged
+    paged-attention / fused ring-collective kernels (ROADMAP) land
+    inside this trace the day they exist."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.analysis import analyze_kernels
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    _require_devices(2, "zoo:ring_attention")
+    mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
+
+    def attn(q, k, v):
+        return ring_attention(q, k, v, causal=True, mesh=mesh)
+
+    sds = jax.ShapeDtypeStruct((2, 8, 2, 4), jnp.float32)
+    return analyze_kernels(attn, sds, sds, sds,
+                           name="zoo:ring_attention")
+
+
+PALLAS_ZOO = {
+    "flash_attention": _pzoo_flash_attention,
+    "fused_adam": _pzoo_fused_adam,
+    "fused_ce": _pzoo_fused_ce,
+    "ring_attention": _pzoo_ring_attention,
+}
+
+
+def _file_hook_report(path: str, hook_name: str):
+    """Import a file target and return its ``<hook_name>()`` Report, or
+    None when the file declares no hook (it is then AST-linted like any
+    other target)."""
     import importlib.util
-    name = "_prog_lint_collectives_" + \
+    name = "_prog_lint_hook_" + \
         os.path.splitext(os.path.basename(path))[0]
     spec = importlib.util.spec_from_file_location(name, path)
     if spec is None or spec.loader is None:
         return None
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    hook = getattr(mod, "collectives_report", None)
+    hook = getattr(mod, hook_name, None)
     return hook() if callable(hook) else None
+
+
+def _collectives_file_report(path: str):
+    """Import a file target and return its ``collectives_report()``
+    Report, or None when the file declares no hook (it is then
+    AST-linted like any other target)."""
+    return _file_hook_report(path, "collectives_report")
 
 
 def resolve_target(target: str):
@@ -623,6 +736,13 @@ def main(argv=None) -> int:
                          "file targets with a collectives_report() "
                          "hook are imported, other targets AST-lint "
                          "as usual")
+    ap.add_argument("--pallas", action="store_true",
+                    help="arm the Pallas kernel pass family "
+                         "(PTA601-606): zoo entries resolve to the "
+                         "traced kernel tier "
+                         f"({', '.join(sorted(PALLAS_ZOO))}), file "
+                         "targets with a pallas_report() hook are "
+                         "imported, other targets AST-lint as usual")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the registered rule table and exit")
     ap.add_argument("--check-docs", action="store_true",
@@ -640,12 +760,12 @@ def main(argv=None) -> int:
     ap.add_argument("--no-cost", action="store_true",
                     help="skip the PTA106 cost report (quieter json)")
     a = ap.parse_args(argv)
-    if a.threads and a.collectives:
-        ap.error("--threads and --collectives are distinct front ends; "
-                 "run them as separate invocations")
-    if a.collectives:
-        # the collectives zoo traces dp/mp/sharding meshes: make the
-        # virtual CPU devices exist before jax initializes
+    if sum((a.threads, a.collectives, a.pallas)) > 1:
+        ap.error("--threads, --collectives and --pallas are distinct "
+                 "front ends; run them as separate invocations")
+    if a.collectives or a.pallas:
+        # these zoos trace dp/mp/sharding/sp meshes: make the virtual
+        # CPU devices exist before jax initializes
         _virtual_devices(8)
     if a.list_rules:
         print(list_rules(a.format))
@@ -680,12 +800,14 @@ def main(argv=None) -> int:
             for path in resolve_target(target):
                 rel = os.path.relpath(path, REPO) \
                     if path.startswith(REPO) else path
-                if a.collectives and os.path.isfile(target) and \
-                        path == target:
-                    # a single-file collectives target may carry the
-                    # traced-fixture hook (collectives_report) — the
-                    # committed divergence fixture's static half
-                    hooked = _collectives_file_report(path)
+                if (a.collectives or a.pallas) and \
+                        os.path.isfile(target) and path == target:
+                    # a single-file target may carry the traced-fixture
+                    # hook (collectives_report / pallas_report) — the
+                    # committed divergence fixtures' static halves
+                    hooked = _file_hook_report(
+                        path, "pallas_report" if a.pallas
+                        else "collectives_report")
                     if hooked is not None:
                         hooked.files_seen = [rel]
                         report.extend(hooked)
@@ -696,7 +818,8 @@ def main(argv=None) -> int:
                     d.file = rel
                 report.extend(sub)
 
-    zoo_map = COLLECTIVES_ZOO if a.collectives else ZOO
+    zoo_map = PALLAS_ZOO if a.pallas else \
+        COLLECTIVES_ZOO if a.collectives else ZOO
     zoo = a.zoo
     if "all" in zoo:
         zoo = sorted(zoo_map)
