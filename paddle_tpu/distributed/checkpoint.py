@@ -22,26 +22,68 @@ jax.distributed analogue of every PS rank persisting its own table shard.
 """
 from __future__ import annotations
 
+import io
 import json
 import os
-from typing import Any, Callable, Dict, Optional
+import threading
+import zlib
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from paddle_tpu.core import Tensor
-from paddle_tpu.framework import chaos
+from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework.observability import flight
 
 __all__ = ["save_sharded", "load_sharded", "restore_like",
-           "save_train_state", "load_train_state", "checkpoint_meta"]
+           "save_train_state", "load_train_state", "checkpoint_meta",
+           "verify_checkpoint", "is_committed", "write_commit",
+           "read_commit", "AsyncSaveHandle", "CheckpointVerifyError",
+           "wait_pending_saves"]
 
 _META = "metadata.json"
+_COMMIT = "COMMIT"
+
+
+class CheckpointVerifyError(RuntimeError):
+    """A checkpoint directory failed integrity verification at a point
+    where proceeding would persist or load corrupt state (save-side
+    verify before commit).  Load-side verification never raises this —
+    it falls back generation-by-generation instead."""
+
+
+class _HostShardedLeaf:
+    """Host-RAM snapshot of one jax.Array's replica-0 device shards —
+    what ``save_train_state(mode="async")`` captures at the step boundary
+    (the ``resilient.snapshot`` idiom) so the background writer never
+    touches live device buffers the next step may donate.  Persisted by
+    save_sharded with the exact per-shard file layout the live array
+    would have produced, so async and sync saves are interchangeable."""
+
+    __slots__ = ("shape", "dtype", "shards")
+
+    def __init__(self, arr: "jax.Array"):
+        self.shape = tuple(arr.shape)
+        self.dtype = np.dtype(arr.dtype)
+        self.shards = [(s.index, np.asarray(s.data))
+                       for s in arr.addressable_shards if s.replica_id == 0]
+
+
+def _snapshot_leaf(arr):
+    """Host-copy one state leaf at the step boundary: sharded jax Arrays
+    keep their shard structure, everything else becomes a plain host
+    array."""
+    if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
+        return _HostShardedLeaf(arr)
+    return np.asarray(arr)
 
 
 def _leafify(obj, leaves, path):
     if isinstance(obj, Tensor):
         obj = obj._data
-    if isinstance(obj, (jax.Array, np.ndarray, np.generic)):
+    if isinstance(obj, (jax.Array, np.ndarray, np.generic,
+                        _HostShardedLeaf)):
         idx = len(leaves)
         leaves.append((path, obj))
         return {"__leaf__": idx}
@@ -75,25 +117,37 @@ def _shard_fname(leaf_idx: int, index) -> str:
 
 def _atomic_save(dirpath: str, fname: str, arr: np.ndarray):
     """Crash-safe shard write: the ``ckpt.save`` chaos point fires before
-    the bytes land (simulating a kill mid-save), and the tmp+rename commit
-    means a torn write can never leave a half-written ``.npy`` under the
-    final name — the two-slot TrainEpochRange protocol on top then
-    guarantees a loadable committed slot survives any single crash."""
-    chaos.fault_point("ckpt.save", meta={"file": fname})  # pta: disable=PTA301 (TrainEpochRange two-slot protocol owns recovery)
+    the bytes land (simulating a kill mid-save), and the tmp+rename+
+    dir-fsync commit means a torn write can never leave a half-written
+    ``.npy`` under the final name (nor lose the rename to a power cut) —
+    the committed-generation protocol on top then guarantees a loadable
+    verified generation survives any single crash.
+
+    Returns ``(crc32, nbytes)`` of the serialized ``.npy`` stream — the
+    integrity stamp save_sharded records per shard in the metadata, so
+    verify_checkpoint can prove every byte landed intact."""
+    chaos.fault_point("ckpt.save", meta={"file": fname})  # pta: disable=PTA301 (committed-generation protocol owns recovery: load walks back to the newest verified commit)
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    payload = buf.getvalue()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
     final = os.path.join(dirpath, fname)
     tmp = final + f".tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
-            np.save(f, arr)
+            f.write(payload)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, final)
+        from paddle_tpu.distributed.fleet.utils.fs import fsync_dir
+        fsync_dir(dirpath)
     except BaseException:
         try:
             os.remove(tmp)
         except OSError:
             pass
         raise
+    return crc, len(payload)
 
 
 def save_sharded(state: Any, dirpath: str, step: Optional[int] = None,
@@ -116,12 +170,32 @@ def save_sharded(state: Any, dirpath: str, step: Optional[int] = None,
             for s in shards:
                 index = s.index
                 fname = _shard_fname(i, index)
-                _atomic_save(dirpath, fname, np.asarray(s.data))
+                crc, nbytes = _atomic_save(dirpath, fname,
+                                           np.asarray(s.data))
                 rec_shards.append({
                     "file": fname,
                     "index": [[sl.start or 0,
                                sl.stop if sl.stop is not None else dim]
                               for sl, dim in zip(index, arr.shape)],
+                    "crc32": crc, "bytes": nbytes,
+                })
+            meta_leaves.append({"path": path, "shape": list(arr.shape),
+                                "dtype": str(arr.dtype),
+                                "shards": rec_shards})
+        elif isinstance(arr, _HostShardedLeaf):
+            # async-save snapshot: the device shards were host-copied at
+            # the step boundary; persist the SAME per-shard file layout
+            # a live jax.Array would have produced
+            rec_shards = []
+            for index, data in arr.shards:
+                fname = _shard_fname(i, index)
+                crc, nbytes = _atomic_save(dirpath, fname, data)
+                rec_shards.append({
+                    "file": fname,
+                    "index": [[sl.start or 0,
+                               sl.stop if sl.stop is not None else dim]
+                              for sl, dim in zip(index, arr.shape)],
+                    "crc32": crc, "bytes": nbytes,
                 })
             meta_leaves.append({"path": path, "shape": list(arr.shape),
                                 "dtype": str(arr.dtype),
@@ -129,12 +203,14 @@ def save_sharded(state: Any, dirpath: str, step: Optional[int] = None,
         else:
             a = np.asarray(arr)
             fname = f"leaf{i}.full.npy"
-            _atomic_save(dirpath, fname, a)
+            crc, nbytes = _atomic_save(dirpath, fname, a)
             meta_leaves.append({"path": path, "shape": list(a.shape),
                                 "dtype": str(a.dtype),
                                 "shards": [{"file": fname,
                                             "index": [[0, d] for d in
-                                                      a.shape]}]})
+                                                      a.shape],
+                                            "crc32": crc,
+                                            "bytes": nbytes}]})
     pid = jax.process_index() if jax.process_count() > 1 else 0
     meta = {"skeleton": skel, "leaves": meta_leaves, "step": step}
     if extra_meta:
@@ -162,6 +238,139 @@ def checkpoint_meta(dirpath: str) -> Dict[str, Any]:
     meta.pop("skeleton", None)
     meta.pop("leaves", None)
     return meta
+
+
+# ---------------------------------------------------------------------------
+# integrity: per-shard crc32 verification + commit markers
+# ---------------------------------------------------------------------------
+
+def verify_checkpoint(dirpath: str, deep: bool = True) -> List[dict]:
+    """Integrity-check a checkpoint directory against its metadata.
+
+    Returns a list of problem records (empty = verified): each names the
+    offending ``file`` and a ``reason`` (``missing`` / ``truncated`` /
+    ``crc_mismatch`` / ``no_metadata`` / ``bad_metadata`` /
+    ``verify_error``).  ``deep=False`` skips the crc re-read (existence +
+    size only — the cheap probe the load-time generation walk uses on
+    legacy checkpoints without stamps).
+
+    Every detected corruption fires a ``ckpt.corrupt`` flight event and
+    counts ``ckpt_corrupt_total``.  The ``ckpt.verify`` chaos point at
+    the head models a broken verifier: an injected fault is swallowed
+    and counted (``ckpt_verify_errors_total``) and the checkpoint is
+    reported UNVERIFIABLE (fail-closed — callers treat it exactly like
+    corruption and fall back), never silently trusted."""
+    try:
+        chaos.fault_point("ckpt.verify", meta={"dir": dirpath})
+    except chaos.InjectedFault as e:
+        monitor.stat_add("ckpt_verify_errors_total")
+        flight.record("ckpt.verify_error", severity="warn",
+                      dir=dirpath, error=repr(e))
+        return [{"file": _META, "reason": "verify_error",
+                 "detail": repr(e)}]
+    problems: List[dict] = []
+    meta_path = os.path.join(dirpath, _META)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        recs = meta["leaves"]
+    except (OSError, ValueError, KeyError) as e:
+        reason = "no_metadata" if not os.path.exists(meta_path) \
+            else "bad_metadata"
+        problems.append({"file": _META, "reason": reason,
+                         "detail": repr(e)})
+        _record_corruption(dirpath, problems)
+        return problems
+    for rec in recs:
+        for sh in rec["shards"]:
+            fpath = os.path.join(dirpath, sh["file"])
+            try:
+                size = os.path.getsize(fpath)
+            except OSError:
+                problems.append({"file": sh["file"], "reason": "missing",
+                                 "leaf": rec["path"]})
+                continue
+            want_bytes = sh.get("bytes")
+            if want_bytes is not None and size != want_bytes:
+                problems.append({"file": sh["file"], "reason": "truncated",
+                                 "leaf": rec["path"], "size": size,
+                                 "expected": want_bytes})
+                continue
+            want_crc = sh.get("crc32")
+            if deep and want_crc is not None:
+                crc = 0
+                try:
+                    with open(fpath, "rb") as f:
+                        while True:
+                            chunk = f.read(1 << 20)
+                            if not chunk:
+                                break
+                            crc = zlib.crc32(chunk, crc)
+                except OSError as e:
+                    problems.append({"file": sh["file"],
+                                     "reason": "missing", "detail": repr(e),
+                                     "leaf": rec["path"]})
+                    continue
+                if (crc & 0xFFFFFFFF) != want_crc:
+                    problems.append({"file": sh["file"],
+                                     "reason": "crc_mismatch",
+                                     "leaf": rec["path"]})
+            elif want_crc is None and want_bytes is None:
+                # legacy stamp-less shard: the strongest cheap check is
+                # that the npy header still parses to the declared shape
+                try:
+                    a = np.load(fpath, mmap_mode="r")
+                    del a
+                except (OSError, ValueError) as e:
+                    problems.append({"file": sh["file"],
+                                     "reason": "truncated",
+                                     "detail": repr(e),
+                                     "leaf": rec["path"]})
+    if problems:
+        _record_corruption(dirpath, problems)
+    return problems
+
+
+def _record_corruption(dirpath: str, problems: List[dict]):
+    monitor.stat_add("ckpt_corrupt_total")
+    flight.record("ckpt.corrupt", severity="error", dir=dirpath,
+                  files=[p["file"] for p in problems[:8]],
+                  reasons=sorted({p["reason"] for p in problems}))
+
+
+def write_commit(dirpath: str, generation: Optional[int] = None,
+                 verify: bool = True):
+    """Stamp a checkpoint directory COMMITTED — written strictly LAST,
+    and (by default) only after every shard re-reads intact.  The marker
+    is the atomic unit the generation walk trusts: a directory without
+    one is at best mid-save, at worst torn, and is never loaded.
+    Raises :class:`CheckpointVerifyError` when verification fails (the
+    save did NOT commit; the previous generation stands)."""
+    if verify:
+        problems = verify_checkpoint(dirpath)
+        if problems:
+            raise CheckpointVerifyError(
+                f"refusing to commit {dirpath}: "
+                + "; ".join(f"{p['file']}: {p['reason']}"
+                            for p in problems[:4]))
+    import time as _time
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+    LocalFS().atomic_write(
+        os.path.join(dirpath, _COMMIT),
+        json.dumps({"generation": generation, "time": _time.time()}))
+
+
+def read_commit(dirpath: str) -> Optional[dict]:
+    """The directory's commit record, or None when uncommitted/torn."""
+    try:
+        with open(os.path.join(dirpath, _COMMIT)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_committed(dirpath: str) -> bool:
+    return read_commit(dirpath) is not None
 
 
 def _window_reader(dirpath: str, rec: dict) -> Callable:
@@ -293,22 +502,14 @@ def restore_like(template: Any, dirpath: str):
 
 
 # ---------------------------------------------------------------------------
-# TrainStep-level convenience
+# TrainStep-level convenience + async save tier
 # ---------------------------------------------------------------------------
 
-def save_train_state(step, dirpath: str, global_step: Optional[int] = None,
-                     world_size: Optional[int] = None):
-    """Persist a (Sharded)TrainStep's full training state: params, buffers,
-    optimizer slots.  Counterpart of the reference's save_persistables +
-    optimizer state save (framework/io.py save path).  ``world_size``
-    (data-parallel width at save time) is recorded in the metadata so an
-    elastic job restoring at a *different* width — shrink-to-survive —
-    can tell, via :func:`checkpoint_meta`, that it is crossing layouts.
-
-    A ZeRO step (``parallel.zero.ShardedUpdateTrainStep``) persists its
-    dp-sharded flat moments as-is (one file per dp shard) and stamps its
-    shard bookkeeping (``checkpoint_extra_meta``) into the metadata, so
-    :func:`load_train_state` can reshard onto a different dp width."""
+def _capture_train_state(step, global_step: Optional[int],
+                         world_size: Optional[int]):
+    """Collect a TrainStep's full state pytree + extra metadata — live
+    device arrays (sync save) or, through :func:`_snapshot_state`, a
+    host copy (async save)."""
     model = step.model
     state = {
         "params": {n: p._data for n, p in model.named_parameters()},
@@ -325,8 +526,154 @@ def save_train_state(step, dirpath: str, global_step: Optional[int] = None,
     meta_fn = getattr(step, "checkpoint_extra_meta", None)
     if callable(meta_fn):
         extra.update(meta_fn())
-    save_sharded(state, dirpath, step=global_step,
-                 extra_meta=extra or None)
+    return state, extra
+
+
+def _snapshot_state(state):
+    """Host-copy every array leaf of a state pytree at the step boundary
+    (the ``resilient.snapshot`` idiom): sharded jax Arrays keep their
+    per-shard structure (:class:`_HostShardedLeaf`), so the background
+    writer produces byte-identical files to a sync save — and never
+    races the next step's donated device buffers."""
+    if isinstance(state, dict):
+        return {k: _snapshot_state(v) for k, v in state.items()}
+    if isinstance(state, (list, tuple)):
+        return type(state)(_snapshot_state(v) for v in state)
+    if isinstance(state, Tensor):
+        state = state._data
+    if isinstance(state, (jax.Array, np.ndarray, np.generic)):
+        return _snapshot_leaf(state)
+    return state
+
+
+class AsyncSaveHandle:
+    """Handle to one in-flight background checkpoint write.
+
+    ``wait()`` joins it and returns True when the write (and commit, if
+    requested) landed; an exception in the writer thread re-raises there
+    — never in the training thread that moved on."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self.committed = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if not self._done.wait(timeout):
+            raise TimeoutError("async checkpoint save still in flight")
+        if self._exc is not None:
+            raise self._exc
+        return True
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class _AsyncSaver:
+    """At-most-one-in-flight background checkpoint writer.
+
+    The fence: submitting a new save first JOINS the previous one — two
+    concurrent writers racing the same directory tree (or saturating
+    host I/O under the training loop) is exactly the failure mode an
+    async tier must exclude by construction.  One module-level instance
+    serves the process (the jax.distributed one-controller-per-host
+    shape)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: Optional[threading.Thread] = None
+
+    def submit(self, fn, handle: AsyncSaveHandle) -> AsyncSaveHandle:
+        with self._lock:
+            prev = self._inflight
+            if prev is not None and prev.is_alive():
+                prev.join()              # the at-most-one-in-flight fence
+
+            def run():
+                try:
+                    fn()
+                except BaseException as e:   # noqa: BLE001 — surfaced at wait()
+                    handle._exc = e
+                    flight.record("ckpt.async_error", severity="error",
+                                  error=repr(e))
+                    monitor.stat_add("ckpt_async_errors_total")
+                finally:
+                    handle._done.set()
+
+            t = threading.Thread(target=run, name="ckpt-async-save",
+                                 daemon=True)
+            self._inflight = t
+            t.start()
+        return handle
+
+    def wait_idle(self, timeout: Optional[float] = None):
+        """Block until no save is in flight (shutdown / test fence)."""
+        with self._lock:
+            t = self._inflight
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+
+_async_saver = _AsyncSaver()
+
+
+def wait_pending_saves(timeout: Optional[float] = None):
+    """Join any in-flight async checkpoint write — the process-exit /
+    pre-restore fence (an emergency save must not race a background
+    writer into the same generation tree)."""
+    _async_saver.wait_idle(timeout)
+
+
+def save_train_state(step, dirpath: str, global_step: Optional[int] = None,
+                     world_size: Optional[int] = None, mode: str = "sync",
+                     commit: bool = False):
+    """Persist a (Sharded)TrainStep's full training state: params, buffers,
+    optimizer slots.  Counterpart of the reference's save_persistables +
+    optimizer state save (framework/io.py save path).  ``world_size``
+    (data-parallel width at save time) is recorded in the metadata so an
+    elastic job restoring at a *different* width — shrink-to-survive —
+    can tell, via :func:`checkpoint_meta`, that it is crossing layouts.
+
+    A ZeRO step (``parallel.zero.ShardedUpdateTrainStep``) persists its
+    dp-sharded flat moments as-is (one file per dp shard) and stamps its
+    shard bookkeeping (``checkpoint_extra_meta``) into the metadata, so
+    :func:`load_train_state` can reshard onto a different dp width.
+
+    ``mode="async"``: snapshot the state to host RAM at the step
+    boundary (per-shard, so the file layout matches a sync save), then
+    write on a background thread behind an at-most-one-in-flight fence;
+    returns an :class:`AsyncSaveHandle`.  A broken async tier — modeled
+    by the ``ckpt.async`` chaos point at the dispatch head — degrades to
+    a counted sync save (``ckpt_async_fallbacks_total`` +
+    ``ckpt.async_fallback`` flight event): durability never hinges on
+    the background thread existing.  ``commit=True`` verifies every
+    shard after the write and stamps the COMMIT marker (written strictly
+    last) — the unit the generation walk trusts."""
+    if mode not in ("sync", "async"):
+        raise ValueError(f"unknown save mode {mode!r}")
+    state, extra = _capture_train_state(step, global_step, world_size)
+
+    def write(st):
+        save_sharded(st, dirpath, step=global_step,
+                     extra_meta=extra or None)
+        if commit:
+            write_commit(dirpath, generation=global_step)
+
+    if mode == "async":
+        snap = _snapshot_state(state)
+        try:
+            chaos.fault_point("ckpt.async", meta={"dir": dirpath})
+            handle = AsyncSaveHandle()
+            out = _async_saver.submit(lambda: write(snap), handle)
+            out.committed = commit
+            return out
+        except chaos.InjectedFault as e:
+            monitor.stat_add("ckpt_async_fallbacks_total")
+            flight.record("ckpt.async_fallback", severity="warn",
+                          dir=dirpath, error=repr(e))
+            state = snap             # fall through to the sync path
+    write(state)
+    return None
 
 
 def load_train_state(step, dirpath: str):

@@ -267,6 +267,12 @@ class FileStore(RendezvousStore):
                         f.flush()
                         os.fsync(f.fileno())
                     os.replace(tmp, self.path)
+                    # durability: the rename lives in the directory
+                    # inode — without this a power cut can resurrect a
+                    # stale membership file (fs.fsync_dir rationale)
+                    from paddle_tpu.distributed.fleet.utils.fs import \
+                        fsync_dir
+                    fsync_dir(os.path.dirname(self.path))
                 finally:
                     fcntl.flock(lf.fileno(), fcntl.LOCK_UN)
         return cm()
@@ -380,7 +386,7 @@ class WorkerHandle:
     def exit_code(self) -> Optional[int]:
         raise NotImplementedError
 
-    def kill(self):
+    def kill(self, grace: float = 0.0):
         raise NotImplementedError
 
     def restart(self):
@@ -401,14 +407,25 @@ class ProcHandle(WorkerHandle):
     def exit_code(self) -> Optional[int]:
         return self.child.proc.poll()
 
-    def kill(self):
-        # hard kill, no SIGTERM grace: the agent kills only children it
-        # has already judged hung or fenced, and a supervision pass that
-        # blocks in a graceful-shutdown wait would stall the lease
-        # renewals every healthy peer depends on
+    def kill(self, grace: float = 0.0):
+        # default (grace=0): hard kill, no SIGTERM — the agent kills only
+        # children it has already judged hung or fenced, and a
+        # supervision pass that blocks in a graceful-shutdown wait would
+        # stall the lease renewals every healthy peer depends on.
+        # grace>0 is the PREEMPTION contract (ElasticAgent term_grace):
+        # SIGTERM first, so the child's crash-handler chain gets the
+        # window to run its deadline-bounded emergency checkpoint save
+        # (observability.on_sigterm), then SIGKILL whatever remains.
         proc = self.child.proc
         if proc.poll() is None:
-            proc.kill()
+            if grace > 0:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=grace)
+                except Exception:        # noqa: BLE001 — still alive
+                    pass
+            if proc.poll() is None:
+                proc.kill()
             try:
                 proc.wait(timeout=5)     # reap; instant after SIGKILL
             except Exception:            # noqa: BLE001
@@ -472,7 +489,8 @@ class LocalHandle(WorkerHandle):
             return None
         return self._rc
 
-    def kill(self):
+    def kill(self, grace: float = 0.0):
+        # the stop event IS the graceful path; grace adds nothing here
         self.killed = True
         self.stop.set()
 
@@ -516,7 +534,8 @@ class ElasticAgent:
                  member_names: Optional[Sequence[str]] = None,
                  endpoints: Optional[Dict[str, str]] = None,
                  first_beat_deadline: Optional[float] = None,
-                 straggler_ttl: float = 60.0):
+                 straggler_ttl: float = 60.0,
+                 term_grace: float = 0.0):
         self.store = store
         self.handles = list(handles)
         # member -> host:port, re-attached when the agent re-registers a
@@ -535,6 +554,12 @@ class ElasticAgent:
         self.backoff_cap = float(backoff_cap)
         self.healthy_interval = float(healthy_interval)
         self.min_world = int(min_world)
+        # seconds of SIGTERM grace granted before any kill (0 = the
+        # classic hard kill).  The preemption contract: grace >= the
+        # workers' FLAGS_ckpt_emergency_deadline lets every kill path —
+        # fence, hang, straggler shrink, shutdown — land one final
+        # emergency checkpoint generation before SIGKILL
+        self.term_grace = float(term_grace)
         # a worker that registered but NEVER beat is exempt from the
         # hang deadline (plain scripts don't beat at all); with
         # elastic-aware trainers, set first_beat_deadline to also catch
@@ -592,7 +617,7 @@ class ElasticAgent:
             events.append(("lease_expired", w))
             h = self._by_name(w)
             if h is not None and h.alive():
-                h.kill()                     # fence: the lease is gone
+                h.kill(self.term_grace)      # fence: the lease is gone
                 events.append(("fenced", w))
 
         for h in self.handles:
@@ -625,7 +650,7 @@ class ElasticAgent:
                     deadline = self.hang_deadline if prog[1] >= 0 \
                         else self.first_beat_deadline
                     if deadline is not None and prog[0] > deadline:
-                        h.kill()
+                        h.kill(self.term_grace)
                         self.store.leave(h.name)
                         events.append(("hang_killed", h.name, prog[0]))
                         self._schedule_or_shrink(h, now, events)
@@ -813,7 +838,7 @@ class ElasticAgent:
             if h is None or name in self._gone or name in self._restart_at:
                 continue
             score = self.straggler_scores.get(name, 0.0)
-            h.kill()
+            h.kill(self.term_grace)      # planned preemption: grant grace
             try:
                 self.store.leave(name)
             except (LeaseExpired, chaos.InjectedFault, OSError):
@@ -877,7 +902,7 @@ class ElasticAgent:
                     (deadline is not None and self.clock() > deadline):
                 for h in self.handles:   # never orphan children: a dead
                     if h.alive():        # supervisor must not leave
-                        h.kill()         # trainers pushing unsupervised
+                        h.kill(self.term_grace)  # trainers unsupervised
                 return 1
             time.sleep(poll_interval)
 

@@ -15,7 +15,29 @@ import subprocess
 from typing import List, Optional, Tuple
 
 __all__ = ["ExecuteError", "FSFileExistsError", "FSFileNotExistsError",
-           "FSTimeOut", "FSShellCmdAborted", "FS", "LocalFS", "HDFSClient"]
+           "FSTimeOut", "FSShellCmdAborted", "FS", "LocalFS", "HDFSClient",
+           "fsync_dir"]
+
+
+def fsync_dir(dirpath: str):
+    """fsync a DIRECTORY: tmp+rename alone is not crash-durable on ext4 —
+    the rename lives in the directory inode, and a power cut can forget
+    it even though the file's own bytes were fsynced.  Every crash-safe
+    writer in the tree (LocalFS.atomic_write, checkpoint shard writes,
+    the elastic FileStore) commits through this after its rename.
+    Best-effort on filesystems that refuse directory fsync (EINVAL on
+    some network mounts): the rename is still atomic, just not durable
+    past a power cut there."""
+    try:
+        fd = os.open(dirpath or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class ExecuteError(Exception):
@@ -166,11 +188,14 @@ class LocalFS(FS):
             return f.read()
 
     def atomic_write(self, fs_path, data):
-        """Crash-safe write: tmp file + fsync + os.replace, so a kill at
-        any instant leaves either the old file or the new one — never a
-        torn mix.  The ``fs.write`` chaos point sits in the torn-write
-        window (after the tmp write, before the rename) so the
-        fault-injection suite can prove exactly that property."""
+        """Crash-safe write: tmp file + fsync + os.replace + parent-dir
+        fsync, so a kill at any instant leaves either the old file or
+        the new one — never a torn mix — and the rename itself survives
+        a power cut (tmp+rename alone is not crash-durable on ext4: the
+        rename lives in the directory inode, which needs its own fsync).
+        The ``fs.write`` chaos point sits in the torn-write window
+        (after the tmp write, before the rename) so the fault-injection
+        suite can prove exactly that property."""
         from paddle_tpu.framework import chaos
         mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
         tmp = f"{fs_path}.tmp.{os.getpid()}"
@@ -181,6 +206,7 @@ class LocalFS(FS):
                 os.fsync(f.fileno())
             chaos.fault_point("fs.write", meta={"path": fs_path})
             os.replace(tmp, fs_path)           # atomic commit point
+            fsync_dir(os.path.dirname(fs_path))
         except BaseException:
             # a simulated crash leaves the destination untouched; drop
             # the orphan tmp so transient errors don't accumulate litter
@@ -304,6 +330,10 @@ class HDFSClient(FS):
         mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
         with tempfile.NamedTemporaryFile(mode, delete=False) as f:
             f.write(data)
+            # same durability fix as LocalFS: the staged bytes must be on
+            # disk before the shell upload reads them back
+            f.flush()
+            os.fsync(f.fileno())
             local = f.name
         remote_tmp = f"{fs_path}.tmp.{os.getpid()}"
         backup = f"{fs_path}.old"

@@ -70,6 +70,13 @@ def _parse():
                    help="elastic: kill a child whose progress beat is "
                         "older than this (hung/straggler detection; only "
                         "applies once the child has beaten at least once)")
+    p.add_argument("--term_grace", type=float, default=0.0,
+                   help="elastic: SIGTERM grace seconds granted before "
+                        "any kill — the preemption window a child's "
+                        "crash-handler chain spends on its deadline-"
+                        "bounded emergency checkpoint save "
+                        "(FLAGS_ckpt_emergency_deadline); 0 keeps the "
+                        "classic immediate SIGKILL")
     p.add_argument("--collector", action="store_true",
                    help="start a central telemetry collector "
                         "(framework/collector.py) inside the launcher "
@@ -298,7 +305,8 @@ def _run_supervisor(args, children: List[_Child],
                          healthy_interval=args.healthy_interval,
                          log=lambda m: print(m, file=sys.stderr),
                          member_names=[c.name for c in members],
-                         endpoints=endpoints)
+                         endpoints=endpoints,
+                         term_grace=args.term_grace)
     if collector is not None:
         # cluster straggler scores flow into the agent's view: the
         # hang watchdog sees dead-silent workers, the collector sees

@@ -29,19 +29,48 @@ __all__ = ["TrainEpochRange", "train_epoch_range", "latest_checkpoint"]
 _STATUS = "acp_status.json"
 
 
-def latest_checkpoint(checkpoint_dir: str):
-    """The latest *committed* slot under a TrainEpochRange checkpoint
-    directory: ``(slot_dir, epoch)``, or None when nothing committed yet.
-    The status record is the two-slot protocol's commit point, so this
-    never returns a mid-save (torn) slot — it is what the elastic
-    re-form path (paddle_tpu.distributed.elastic.reform) restores from
-    when the job shrinks or grows."""
+def latest_checkpoint(checkpoint_dir: str, verify: bool = True):
+    """The latest committed AND verified slot under a TrainEpochRange
+    checkpoint directory: ``(slot_dir, epoch)``, or None when nothing
+    restorable exists.  The status record is the two-slot protocol's
+    commit point, so a mid-save (torn) slot is never returned — and
+    since a disk can rot a slot AFTER its commit, the slot's shards are
+    re-verified against their crc32 stamps here: a committed slot whose
+    metadata parses but whose shard files are missing / truncated /
+    bit-flipped fires ``ckpt.corrupt`` (inside verify) plus a
+    ``ckpt.fallback`` flight event, and the walk falls back to the
+    OTHER slot (the previous epoch, its own metadata supplying the
+    epoch number) instead of surfacing a raw IO error deep in restore.
+    This is what the elastic re-form path
+    (paddle_tpu.distributed.elastic.reform) restores from when the job
+    shrinks or grows."""
     try:
         with open(os.path.join(checkpoint_dir, _STATUS)) as f:
             s = json.load(f)
-        return os.path.join(checkpoint_dir, s["slot"]), int(s["epoch"])
+        slot_name, epoch = s["slot"], int(s["epoch"])
     except (OSError, ValueError, KeyError):
         return None
+    candidates = [(os.path.join(checkpoint_dir, slot_name), epoch)]
+    other = "slot1" if slot_name == "slot0" else "slot0"
+    other_dir = os.path.join(checkpoint_dir, other)
+    try:
+        candidates.append((other_dir,
+                           int(dckpt.checkpoint_meta(other_dir)["step"])))
+    except (OSError, ValueError, KeyError, TypeError):
+        pass                       # no usable second slot: one candidate
+    for slot_dir, ep in candidates:
+        if not verify:
+            return slot_dir, ep
+        problems = dckpt.verify_checkpoint(slot_dir)
+        if not problems:
+            return slot_dir, ep
+        from paddle_tpu.framework import monitor
+        from paddle_tpu.framework.observability import flight
+        monitor.stat_add("ckpt_fallback_total")
+        flight.record("ckpt.fallback", severity="warn", dir=slot_dir,
+                      epoch=ep,
+                      reasons=sorted({p["reason"] for p in problems}))
+    return None
 
 
 class TrainEpochRange:
@@ -73,9 +102,13 @@ class TrainEpochRange:
         self.restored_epoch = -1
         status = self._read_status()
         if status is not None and train_step is not None:
-            slot = os.path.join(self.checkpoint_dir, status["slot"])
-            dckpt.load_train_state(train_step, slot)
-            self.restored_epoch = status["epoch"]
+            # verified slot walk: a committed slot that rotted on disk
+            # falls back to the other slot's epoch instead of crashing
+            found = latest_checkpoint(self.checkpoint_dir)
+            if found is not None:
+                slot, epoch = found
+                dckpt.load_train_state(train_step, slot)
+                self.restored_epoch = int(epoch)
 
     # -- status record ------------------------------------------------------
     def _status_path(self):
@@ -113,6 +146,15 @@ class TrainEpochRange:
             shutil.rmtree(slot_dir)
         dckpt.save_train_state(self.train_step, slot_dir, global_step=epoch,
                                world_size=self.world_size)
+        # verify-before-flip: the status record must never point at a
+        # slot that can't be read back — a failed verify leaves the old
+        # status (and the old slot) standing
+        problems = dckpt.verify_checkpoint(slot_dir)
+        if problems:
+            raise dckpt.CheckpointVerifyError(
+                f"refusing to commit {slot_dir}: "
+                + "; ".join(f"{p['file']}: {p['reason']}"
+                            for p in problems[:4]))
         self._write_status(epoch, slot)
         self._last_save = time.monotonic()
 

@@ -32,6 +32,17 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         atomic_write)
 ``ckpt.save``           per-file checkpoint writes (distributed/
                         checkpoint.py)
+``ckpt.async``          async-save dispatch (distributed/checkpoint.py
+                        ``save_train_state(mode="async")``) — an
+                        injected fault means the background tier is
+                        broken; the save degrades to a counted
+                        synchronous save, never to no save
+``ckpt.verify``         checkpoint integrity verification
+                        (distributed/checkpoint.py verify_checkpoint)
+                        — an injected fault makes the verifier itself
+                        fail closed: the checkpoint is reported
+                        unverifiable, save-side commit refuses, and
+                        load walks back a generation
 ``download.fetch``      each fetch attempt (utils/download.py)
 ``train.step_grads``    per-step input poisoning (framework/resilient.py)
                         — ``mode="nan"`` with ``payload_index=i``
@@ -165,7 +176,8 @@ __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
            "payload_fault_points"]
 
 FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
-                "ckpt.save", "download.fetch", "train.step_grads",
+                "ckpt.save", "ckpt.async", "ckpt.verify",
+                "download.fetch", "train.step_grads",
                 "elastic.lease", "elastic.worker_hang",
                 "health.detector", "zero.collective",
                 "numerics.observe", "runlog.observe", "collector.rpc",

@@ -430,6 +430,23 @@ define_flag("flight_storm_k", 8,
             "identical (kind, attrs) flight events tolerated per "
             "flight_storm_window before further identical events are "
             "suppressed (ring skipped, counters still bumped)")
+# durable-state tier (distributed/durable.py CheckpointManager +
+# checkpoint.py async save + the SIGTERM emergency-save contract):
+define_flag("ckpt_keep_last", 2,
+            "checkpoint generations the GC always keeps (newest-first); "
+            "the newest VERIFIED commit is kept unconditionally on top "
+            "of this, so a bounded retention policy can never delete "
+            "the only restorable state")
+define_flag("ckpt_keep_every", 0,
+            "additionally keep every Nth generation (by generation "
+            "number) as a long-horizon archive — 0 disables; e.g. 100 "
+            "keeps gen 0, 100, 200, ... forever while ckpt_keep_last "
+            "bounds the rest")
+define_flag("ckpt_emergency_deadline", 10.0,
+            "seconds the SIGTERM emergency save may spend before the "
+            "handler gives up and proceeds with the crash dump — the "
+            "preemption contract: the save must fit the platform's "
+            "grace window, a hung save must not eat it")
 define_flag("profiler_max_spans", 100000,
             "cap on retained chrome-trace spans per profiling session; "
             "beyond it spans are dropped (counted — the Profiling "

@@ -52,6 +52,7 @@ from paddle_tpu.framework.flags import flag
 
 __all__ = ["SpanContext", "Span", "Tracer", "tracer", "FlightRecorder",
            "flight", "MetricsReporter", "install_crash_handler",
+           "on_sigterm", "remove_sigterm_callback",
            "validate_prometheus", "span_summary"]
 
 
@@ -715,6 +716,77 @@ class FlightRecorder:
 flight = FlightRecorder()
 
 
+# ---------------------------------------------------------------------------
+# SIGTERM emergency callbacks (the preemption grace-window contract)
+# ---------------------------------------------------------------------------
+
+#: ordered (name, fn, deadline) registry the crash handler's SIGTERM hook
+#: drains BEFORE dumping the flight ring — the durable-state plane
+#: registers its emergency checkpoint save here
+_sigterm_callbacks: List[tuple] = []
+# reentrant: the SIGTERM hook drains the registry from signal-handler
+# context — a plain Lock self-deadlocks if the interrupted thread was
+# inside on_sigterm/remove_sigterm_callback when the signal landed
+_sigterm_lock = locks.rlock("obs.sigterm")
+
+
+def on_sigterm(name: str, fn, deadline: Optional[float] = None):
+    """Register a deadline-bounded emergency callback for SIGTERM.
+
+    When the :func:`install_crash_handler` SIGTERM hook fires, every
+    registered callback runs (registration order) on a helper thread
+    joined with its deadline (``FLAGS_ckpt_emergency_deadline`` when
+    None) — a hung save cannot eat the platform's grace window; the
+    flight dump and the chained/re-delivered signal still happen.  Each
+    run is recorded (``sigterm.callback`` flight event: ok / error /
+    timeout).  Re-registering a name replaces the previous callback
+    (the training loop re-arms each generation with fresh state)."""
+    with _sigterm_lock:
+        _sigterm_callbacks[:] = [c for c in _sigterm_callbacks
+                                 if c[0] != name]
+        _sigterm_callbacks.append((name, fn, deadline))
+    return fn
+
+
+def remove_sigterm_callback(name: str) -> bool:
+    """Drop a registered emergency callback; True when it existed."""
+    with _sigterm_lock:
+        n = len(_sigterm_callbacks)
+        _sigterm_callbacks[:] = [c for c in _sigterm_callbacks
+                                 if c[0] != name]
+        return len(_sigterm_callbacks) < n
+
+
+def _run_sigterm_callbacks():
+    with _sigterm_lock:
+        cbs = list(_sigterm_callbacks)
+    for name, fn, deadline in cbs:
+        if deadline is None:
+            deadline = float(flag("ckpt_emergency_deadline"))
+        box: Dict[str, Any] = {}
+
+        def run(fn=fn, box=box):
+            try:
+                fn()
+                box["status"] = "ok"
+            except BaseException as e:  # noqa: BLE001 — post-mortem record
+                box["status"] = "error"
+                box["error"] = repr(e)
+
+        t = threading.Thread(target=run, name=f"sigterm-{name}",
+                             daemon=True)
+        t0 = time.monotonic()
+        t.start()
+        t.join(deadline)
+        status = box.get("status", "timeout")
+        flight.record("sigterm.callback",
+                      severity="info" if status == "ok" else "error",
+                      name=name, status=status,
+                      elapsed_s=round(time.monotonic() - t0, 3),
+                      **({"error": box["error"]} if "error" in box else {}))
+        monitor.stat_add(f"sigterm_callback_{status}_total")
+
+
 def install_crash_handler(worker: Optional[str] = None,
                           flight_dir: Optional[str] = None,
                           chain: bool = True, sigterm: bool = True):
@@ -759,6 +831,9 @@ def install_crash_handler(worker: Optional[str] = None,
         prev_term = _signal.getsignal(_signal.SIGTERM)
 
         def term_hook(signum, frame):
+            # emergency callbacks (deadline-bounded) run FIRST: the
+            # whole point of the grace window is the state they save
+            _run_sigterm_callbacks()
             _dump("sigterm")
             if callable(prev_term):
                 prev_term(signum, frame)

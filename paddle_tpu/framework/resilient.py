@@ -98,6 +98,48 @@ class ResilientTrainStep:
         self.last_bad_leaf: Optional[str] = None
         self.membership_epoch: Optional[int] = None
         self.membership_events = 0
+        # optional durable tier (attach_durable): periodic verified
+        # generations + the SIGTERM emergency save
+        self._durable = None
+        self._durable_every = 0
+        self._durable_mode = "async"
+        self._durable_ws: Optional[int] = None
+
+    # -- durable tier --------------------------------------------------------
+    def attach_durable(self, manager, every: int = 0, mode: str = "async",
+                       world_size: Optional[int] = None,
+                       arm_preemption: bool = True):
+        """Wire the rollback tier to a multi-generation durable store
+        (:class:`paddle_tpu.distributed.durable.CheckpointManager`).
+
+        ``every=N`` persists a verified, committed generation after
+        every N-th GOOD step (``mode="async"`` by default: the host
+        snapshot happens at the step boundary, the write off-thread —
+        the rollback snapshot this class already takes makes the extra
+        host copy cheap by comparison); 0 leaves cadence to the caller.
+        Only good steps count: a rolled-back step must never become a
+        generation.  ``arm_preemption`` registers the SIGTERM emergency
+        save (deadline-bounded, through the install_crash_handler
+        chain), so a preempted worker lands one final generation of its
+        last-good state inside the agent's ``term_grace`` window."""
+        self._durable = manager
+        self._durable_every = int(every)
+        self._durable_mode = mode
+        self._durable_ws = world_size
+        if arm_preemption:
+            manager.arm_emergency_save(
+                self.step,
+                lambda: int(getattr(self.step.optimizer,
+                                    "_global_step", 0)))
+        return manager
+
+    def _maybe_save_durable(self):
+        if self._durable is None or self._durable_every <= 0:
+            return
+        gen = int(getattr(self.step.optimizer, "_global_step", 0))
+        if gen > 0 and gen % self._durable_every == 0:
+            self._durable.save(self.step, gen, world_size=self._durable_ws,
+                               mode=self._durable_mode)
 
     # -- snapshot / restore --------------------------------------------------
     def snapshot(self):
@@ -208,6 +250,7 @@ class ResilientTrainStep:
             self._good_since_snap += 1
             if self._good_since_snap >= self.snapshot_every:
                 self.snapshot()
+            self._maybe_save_durable()
             return loss
         self.consecutive_bad += 1
         self.skipped_steps += 1

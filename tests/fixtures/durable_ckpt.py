@@ -1,0 +1,226 @@
+"""Durable-state lane fixture — the checkpoint plane's acceptance
+artifact (tools/ci.sh durability lane).
+
+Modes (``python tests/fixtures/durable_ckpt.py <mode> [root]``):
+
+* ``clean`` — train, persist three generations (sync + async + async),
+  restore into a fresh step; prints ``DURABLE_CLEAN gen=<N>`` when the
+  newest generation restores bit-exact.
+* ``corrupt`` — persist two generations, bit-flip one shard of the
+  newest, restore: the generation walk must land on the OLDER verified
+  generation, fire the named ``ckpt.corrupt`` flight event, and GC must
+  keep the survivor.  Prints ``DURABLE_RECOVERED <gen_name>`` plus one
+  ``FLIGHT <kind>`` line per recorded flight kind (the lane greps
+  ``FLIGHT ckpt.corrupt``).
+* ``chaos`` — two identical runs with ``ckpt.async`` armed ERROR under
+  a fixed seed (every async save degrades to a counted sync save); the
+  final parameter state of both runs must hash bit-identically.
+  Prints ``CKPT_CHAOS_BITIDENTICAL <sha256>``.
+* ``child`` / ``sigkill-parent`` — the SIGKILL-mid-async-save pair: the
+  child commits generation 1, then starts an ASYNC save of generation 2
+  with ``ckpt.save`` armed to stall mid-shard-sequence and prints
+  ``CHILD_SAVING`` (the parent's kill cue).  The parent SIGKILLs it
+  there, then proves recovery: generation 2 is present-but-uncommitted
+  (or torn), the walk lands on generation 1 BY NAME, and a fresh step
+  restores it.  Prints ``DURABLE_SIGKILL_RECOVERED gen_00000001``.
+
+Every verdict line is grepped by tools/ci.sh; keep them stable.
+"""
+import hashlib
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.distributed import checkpoint as ck  # noqa: E402
+from paddle_tpu.distributed.durable import CheckpointManager  # noqa: E402
+from paddle_tpu.framework import chaos  # noqa: E402
+from paddle_tpu.framework.observability import flight  # noqa: E402
+from paddle_tpu.jit import TrainStep  # noqa: E402
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 8)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _loss(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+def _build(seed: int = 0):
+    paddle.seed(seed)
+    m = Net()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    return TrainStep(m, _loss, opt)
+
+
+def _batch(seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+
+
+def _param_hash(step) -> str:
+    h = hashlib.sha256()
+    for name, p in sorted(step.model.named_parameters()):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(np.asarray(p._data)).tobytes())
+    return h.hexdigest()
+
+
+def _bitflip_one_shard(dirpath: str):
+    shard = sorted(f for f in os.listdir(dirpath) if f.endswith(".npy"))[0]
+    path = os.path.join(dirpath, shard)
+    with open(path, "r+b") as f:
+        f.seek(96)
+        b = f.read(1)
+        f.seek(96)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return shard
+
+
+def mode_clean(root: str) -> int:
+    step = _build()
+    x = _batch()
+    mgr = CheckpointManager(root, keep_last=3)
+    step(x, x)
+    mgr.save(step, 1, mode="sync")
+    step(x, x)
+    h2 = mgr.save(step, 2, mode="async")
+    if h2 is not None:
+        h2.wait()
+    step(x, x)
+    want = _param_hash(step)
+    h3 = mgr.save(step, 3, mode="async")
+    if h3 is not None:
+        h3.wait()
+    fresh = _build(seed=123)
+    gen = mgr.restore(fresh)
+    assert gen == 3, f"expected gen 3, restored {gen}"
+    assert _param_hash(fresh) == want, "restored state not bit-exact"
+    print(f"DURABLE_CLEAN gen={gen}")
+    return 0
+
+
+def mode_corrupt(root: str) -> int:
+    step = _build()
+    x = _batch()
+    mgr = CheckpointManager(root, keep_last=2)
+    step(x, x)
+    mgr.save(step, 1, mode="sync")
+    want = _param_hash(step)
+    step(x, x)
+    mgr.save(step, 2, mode="sync")
+    flipped = _bitflip_one_shard(mgr.generation_dir(2))
+    fresh = _build(seed=123)
+    gen = mgr.restore(fresh)
+    assert gen == 1, f"walk should land on gen 1, got {gen}"
+    assert _param_hash(fresh) == want, "fallback restore not bit-exact"
+    deleted = mgr.gc()
+    assert 1 not in deleted, "GC deleted the newest verified generation"
+    assert os.path.isdir(mgr.generation_dir(1)), "survivor gone"
+    print(f"DURABLE_RECOVERED gen_{gen:08d} flipped={flipped}")
+    for kind in sorted(flight.kind_totals()):
+        print(f"FLIGHT {kind}")
+    return 0
+
+
+def _chaos_run(root: str, tag: str) -> str:
+    chaos.reset()
+    chaos.arm("ckpt.async", mode="error", every=1)
+    try:
+        step = _build()
+        x = _batch()
+        mgr = CheckpointManager(os.path.join(root, tag), keep_last=2)
+        for gen in (1, 2, 3):
+            step(x, x)
+            out = mgr.save(step, gen, mode="async")
+            assert out is None, "armed ckpt.async must degrade to sync"
+        assert mgr.latest_verified() == 3
+        return _param_hash(step)
+    finally:
+        chaos.disarm("ckpt.async")
+
+
+def mode_chaos(root: str) -> int:
+    a = _chaos_run(root, "runA")
+    b = _chaos_run(root, "runB")
+    assert a == b, f"chaos trajectory diverged: {a} vs {b}"
+    print(f"CKPT_CHAOS_BITIDENTICAL {a}")
+    return 0
+
+
+def mode_child(root: str) -> int:
+    step = _build()
+    x = _batch()
+    mgr = CheckpointManager(root, keep_last=3)
+    step(x, x)
+    mgr.save(step, 1, mode="sync")
+    step(x, x)
+    # stall the SECOND shard write of the async generation-2 save: at
+    # least one shard lands, metadata/COMMIT never do — the torn state
+    # the walk must skip
+    chaos.arm("ckpt.save", mode="latency", latency=600.0, nth=2)
+    mgr.save(step, 2, mode="async")
+    print("CHILD_SAVING", flush=True)
+    time.sleep(600)
+    return 0
+
+
+def mode_sigkill_parent(root: str) -> int:
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "child", root],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = child.stdout.readline()
+            if "CHILD_SAVING" in line:
+                break
+        else:
+            raise AssertionError("child never reached CHILD_SAVING")
+        time.sleep(0.5)              # let the stalled writer settle
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    mgr = CheckpointManager(root)
+    gen2 = mgr.generation_dir(2)
+    assert os.path.isdir(mgr.generation_dir(1)), "gen 1 missing"
+    assert not ck.is_committed(gen2), "torn gen 2 must not be committed"
+    latest = mgr.latest_verified()
+    assert latest == 1, f"walk must name gen 1, got {latest}"
+    fresh = _build(seed=123)
+    gen = mgr.restore(fresh)
+    assert gen == 1
+    print(f"DURABLE_SIGKILL_RECOVERED gen_{gen:08d}")
+    return 0
+
+
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "clean"
+    root = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"durable_ckpt_{mode}_{os.getpid()}")
+    return {"clean": mode_clean, "corrupt": mode_corrupt,
+            "chaos": mode_chaos, "child": mode_child,
+            "sigkill-parent": mode_sigkill_parent}[mode](root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

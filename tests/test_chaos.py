@@ -358,6 +358,90 @@ print("UNEXPECTED-SURVIVAL", flush=True)
         r2 = TrainEpochRange(10, "job", step2, checkpoint_dir=ck)
         assert r2.restored_epoch == 0
 
+    @pytest.mark.slow
+    def test_sigkill_generation_walk(self, tmp_path):
+        """Multi-generation escalation of the SIGKILL test: at EACH of
+        three generations a child process commits generation N, starts
+        an async save of generation N+1, and is SIGKILLed mid-shard.
+        After every kill the generation walk must land on N by name —
+        the newest verified commit — and GC must never delete it, even
+        with keep_last=1 and the torn N+1 directory sitting newer."""
+        from paddle_tpu.distributed import checkpoint as dckpt
+        from paddle_tpu.distributed.durable import CheckpointManager
+        root = str(tmp_path / "gens")
+        code_tmpl = """
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer
+from paddle_tpu.distributed.durable import CheckpointManager
+from paddle_tpu.jit import TrainStep
+
+class M(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(6, 12)
+        self.fc2 = nn.Linear(12, 3)
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+def loss_fn(m, x, y):
+    return paddle.nn.functional.cross_entropy(m(x), y).mean()
+
+paddle.seed(0)
+m = M()
+opt = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                         parameters=m.parameters())
+step = TrainStep(m, loss_fn, opt, donate=False)
+rng = np.random.default_rng(0)
+x = paddle.to_tensor(rng.standard_normal((8, 6)).astype("float32"))
+y = paddle.to_tensor(rng.integers(0, 3, size=(8,)).astype("int64"))
+step(x, y)                 # optimizer slots exist before the first save
+mgr = CheckpointManager({root!r}, keep_last=1)
+resumed = mgr.restore(step)
+assert resumed == ({gen} - 1 if {gen} > 1 else None), resumed
+step(x, y)
+mgr.save(step, {gen}, mode="sync")
+print("COMMITTED", flush=True)
+step(x, y)
+# stall the 2nd shard write of the NEXT (async) generation; the
+# parent SIGKILLs us inside the stall — a torn, uncommitted dir
+from paddle_tpu.framework import chaos
+chaos.arm("ckpt.save", mode="latency", latency=600.0, nth=2)
+print("SAVING", flush=True)
+h = mgr.save(step, {gen} + 1, mode="async")
+if h is not None:
+    h.wait()
+print("UNEXPECTED-SURVIVAL", flush=True)
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for gen in (1, 2, 3):
+            code = code_tmpl.format(root=root, gen=gen)
+            p = subprocess.Popen([sys.executable, "-c", code],
+                                 stdout=subprocess.PIPE, text=True,
+                                 env=env, cwd=repo)
+            try:
+                assert p.stdout.readline().strip() == "COMMITTED"
+                assert p.stdout.readline().strip() == "SAVING"
+                time.sleep(1.5)      # inside the stalled shard write
+                p.send_signal(signal.SIGKILL)
+                p.wait(timeout=30)
+            finally:
+                if p.poll() is None:
+                    p.kill()
+            mgr = CheckpointManager(root, keep_last=1)
+            # the torn N+1 never committed; the walk names N
+            assert not dckpt.is_committed(mgr.generation_dir(gen + 1))
+            assert mgr.latest_verified() == gen
+            # retention can never reap the only restorable state
+            deleted = mgr.gc()
+            assert gen not in deleted
+            assert os.path.isdir(mgr.generation_dir(gen))
+        # after three kill rounds a cold process still restores gen 3
+        step2 = _mk_step(seed=7)
+        assert CheckpointManager(root).restore(step2) == 3
+
 
 # ---------------------------------------------------------------------------
 # download retry

@@ -335,6 +335,53 @@ JAX_PLATFORMS=cpu python tools/perf_report.py compare \
     --ledger "$AUTO/tune.jsonl"
 rm -rf "$AUTO"
 
+echo "== durability lane (verified generations; SIGKILL-mid-async-save; bit-flip recovery; offline fsck) =="
+# the durable-state plane end-to-end: (1) clean leg — three generations
+# (sync + async + async) save, commit-after-verify, and restore
+# bit-exact.  (2) corruption leg — a bit-flipped shard in the newest
+# committed generation makes the walk land on the older verified one BY
+# NAME, firing the named ckpt.corrupt flight event, with GC keeping the
+# survivor; the offline fsck must then name the corrupt file and exit 1.
+# (3) SIGKILL leg — a child killed mid-ASYNC-save leaves a torn,
+# uncommitted generation the walk skips; recovery lands on the newest
+# verified generation by name.  (4) chaos leg — ckpt.async armed ERROR
+# under the fixed seed degrades every async save to a counted sync save
+# and the trajectory is bit-identical to its replay.
+DURA=$(mktemp -d /tmp/pt_durable.XXXXXX)
+JAX_PLATFORMS=cpu python tests/fixtures/durable_ckpt.py clean \
+    "$DURA/clean" | tee "$DURA/clean.txt"
+grep -q "DURABLE_CLEAN gen=3" "$DURA/clean.txt" || {
+  echo "durability lane FAILED: clean leg did not restore gen 3" >&2
+  exit 1; }
+JAX_PLATFORMS=cpu python tests/fixtures/durable_ckpt.py corrupt \
+    "$DURA/corrupt" | tee "$DURA/corrupt.txt"
+if ! grep -q "DURABLE_RECOVERED gen_00000001" "$DURA/corrupt.txt" \
+    || ! grep -q "FLIGHT ckpt.corrupt" "$DURA/corrupt.txt"; then
+  echo "durability lane FAILED: bit-flip recovery or ckpt.corrupt event missing" >&2
+  exit 1
+fi
+# offline fsck: must NAME the corrupt shard and exit 1
+rc=0
+JAX_PLATFORMS=cpu python tools/ckpt_check.py verify "$DURA/corrupt" \
+    | tee "$DURA/fsck.txt" || rc=$?
+if [ "$rc" != 1 ] || ! grep -q "crc_mismatch" "$DURA/fsck.txt" \
+    || ! grep -q "CORRUPT  gen_00000002" "$DURA/fsck.txt"; then
+  echo "durability lane FAILED: fsck did not name the corrupt file (rc=$rc)" >&2
+  exit 1
+fi
+JAX_PLATFORMS=cpu python tests/fixtures/durable_ckpt.py sigkill-parent \
+    "$DURA/sigkill" | tee "$DURA/sigkill.txt"
+grep -q "DURABLE_SIGKILL_RECOVERED gen_00000001" "$DURA/sigkill.txt" || {
+  echo "durability lane FAILED: SIGKILL-mid-async-save recovery" >&2
+  exit 1; }
+JAX_PLATFORMS=cpu FLAGS_chaos_seed=1234 \
+    python tests/fixtures/durable_ckpt.py chaos "$DURA/chaos" \
+    | tee "$DURA/chaos.txt"
+grep -q "CKPT_CHAOS_BITIDENTICAL" "$DURA/chaos.txt" || {
+  echo "durability lane FAILED: armed-chaos trajectory not bit-identical" >&2
+  exit 1; }
+rm -rf "$DURA"
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and
@@ -345,7 +392,7 @@ echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
     --zoo ps_transport --zoo ingest --zoo health --zoo zero_step \
-    --zoo numerics_step --zoo runlog --zoo collector \
+    --zoo numerics_step --zoo runlog --zoo collector --zoo ckpt \
     --format=json --min-severity warning
 
 echo "== API signature freeze =="

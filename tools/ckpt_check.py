@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Offline checkpoint fsck — verify / list / gc over the durable-state
+layout, no training session required.
+
+Works on either shape the repo writes:
+
+* a SINGLE checkpoint directory (``metadata.json`` + shards, optional
+  ``COMMIT``) — e.g. one TrainEpochRange slot;
+* a GENERATION ROOT of ``gen_<NNNNNNNN>`` directories
+  (``distributed/durable.py`` CheckpointManager layout).
+
+Subcommands::
+
+    # re-read every shard against its crc32 stamp; exit 1 on corruption,
+    # naming each bad file
+    python tools/ckpt_check.py verify <dir> [--shallow] [--json]
+
+    # one line per generation/slot: committed? verified? step, bytes
+    python tools/ckpt_check.py list <root> [--json]
+
+    # apply the retention policy offline (FLAGS_ckpt_keep_last /
+    # _keep_every, or --keep-last/--keep-every); --dry-run prints only
+    python tools/ckpt_check.py gc <root> [--keep-last K] [--keep-every N]
+        [--dry-run] [--json]
+
+Exit status: 0 clean, 1 corruption found (verify: any problem; list: no
+verifiable checkpoint), 2 usage/IO errors.  ``--json`` emits one
+machine-readable report on stdout — the ci.sh durability lane greps it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.distributed import checkpoint  # noqa: E402
+from paddle_tpu.distributed.durable import (  # noqa: E402
+    CheckpointManager, generation_dirs)
+
+
+def _is_single_checkpoint(path: str) -> bool:
+    return os.path.exists(os.path.join(path, "metadata.json"))
+
+
+def _targets(path: str):
+    """(label, dirpath) pairs: the dir itself, or its generations."""
+    if _is_single_checkpoint(path):
+        return [(os.path.basename(path.rstrip(os.sep)) or path, path)]
+    gens = generation_dirs(path)
+    if gens:
+        return [(f"gen_{g:08d}", d) for g, d in gens]
+    # two-slot TrainEpochRange root: verify whatever slots exist
+    return [(n, os.path.join(path, n)) for n in ("slot0", "slot1")
+            if os.path.isdir(os.path.join(path, n))]
+
+
+def _dir_bytes(dirpath: str) -> int:
+    total = 0
+    try:
+        for name in os.listdir(dirpath):
+            try:
+                total += os.path.getsize(os.path.join(dirpath, name))
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return total
+
+
+def _describe(label: str, dirpath: str, deep: bool) -> dict:
+    problems = checkpoint.verify_checkpoint(dirpath, deep=deep)
+    meta_step = None
+    try:
+        meta_step = checkpoint.checkpoint_meta(dirpath).get("step")
+    except (OSError, ValueError):
+        pass
+    return {"name": label, "dir": dirpath, "step": meta_step,
+            "committed": checkpoint.is_committed(dirpath),
+            "verified": not problems, "problems": problems,
+            "bytes": _dir_bytes(dirpath)}
+
+
+def cmd_verify(args) -> int:
+    targets = _targets(args.path)
+    if not targets:
+        print(f"ckpt_check: no checkpoint found under {args.path}",
+              file=sys.stderr)
+        return 2
+    report = [_describe(label, d, deep=not args.shallow)
+              for label, d in targets]
+    corrupt = [r for r in report if r["problems"]]
+    if args.json:
+        print(json.dumps({"cmd": "verify", "path": args.path,
+                          "checkpoints": report,
+                          "corrupt": len(corrupt)}, indent=2))
+    else:
+        for r in report:
+            verdict = "OK" if r["verified"] else "CORRUPT"
+            commit = "committed" if r["committed"] else "uncommitted"
+            print(f"{verdict:8s} {r['name']}  step={r['step']}  "
+                  f"{commit}  {r['bytes']} bytes")
+            for p in r["problems"]:
+                print(f"         {p['file']}: {p['reason']}")
+    return 1 if corrupt else 0
+
+
+def cmd_list(args) -> int:
+    targets = _targets(args.path)
+    report = [_describe(label, d, deep=False) for label, d in targets]
+    newest = None
+    for r in reversed(report):
+        if r["committed"] and r["verified"]:
+            newest = r["name"]
+            break
+    if args.json:
+        print(json.dumps({"cmd": "list", "path": args.path,
+                          "checkpoints": report,
+                          "newest_verified": newest}, indent=2))
+    else:
+        for r in report:
+            mark = "*" if r["name"] == newest else " "
+            print(f"{mark} {r['name']}  step={r['step']}  "
+                  f"committed={r['committed']}  verified={r['verified']}  "
+                  f"{r['bytes']} bytes")
+        print(f"newest verified: {newest}")
+    return 0 if newest is not None else 1
+
+
+def cmd_gc(args) -> int:
+    mgr = CheckpointManager(args.path, keep_last=args.keep_last,
+                            keep_every=args.keep_every)
+    before = mgr.generations()
+    if args.dry_run:
+        newest = mgr.latest_verified(deep=True)
+        keep = set(before[-mgr.keep_last:])
+        if newest is not None:
+            keep.add(newest)
+        if mgr.keep_every > 0:
+            keep.update(g for g in before if g % mgr.keep_every == 0)
+        deleted = [] if newest is None else \
+            [g for g in before if g not in keep and g < newest]
+    else:
+        deleted = mgr.gc()
+    out = {"cmd": "gc", "path": args.path, "generations": before,
+           "deleted": deleted, "dry_run": bool(args.dry_run),
+           "kept": [g for g in before if g not in deleted]}
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"generations: {before}")
+        print(f"{'would delete' if args.dry_run else 'deleted'}: {deleted}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tools/ckpt_check.py", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    v = sub.add_parser("verify", help="re-read shards against crc stamps")
+    v.add_argument("path")
+    v.add_argument("--shallow", action="store_true",
+                   help="existence+size only (skip the crc re-read)")
+    v.add_argument("--json", action="store_true")
+
+    li = sub.add_parser("list", help="enumerate generations/slots")
+    li.add_argument("path")
+    li.add_argument("--json", action="store_true")
+
+    g = sub.add_parser("gc", help="apply the retention policy offline")
+    g.add_argument("path")
+    g.add_argument("--keep-last", type=int, default=None)
+    g.add_argument("--keep-every", type=int, default=None)
+    g.add_argument("--dry-run", action="store_true")
+    g.add_argument("--json", action="store_true")
+
+    args = p.parse_args(argv)
+    try:
+        return {"verify": cmd_verify, "list": cmd_list,
+                "gc": cmd_gc}[args.cmd](args)
+    except OSError as e:
+        print(f"ckpt_check: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

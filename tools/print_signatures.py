@@ -53,6 +53,8 @@ MODULES = [
     "paddle_tpu.distribution",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.elastic",
+    "paddle_tpu.distributed.checkpoint",
+    "paddle_tpu.distributed.durable",
     "paddle_tpu.distributed.wire",
     "paddle_tpu.distributed.ps",
     "paddle_tpu.distributed.ps.service",

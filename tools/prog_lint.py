@@ -100,6 +100,11 @@ ZOO = {
     # mode and the launcher's endpoint plumbing) — Report, like
     # elastic_step
     "collector": lambda: _zoo_collector(),
+    # lints the durable-state plane (ckpt.save / ckpt.async /
+    # ckpt.verify fault-point hygiene across the checkpoint writer,
+    # the generation manager, the two-slot epoch protocol, and the
+    # crash-safe fs tier) — Report, like elastic_step
+    "ckpt": lambda: _zoo_ckpt(),
 }
 
 
@@ -343,6 +348,32 @@ def _zoo_collector():
                 os.path.join("paddle_tpu", "framework",
                              "observability.py"),
                 os.path.join("paddle_tpu", "distributed", "launch.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_ckpt():
+    """AST-lint the durable-state plane — ``distributed/checkpoint.py``
+    (which threads the ``ckpt.save`` / ``ckpt.async`` / ``ckpt.verify``
+    chaos fault points through the shard writer, the async dispatch,
+    and the integrity verifier), the generation manager
+    (``distributed/durable.py``), the two-slot epoch protocol
+    (``framework/auto_checkpoint.py``), and the crash-safe fs tier
+    (``fleet/utils/fs.py``) — so PTA301/302 validate every new
+    fault-point site against the registry and its recovery-ownership
+    pragma."""
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for rel in (os.path.join("paddle_tpu", "distributed", "checkpoint.py"),
+                os.path.join("paddle_tpu", "distributed", "durable.py"),
+                os.path.join("paddle_tpu", "framework",
+                             "auto_checkpoint.py"),
+                os.path.join("paddle_tpu", "distributed", "fleet",
+                             "utils", "fs.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:
