@@ -324,8 +324,10 @@ def bench_gpt2_zero(on_accel):
     replicas on CPU, real chips when >= 2 are attached): tokens/s plus
     the measured optimizer-state bytes ONE replica holds vs the
     replicated-baseline bytes (vs_baseline on that metric is the
-    sharded/replicated ratio — lower is better, ~0.5 at dp=2), and the
-    bf16 collective wire bytes vs the f32 leg (~0.5)."""
+    sharded/replicated ratio — lower is better, ~0.5 at dp=2), the
+    bf16 collective wire bytes vs the f32 leg (~0.5), and a fused
+    chunked-ring leg (int4 wire) whose MEASURED per-step collective
+    bytes ratio fused/unfused lands well under the bf16 leg's."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -376,6 +378,30 @@ def bench_gpt2_zero(on_accel):
     f32_total = f32["reduce_scatter"] + f32["all_gather"]
     _emit("gpt2_zero_bf16_collective_bytes_per_step", bf16_total,
           "bytes", bf16_total / max(f32_total, 1))
+
+    # fused chunked-ring leg (parallel/ring.py, int4 wire): same model
+    # and step shape, the collectives ride the quantize-while-permute
+    # ring schedule.  Bytes are MEASURED off the step's own per-step
+    # stat (not shape math), and vs_baseline is fused/unfused — the
+    # ring's wire against the bf16 leg this bench just measured
+    from paddle_tpu.framework import monitor
+    unfused_bytes = float(monitor.get_stat(
+        "zero_collective_bytes_per_step") or bf16_total)
+    model_r = GPT(cfg)
+    opt_r = optimizer.AdamW(learning_rate=1e-4,
+                            parameters=model_r.parameters())
+    ring_step = ShardedUpdateTrainStep(model_r, gpt_loss, opt_r,
+                                       mesh=mesh, wire_dtype="int4",
+                                       ring=True, amp_level="O2",
+                                       amp_dtype="bfloat16")
+    dt, _ = _timeit(lambda: ring_step(ids, ids), 2, iters)
+    tps_r = B * S * iters / dt
+    _emit("gpt2_zero_ring_int4_tokens_per_sec", tps_r, "tokens/s",
+          tps_r / max(tps, 1e-9))
+    ring_bytes = float(monitor.get_stat(
+        "zero_collective_bytes_per_step") or 0.0)
+    _emit("gpt2_zero_ring_int4_collective_bytes_per_step", ring_bytes,
+          "bytes", ring_bytes / max(unfused_bytes, 1))
 
 
 def bench_widedeep(on_accel):

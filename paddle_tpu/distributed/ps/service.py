@@ -427,7 +427,8 @@ class PsServer:
             return True
         try:
             t = self.tables[header["table"]]
-            grads = dequantize_rows(grad_bufs, header.get("wire", "f32"))
+            grads = dequantize_rows(grad_bufs, header.get("wire", "f32"),
+                                    cols=int(header.get("cols", 0) or 0))
             t.push(ids.astype(np.int64), grads, lr=header.get("lr"))
         except Exception:
             self._unreserve_push(header)   # failed apply frees the stamp
@@ -499,9 +500,17 @@ class PsServer:
             # reply-driven negotiation: encode in the dtype the request
             # asked for and DECLARE it in the reply header; a client
             # talking to an old server sees no "wire" key and decodes
-            # f32 — no separate handshake needed on the pull side
+            # f32 — no separate handshake needed on the pull side.
+            # (int4 requests only arrive hello-gated: an old server's
+            # normalize_wire would error this path, so the client pins
+            # f32 unless the handshake listed int4.)  Packed int4
+            # replies declare the logical row width — the packed buffer
+            # alone cannot distinguish an odd dim from its pad nibble
             wire = normalize_wire(header.get("wire", "f32"))
-            return {"ok": True, "wire": wire}, quantize_rows(rows, wire)
+            hdr = {"ok": True, "wire": wire}
+            if wire == "int4":
+                hdr["cols"] = int(rows.shape[-1])
+            return hdr, quantize_rows(rows, wire)
         if op == "push":
             dup = self._apply_push(header, bufs[0], bufs[1:])
             self._note_table(header["table"], pushes=1,
@@ -526,8 +535,10 @@ class PsServer:
                 rows_pushed=int(np.asarray(bufs[0]).size) if n_push
                 else 0)
             wire = normalize_wire(header.get("wire", "f32"))
-            return {"ok": True, "wire": wire,
-                    "dup": dup}, quantize_rows(rows, wire)
+            hdr = {"ok": True, "wire": wire, "dup": dup}
+            if wire == "int4":
+                hdr["cols"] = int(rows.shape[-1])
+            return hdr, quantize_rows(rows, wire)
         if op == "graph":
             # GNN tier: delegate to GraphTable.dispatch (graph_brpc_server
             # sample_neighbors / node_feat / degree ops)
@@ -723,11 +734,12 @@ class PsClient:
 
     Wire dtype: pull replies and push gradient rows travel in
     ``wire_dtype`` (FLAGS_ps_wire_dtype; 'bf16' default, 'int8' adds a
-    per-row scale, 'f32' is the exact-parity fallback).  Pulls are
-    reply-driven (the server declares the encoding it used), pushes
-    quantize only after a ``hello`` handshake confirmed the server
-    understands the dtype — so an old f32-only peer on either side
-    degrades the link to f32 instead of corrupting it."""
+    per-row scale, 'int4' packs two nibbles per byte + per-row scale,
+    'f32' is the exact-parity fallback).  bf16/int8 pulls are
+    reply-driven (the server declares the encoding it used); int4
+    pulls and all quantized pushes engage only after a ``hello``
+    handshake confirmed the server lists the dtype — so an old peer on
+    either side degrades the link to f32 instead of corrupting it."""
 
     def __init__(self, endpoints: Sequence[str],
                  worker_id: Optional[str] = None,
@@ -888,8 +900,22 @@ class PsClient:
             self._push_wires[s] = w
         return w
 
+    def _pull_wire(self, s: int) -> str:
+        """Wire dtype to ASK server ``s`` to encode pull replies in.
+        bf16/int8 stay reply-driven (any server that predates them
+        simply ignores unknown reply preferences at f32... they are in
+        the frozen-era set, every server decodes them).  int4 — the
+        first dtype added AFTER the pull protocol shipped — must ride
+        the ``hello`` handshake instead: an old server's pull path
+        *raises* on a dtype it doesn't know, so the client pins f32
+        unless the server's advertised ``wire_dtypes`` lists int4."""
+        if self.wire_dtype != "int4":
+            return self.wire_dtype
+        return self._push_wire(s)
+
     def _decode_pull(self, table: str, reply: dict, rbufs) -> np.ndarray:
-        rows = dequantize_rows(rbufs, reply.get("wire", "f32"))
+        rows = dequantize_rows(rbufs, reply.get("wire", "f32"),
+                               cols=int(reply.get("cols", 0) or 0))
         self._dims[table] = rows.shape[-1]
         return rows
 
@@ -918,7 +944,7 @@ class PsClient:
             with self.tracer.activate(tctx):
                 reply, rows = self._rpc(
                     s, {"op": "pull", "table": table,
-                        "wire": self.wire_dtype}, [flat[mask]])
+                        "wire": self._pull_wire(s)}, [flat[mask]])
             return s, mask, self._decode_pull(table, reply, rows)
 
         first_dim = None
@@ -957,9 +983,12 @@ class PsClient:
             if mask.any():
                 with self.tracer.activate(tctx):
                     wire = self._push_wire(s)
-                    self._rpc(s, {"op": "push", "table": table, "lr": lr,
-                                  "wire": wire, "worker": self._push_ident,
-                                  "seq": seq},
+                    hdr = {"op": "push", "table": table, "lr": lr,
+                           "wire": wire, "worker": self._push_ident,
+                           "seq": seq}
+                    if wire == "int4":   # packed rows: declare width
+                        hdr["cols"] = int(g.shape[-1])
+                    self._rpc(s, hdr,
                               [flat[mask]] + quantize_rows(g[mask], wire),
                               links=links)
 
@@ -998,19 +1027,25 @@ class PsClient:
             with self.tracer.activate(tctx):
                 if not pmask.any():            # push-only shard
                     wire = self._push_wire(s)
-                    self._rpc(s, {"op": "push", "table": table, "lr": lr,
-                                  "wire": wire, "worker": self._push_ident,
-                                  "seq": seq},
+                    hdr = {"op": "push", "table": table, "lr": lr,
+                           "wire": wire, "worker": self._push_ident,
+                           "seq": seq}
+                    if wire == "int4":
+                        hdr["cols"] = int(g.shape[-1])
+                    self._rpc(s, hdr,
                               [gids[gmask]] + quantize_rows(g[gmask], wire),
                               links=links)
                     return s, pmask, None
                 wire = self._push_wire(s)
                 payload = quantize_rows(g[gmask], wire) if gmask.any() \
                     else []
+                hdr = {"op": "push_pull", "table": table, "lr": lr,
+                       "wire": wire, "worker": self._push_ident,
+                       "seq": seq, "n_push_bufs": len(payload)}
+                if wire == "int4":
+                    hdr["cols"] = int(g.shape[-1])
                 reply, rows = self._rpc(
-                    s, {"op": "push_pull", "table": table, "lr": lr,
-                        "wire": wire, "worker": self._push_ident,
-                        "seq": seq, "n_push_bufs": len(payload)},
+                    s, hdr,
                     [gids[gmask]] + payload + [pflat[pmask]],
                     links=links)
                 return s, pmask, self._decode_pull(table, reply, rows)

@@ -24,7 +24,16 @@ first-class fallback everywhere, pinned by parity tests.
 ``COLLECTIVE_WIRE_DTYPES`` additionally admits ``f16`` — the
 fp16_allreduce compress dtype of ``CompressedAllReduceTrainStep`` —
 which the PS wire protocol does NOT negotiate (``WIRE_DTYPES`` is the
-frozen PS set; old peers would mis-decode an f16 reply).
+PS-negotiated set; old peers would mis-decode an f16 reply).
+
+PR 19 adds a packed **int4** codec to both sets: two nibbles per byte
+(low nibble first), symmetric per-row scale ``max|row| / 7``, values
+clipped to [-7, 7] so the packed bytes round-trip through the same
+sign-extension on every peer.  ``WIRE_DTYPES`` may only ever GROW —
+the PS ``hello`` handshake advertises the server's list, so a client
+asking for a dtype an old peer does not list pins f32 (the same
+degradation contract bf16/int8 shipped with).  Odd row widths pack a
+zero pad nibble; decoders that know the logical width pass ``cols=``.
 """
 from __future__ import annotations
 
@@ -36,17 +45,19 @@ __all__ = ["WIRE_DTYPES", "COLLECTIVE_WIRE_DTYPES", "normalize_wire",
            "quantize_rows", "dequantize_rows", "quantize_rows_traced",
            "dequantize_rows_traced", "wire_nbytes"]
 
-#: the PS-transport negotiated set (frozen: peers handshake over it)
-WIRE_DTYPES = ("f32", "bf16", "int8")
+#: the PS-transport negotiated set (grow-only: the hello handshake
+#: advertises it, so peers that predate an entry pin f32)
+WIRE_DTYPES = ("f32", "bf16", "int8", "int4")
 
 #: the in-XLA collective set — adds f16 (fp16-compressed allreduce),
 #: which never crosses the PS TCP wire
-COLLECTIVE_WIRE_DTYPES = ("f32", "bf16", "f16", "int8")
+COLLECTIVE_WIRE_DTYPES = ("f32", "bf16", "f16", "int8", "int4")
 
 _WIRE_ALIASES = {"f32": "f32", "float32": "f32", "fp32": "f32",
                  "bf16": "bf16", "bfloat16": "bf16",
                  "f16": "f16", "float16": "f16", "fp16": "f16",
-                 "int8": "int8", "s8": "int8"}
+                 "int8": "int8", "s8": "int8",
+                 "int4": "int4", "s4": "int4", "i4": "int4"}
 
 
 def normalize_wire(name, known=WIRE_DTYPES) -> str:
@@ -64,6 +75,41 @@ def normalize_wire(name, known=WIRE_DTYPES) -> str:
 
 
 # ---------------------------------------------------------------------------
+# nibble packing — shared by the numpy and traced int4 paths
+# ---------------------------------------------------------------------------
+
+def _pack_nibbles(q, xp):
+    """Pack int4 values (int8 carrier, [-7, 7]) two-per-byte along the
+    trailing axis: low nibble first, odd widths padded with a zero
+    nibble.  ``xp`` is numpy or jax.numpy (identical semantics)."""
+    d = q.shape[-1]
+    if d % 2:
+        pad = [(0, 0)] * (q.ndim - 1) + [(0, 1)]
+        q = xp.pad(q, pad)
+    # two's-complement low nibble via the uint8 carrier: -7 -> 0x9
+    u = (q.astype(xp.uint8) & 0xF)
+    return u[..., 0::2] | (u[..., 1::2] << 4)
+
+
+def _unpack_nibbles(packed, cols, xp):
+    """Inverse of :func:`_pack_nibbles`: sign-extend both nibbles of
+    each byte and trim to the logical trailing width ``cols``."""
+    lo = (packed & 0xF).astype(xp.int8)
+    hi = (packed >> 4).astype(xp.int8)
+    # sign-extend a 4-bit two's-complement value held in 8 bits
+    lo = (lo ^ 8) - 8
+    hi = (hi ^ 8) - 8
+    q = xp.stack([lo, hi], axis=-1).reshape(packed.shape[:-1]
+                                            + (2 * packed.shape[-1],))
+    return q[..., :cols]
+
+
+def _row_scale_np(r: np.ndarray, qmax: float) -> np.ndarray:
+    scale = np.max(np.abs(r), axis=-1) / np.float32(qmax)
+    return np.where(scale > 0, scale, np.float32(1.0)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
 # numpy pair — the PS TCP wire (moved verbatim from ps/device_table.py)
 # ---------------------------------------------------------------------------
 
@@ -71,9 +117,11 @@ def quantize_rows(rows: np.ndarray, wire: str):
     """Encode f32 rows ``(N, D)`` for the wire.  Returns the buffer list
     to ship: ``[rows]`` for f32/bf16, ``[q_int8, scale_f32]`` for int8
     (symmetric per-row scale ``max|row| / 127``; all-zero rows get scale
-    1 so they decode to exact zeros).  Validates against the FROZEN PS
-    set — a peer naming a dtype outside it (e.g. f16) must fail loudly,
-    exactly as in PR 4."""
+    1 so they decode to exact zeros), ``[packed_uint8, scale_f32]`` for
+    int4 (scale ``max|row| / 7``, two nibbles per byte — decoders with
+    an odd ``D`` must pass ``cols=D`` to :func:`dequantize_rows`).
+    Validates against the PS-negotiated set — a peer naming a dtype
+    outside it must fail loudly, exactly as in PR 4."""
     r = np.asarray(rows, np.float32)
     wire = normalize_wire(wire)
     if wire == "f32":
@@ -81,16 +129,25 @@ def quantize_rows(rows: np.ndarray, wire: str):
     if wire == "bf16":
         import ml_dtypes
         return [r.astype(ml_dtypes.bfloat16)]
-    scale = np.max(np.abs(r), axis=-1) / np.float32(127.0)
-    scale = np.where(scale > 0, scale, np.float32(1.0)).astype(np.float32)
+    if wire == "int4":
+        scale = _row_scale_np(r, 7.0)
+        q = np.clip(np.rint(r / scale[..., None]), -7, 7).astype(np.int8)
+        return [_pack_nibbles(q, np), scale]
+    scale = _row_scale_np(r, 127.0)
     q = np.clip(np.rint(r / scale[..., None]), -127, 127).astype(np.int8)
     return [q, scale]
 
 
-def dequantize_rows(bufs, wire: str) -> np.ndarray:
-    """Decode :func:`quantize_rows` buffers back to f32 rows (PS wire
-    dtypes only — see :func:`quantize_rows`)."""
+def dequantize_rows(bufs, wire: str, cols: int = 0) -> np.ndarray:
+    """Decode :func:`quantize_rows` buffers back to f32 rows.  ``cols``
+    recovers the logical trailing width of an int4 payload (0 means
+    twice the packed width, i.e. the even-``D`` case)."""
     wire = normalize_wire(wire)
+    if wire == "int4":
+        packed, scale = np.asarray(bufs[0], np.uint8), bufs[1]
+        q = _unpack_nibbles(packed, cols or 2 * packed.shape[-1], np)
+        return q.astype(np.float32) * np.asarray(scale,
+                                                 np.float32)[..., None]
     if wire == "int8":
         q, scale = bufs[0], bufs[1]
         return q.astype(np.float32) * np.asarray(scale,
@@ -106,8 +163,9 @@ def quantize_rows_traced(rows, wire: str):
     """jnp twin of :func:`quantize_rows`: encode ``(..., D)`` rows for a
     collective's wire.  Returns the buffer tuple the collective ships —
     ``(rows,)`` for f32 (identity: the exact fallback), the cast array
-    for bf16/f16, ``(q_int8, scale_f32)`` for int8 with the same
-    symmetric per-row scale as the numpy pair (``jnp.round`` is
+    for bf16/f16, ``(q_int8, scale_f32)`` for int8 and
+    ``(packed_uint8, scale_f32)`` for int4 with the same symmetric
+    per-row scale as the numpy pair (``jnp.round`` is
     round-half-to-even, matching ``np.rint``)."""
     import jax.numpy as jnp
     wire = normalize_wire(wire, known=COLLECTIVE_WIRE_DTYPES)
@@ -118,18 +176,27 @@ def quantize_rows_traced(rows, wire: str):
         return (r.astype(jnp.bfloat16),)
     if wire == "f16":
         return (r.astype(jnp.float16),)
-    scale = jnp.max(jnp.abs(r), axis=-1) / jnp.float32(127.0)
+    qmax = jnp.float32(7.0 if wire == "int4" else 127.0)
+    scale = jnp.max(jnp.abs(r), axis=-1) / qmax
     scale = jnp.where(scale > 0, scale,
                       jnp.float32(1.0)).astype(jnp.float32)
-    q = jnp.clip(jnp.round(r / scale[..., None]), -127, 127).astype(
+    q = jnp.clip(jnp.round(r / scale[..., None]), -qmax, qmax).astype(
         jnp.int8)
+    if wire == "int4":
+        return (_pack_nibbles(q, jnp), scale)
     return (q, scale)
 
 
-def dequantize_rows_traced(bufs, wire: str):
-    """Decode :func:`quantize_rows_traced` buffers back to f32 rows."""
+def dequantize_rows_traced(bufs, wire: str, cols: int = 0):
+    """Decode :func:`quantize_rows_traced` buffers back to f32 rows.
+    ``cols`` recovers the logical trailing width of an int4 payload (0
+    means twice the packed width)."""
     import jax.numpy as jnp
     wire = normalize_wire(wire, known=COLLECTIVE_WIRE_DTYPES)
+    if wire == "int4":
+        packed, scale = bufs[0], bufs[1]
+        q = _unpack_nibbles(packed, cols or 2 * packed.shape[-1], jnp)
+        return q.astype(jnp.float32) * scale[..., None]
     if wire == "int8":
         q, scale = bufs[0], bufs[1]
         return q.astype(jnp.float32) * scale[..., None]
@@ -140,17 +207,24 @@ def dequantize_rows_traced(bufs, wire: str):
 # byte accounting — deterministic, so a CI gate can hold the line
 # ---------------------------------------------------------------------------
 
-_ELEM_BYTES = {"f32": 4.0, "bf16": 2.0, "f16": 2.0, "int8": 1.0}
+_ELEM_BYTES = {"f32": 4.0, "bf16": 2.0, "f16": 2.0, "int8": 1.0,
+               "int4": 0.5}
 
 
 def wire_nbytes(n_elems: int, wire: str, row: int = 0) -> int:
-    """Bytes on the wire for ``n_elems`` encoded values.  For int8,
-    ``row`` is the per-scale chunk length (one f32 scale per ``row``
-    elements — :func:`quantize_rows` emits one scale per trailing-axis
-    row); 0 means a single row."""
+    """Bytes on the wire for ``n_elems`` encoded values.  For int8 and
+    int4, ``row`` is the per-scale chunk length (one f32 scale per
+    ``row`` elements — :func:`quantize_rows` emits one scale per
+    trailing-axis row); 0 means a single row.  int4 rows round up to
+    whole bytes (odd widths carry a pad nibble)."""
     wire = normalize_wire(wire, known=COLLECTIVE_WIRE_DTYPES)
-    payload = _ELEM_BYTES[wire] * n_elems
-    if wire == "int8":
+    if wire == "int4":
         rows = math.ceil(n_elems / row) if row else 1
-        payload += 4.0 * rows
+        per_row = row if row else n_elems
+        payload = rows * (math.ceil(per_row / 2) + 4.0)
+    else:
+        payload = _ELEM_BYTES[wire] * n_elems
+        if wire == "int8":
+            rows = math.ceil(n_elems / row) if row else 1
+            payload += 4.0 * rows
     return int(payload)

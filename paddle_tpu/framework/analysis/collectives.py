@@ -27,7 +27,13 @@ Shipped passes (stable IDs, see diagnostics.RULES):
 PTA501    unreduced value on a mapped axis: a shard_map output whose
           ``out_names`` claim replication over an axis the value still
           varies on — the grad-leaf-reaches-the-optimizer-without-a-
-          psum bug; replicas silently diverge (error)
+          psum bug; replicas silently diverge (error).  A *complete
+          ring* scan is recognized as a gather: a scan whose body
+          ``ppermute``s over axis A with a single full cycle of size
+          ``n = |A|`` and runs ``n`` or ``n-1`` trips has shown every
+          replica every chunk, so scan outputs with leading dim ``n``
+          (the assembled buffer) stop varying over A
+          (``parallel/ring.py``'s ring_all_gather)
 PTA502    collective axis mismatch: an axis name absent from the
           enclosing manual region (error), or a ``psum`` of an
           already-replicated value that is not a ``pmean`` — the
@@ -39,9 +45,14 @@ PTA503    replicated/sharded mixing: ``all_gather`` whose only
 PTA504    quantized payload summed by a collective: int8 rows fed to
           ``psum``/``psum_scatter`` (error — the sum of encodings is
           not the encoding of the sum) or bf16/f16 payloads (warning —
-          the wire accumulates in reduced precision); the legal idiom
-          is ``wire.py`` quantize → ``all_to_all``/``all_gather`` →
-          dequantize → local sum
+          the wire accumulates in reduced precision); the legal idioms
+          are ``wire.py`` quantize → ``all_to_all``/``all_gather`` →
+          dequantize → local sum, and the fused ring
+          (``parallel/ring.py``): quantize inside a ``ppermute`` scan
+          carry with an **f32 accumulator**.  The pass also flags the
+          fused ring gone wrong — an ``add`` consuming a ``ppermute``
+          result that is still int8/uint8 encoded (error) or bf16/f16
+          (warning) sums encoded payloads one hop at a time
 PTA505    donated buffer crossing a collective boundary: a donated
           input consumed *directly* by a collective with no
           shape/dtype-matching output to alias — XLA cannot reuse the
@@ -149,7 +160,8 @@ class _Ctx:
     """Per-analysis state threaded through the walk."""
 
     __slots__ = ("report", "name", "manual", "sizes", "donated",
-                 "out_labels", "out_avals", "seen_manual", "flagged_505")
+                 "out_labels", "out_avals", "seen_manual", "flagged_505",
+                 "ppermute_outs", "flagged_ring_sum")
 
     def __init__(self, report: Report, name: str):
         self.report = report
@@ -162,6 +174,9 @@ class _Ctx:
         self.out_avals: List[tuple] = []    # program output (shape, dtype)
         self.seen_manual = False
         self.flagged_505: set = set()       # one finding per donated var
+        # ppermute result vars -> dtype (the fused-ring PTA504 check)
+        self.ppermute_outs: Dict[object, object] = {}
+        self.flagged_ring_sum: set = set()  # one finding per add eqn
 
 
 def _vary(env, v) -> frozenset:
@@ -333,6 +348,85 @@ def _check_collective(eqn, jaxpr, env, ctx: _Ctx, pred_vary: frozenset):
                      "survives"))
 
 
+def _check_ring_sum(eqn, ctx: _Ctx):
+    """PTA504, fused-ring flavor: an ``add`` consuming a ``ppermute``
+    result that is still wire-encoded.  The legal hop body decodes the
+    received chunk to f32 first (``parallel/ring.py``); adding raw
+    encodings accumulates garbage (int8) or half-precision error
+    (bf16/f16) on every hop."""
+    import jax
+    if id(eqn) in ctx.flagged_ring_sum:
+        return                        # scan fixpoint re-walks the body
+    for v in eqn.invars:
+        if isinstance(v, jax.core.Literal) or v not in ctx.ppermute_outs:
+            continue
+        dt = ctx.ppermute_outs[v]
+        if dt in (np.dtype(np.int8), np.dtype(np.uint8)):
+            ctx.flagged_ring_sum.add(id(eqn))
+            ctx.report.add(Diagnostic(
+                "PTA504",
+                f"{ctx.name}: fused ring sums encoded payloads — "
+                f"`add` consumes a {dt} `ppermute` result directly, "
+                "so each hop accumulates quantized encodings instead "
+                "of values (garbage after one hop)",
+                Severity.ERROR,
+                hint="dequantize the received chunk to f32, add the "
+                     "local block at full precision, and re-encode "
+                     "for the next hop (parallel/ring.py hop body)"))
+            return
+        if dt is not None and dt.name in ("bfloat16", "float16"):
+            ctx.flagged_ring_sum.add(id(eqn))
+            ctx.report.add(Diagnostic(
+                "PTA504",
+                f"{ctx.name}: fused ring accumulates in {dt} — `add` "
+                "consumes a ppermute result without widening, so the "
+                "partial sum loses bits on every hop",
+                Severity.WARNING,
+                hint="accumulate the ring carry in f32 and cast back "
+                     "to the wire dtype only for the next ppermute"))
+            return
+
+
+def _is_full_cycle(perm, n: int) -> bool:
+    """True iff ``perm`` is a permutation of ``range(n)`` forming one
+    cycle that visits every member — the neighbor rotation every ring
+    hop reuses."""
+    try:
+        step = {int(s): int(d) for s, d in (perm or ())}
+    except (TypeError, ValueError):
+        return False
+    if len(step) != n or set(step) != set(range(n)) \
+            or set(step.values()) != set(range(n)):
+        return False
+    cur = 0
+    for hops in range(1, n + 1):
+        cur = step[cur]
+        if cur == 0:
+            return hops == n
+    return False
+
+
+def _scan_ring_axes(eqn, body, ctx: _Ctx) -> frozenset:
+    """Axes over which this scan is a *complete ring*: the body
+    ``ppermute``s over axis A with a single full cycle of size
+    ``n = |A|`` and the scan runs ``n`` or ``n-1`` trips — by the last
+    trip every replica has seen every replica's chunk, so an assembled
+    buffer (leading dim ``n``) no longer varies over A."""
+    length = eqn.params.get("length")
+    if length is None or not hasattr(body, "eqns"):
+        return _EMPTY
+    out = set()
+    for beqn in body.eqns:
+        if beqn.primitive.name != "ppermute":
+            continue
+        for a in _collective_axes(beqn):
+            n = int(ctx.sizes.get(a, 0) or 0)
+            if n >= 2 and int(length) in (n, n - 1) \
+                    and _is_full_cycle(beqn.params.get("perm"), n):
+                out.add(a)
+    return frozenset(out)
+
+
 def _call_body(eqn):
     for k in _CALL_KEYS:
         v = eqn.params.get(k)
@@ -382,7 +476,12 @@ def _walk(jaxpr, env, ctx: _Ctx, pred_vary: frozenset):
                 out = union
             for o in eqn.outvars:
                 env[o] = out
+                if pname == "ppermute":
+                    ctx.ppermute_outs[o] = _np_dtype(
+                        getattr(o, "aval", None))
             continue
+        if pname in ("add", "add_any"):
+            _check_ring_sum(eqn, ctx)
         if pname == "axis_index":
             ax = eqn.params.get("axis_name")
             axset = frozenset(a for a in (
@@ -556,6 +655,19 @@ def _walk_scan(eqn, env, ctx: _Ctx, pred_vary: frozenset):
             j = i
             env[o] = _vary(env, body.outvars[j]) \
                 if j < len(body.outvars) else _EMPTY
+    ring_axes = _scan_ring_axes(eqn, body, ctx)
+    if ring_axes:
+        # complete-ring gather: outputs holding one slot per replica
+        # (leading dim == axis size) have been filled from every seat
+        for o in eqn.outvars:
+            shape = tuple(getattr(getattr(o, "aval", None),
+                                  "shape", ()) or ())
+            if not shape:
+                continue
+            done = frozenset(a for a in ring_axes
+                             if int(ctx.sizes.get(a, 0)) == shape[0])
+            if done:
+                env[o] = _vary(env, o) - done
 
 
 def run_collective_passes(closed_jaxpr, name: str, report: Report,

@@ -438,7 +438,7 @@ def _pass_cost(jaxpr, name, top_k, report: Report):
     by_op: dict = {}
     state = {"manual": False}
 
-    def note(pname, f, b):
+    def note(pname, f, b, n=1):
         nonlocal total_f, total_b
         total_f += f
         total_b += b
@@ -446,9 +446,9 @@ def _pass_cost(jaxpr, name, top_k, report: Report):
         agg = by_op.setdefault(pname, [0, 0, 0])
         agg[0] += f
         agg[1] += b
-        agg[2] += 1
+        agg[2] += n
 
-    def walk(jx, axis_sizes):
+    def walk(jx, axis_sizes, trips=1):
         nonlocal coll_b
         for eqn in jx.eqns:
             pname = eqn.primitive.name
@@ -464,22 +464,28 @@ def _pass_cost(jaxpr, name, top_k, report: Report):
                 except TypeError:
                     sizes = axis_sizes
                 for sub in subs:
-                    walk(sub, sizes)
+                    walk(sub, sizes, trips)
                 continue
             if pname in COLLECTIVE_PRIMS:
-                b = _collective_wire_bytes(eqn, axis_sizes)
+                b = _collective_wire_bytes(eqn, axis_sizes) * trips
                 coll_b += b
-                note(pname, 0, b)
+                note(pname, 0, b, trips)
                 continue
             if subs:
                 # higher-order wrapper (pjit/scan/cond/custom_*): its
                 # cost IS its bodies' — counting the wrapper's global
-                # outputs too is exactly the sharded-program over-count
+                # outputs too is exactly the sharded-program over-count.
+                # A scan body runs `length` times, so its costs (and
+                # the ring collectives inside it) multiply by the trip
+                # count — the fused-ring wire bytes would otherwise
+                # read as one hop
+                t = trips * max(1, int(eqn.params.get("length", 1) or 1)) \
+                    if pname == "scan" else trips
                 for sub in subs:
-                    walk(sub, axis_sizes)
+                    walk(sub, axis_sizes, t)
                 continue
             f, b = eqn_cost(eqn)
-            note(pname, f, b)
+            note(pname, f * trips, b * trips, trips)
 
     walk(jaxpr, {})
     # structured twin of the PTA106 diagnostics: per-primitive

@@ -123,20 +123,31 @@ define_flag("download_backoff_base", 0.1,
 define_flag("ps_wire_dtype", "bf16",
             "wire encoding for PS pull rows / push grads: 'bf16' "
             "(default, half the f32 bytes, ~3 significant digits), "
-            "'int8' (quarter the bytes, per-row scale), or 'f32' "
-            "(exact-parity fallback).  Negotiated per peer: pulls "
-            "decode whatever the reply header declares, pushes "
-            "quantize only after a hello handshake confirms the "
-            "server understands the dtype — old/new peers always "
-            "interoperate at f32")
+            "'int8' (quarter the bytes, per-row scale), 'int4' "
+            "(eighth the bytes, two nibbles per byte + per-row "
+            "scale), or 'f32' (exact-parity fallback).  Negotiated "
+            "per peer: bf16/int8 pulls decode whatever the reply "
+            "header declares, int4 pulls and all quantized pushes "
+            "engage only after a hello handshake confirms the server "
+            "lists the dtype — old/new peers always interoperate at "
+            "f32")
 define_flag("zero_wire_dtype", "bf16",
             "wire encoding for the ZeRO sharded-update collectives "
             "(parallel/zero.py ShardedUpdateTrainStep reduce-scatter / "
             "all-gather legs): 'bf16' (default, half the f32 bytes), "
-            "'int8' (quarter the bytes + one f32 scale per chunk), or "
-            "'f32' (exact fallback — trajectory-parity with the "
-            "replicated TrainStep, pinned by tests).  Per-step "
-            "override via ShardedUpdateTrainStep(wire_dtype=...)")
+            "'int8' (quarter the bytes + one f32 scale per chunk), "
+            "'int4' (eighth the bytes, packed nibbles + per-chunk "
+            "scale), or 'f32' (exact fallback — trajectory-parity "
+            "with the replicated TrainStep, pinned by tests).  "
+            "Per-step override via ShardedUpdateTrainStep(wire_dtype=...)")
+define_flag("zero_ring_collectives", False,
+            "route the dp collective legs through the fused "
+            "quantized ring (parallel/ring.py): quant/dequant "
+            "overlapped with the neighbor ppermute, per-chunk scales "
+            "on the wire.  Applies to ShardedUpdateTrainStep and "
+            "CompressedAllReduceTrainStep; the f32 wire stays on the "
+            "native XLA collectives (exact leg, bitwise-stable).  "
+            "Per-step override via ring=True/False")
 define_flag("ps_prefetch_depth", 1,
             "max in-flight prefetched pulls in PSTrainStep's pipeline "
             "(PSTrainStep.prefetch): 0 disables the pipeline, 1 is the "

@@ -181,7 +181,8 @@ def _summary_from_snapshot(snap: dict) -> dict:
     for name in ("input_stall_pct", "jit_compiles_total",
                  "jit_recompiles_steady_total", "health_anomalies_total",
                  "numerics_nonfinite_steps_total", "train_steps_total",
-                 "train_nan_skips_total"):
+                 "train_nan_skips_total",
+                 "zero_collective_bytes_per_step"):
         if name in stats:
             out[name] = float(stats[name])
     return out

@@ -246,25 +246,41 @@ class CompressedAllReduceTrainStep:
     ``compress_dtype``: ``float16`` (default, matching the reference's
     fp16_allreduce), ``bfloat16`` (recommended on TPU) or ``float32``
     (exact passthrough — the parity-pinned fallback).  ``int8`` is NOT
-    accepted here: summing int8 payloads inside a pmean would overflow;
-    the chunk-exchange int8 collective lives in
+    accepted on the pmean path: summing int8 payloads inside a pmean
+    would overflow; the chunk-exchange int8 collective lives in
     :class:`paddle_tpu.parallel.zero.ShardedUpdateTrainStep`.
+
+    ``FLAGS_zero_ring_collectives`` (or ``ring=True``) replaces the
+    pmean with the fused quantized ring (``parallel/ring.py``):
+    reduce-scatter + all-gather with per-hop decode/accumulate-in-f32,
+    which LIFTS the int8 restriction — the ring never sums encoded
+    payloads, so ``int8`` and the packed ``int4`` codec become legal
+    compress dtypes here (per-``chunk`` f32 scales on the wire).
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  mesh: Optional[Mesh] = None, compress_dtype="float16",
-                 amp_level=None, amp_dtype="bfloat16", recompute=False):
-        from paddle_tpu.distributed.wire import normalize_wire
+                 amp_level=None, amp_dtype="bfloat16", recompute=False,
+                 ring: Optional[bool] = None, chunk: int = 256):
+        from paddle_tpu.distributed.wire import (COLLECTIVE_WIRE_DTYPES,
+                                                 normalize_wire)
+        from paddle_tpu.framework.flags import flag
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh or get_mesh()
         _require_pure_dp(self.mesh, "compressed-allreduce")
-        self.wire = normalize_wire(compress_dtype,
-                                   known=("f32", "f16", "bf16"))
+        self.ring = bool(flag("zero_ring_collectives")
+                         if ring is None else ring)
+        self.chunk = int(chunk)
+        known = COLLECTIVE_WIRE_DTYPES if self.ring \
+            else ("f32", "f16", "bf16")
+        self.wire = normalize_wire(compress_dtype, known=known)
         self.compress_dtype = {"f32": jnp.dtype(jnp.float32),
                                "f16": jnp.dtype(jnp.float16),
-                               "bf16": jnp.dtype(jnp.bfloat16)}[self.wire]
+                               "bf16": jnp.dtype(jnp.bfloat16),
+                               "int8": jnp.dtype(jnp.int8),
+                               "int4": jnp.dtype(jnp.uint8)}[self.wire]
         self.amp_level = amp_level
         self.amp_dtype = jnp.bfloat16 if str(amp_dtype) in (
             "bfloat16", "bf16") else jnp.float16
@@ -275,15 +291,34 @@ class CompressedAllReduceTrainStep:
     def _build(self, n_inputs):
         from paddle_tpu.distributed.wire import (dequantize_rows_traced,
                                                  quantize_rows_traced)
+        from paddle_tpu.parallel.ring import (ring_all_gather,
+                                              ring_reduce_scatter)
         mesh = self.mesh
         opt = self.optimizer
         wire = self.wire
+        use_ring, chunk = self.ring, self.chunk
+        dp = self.mesh.shape.get("dp", 1)
         loss_from = _loss_closure(self.model, self.loss_fn, self.amp_level,
                                   self.amp_dtype, self.recompute)
+
+        def ring_reduce_one(g, p):
+            # fused ring allreduce = reduce-scatter + all-gather on the
+            # padded flat leaf; decode-before-sum is what makes int8 /
+            # int4 legal here (the pmean path must reject them)
+            flat = g.reshape(-1).astype(jnp.float32)
+            pad = -flat.shape[0] % (dp * chunk)
+            flat = jnp.pad(flat, (0, pad))
+            shard = ring_reduce_scatter(flat, "dp", axis_size=dp,
+                                        chunk=chunk, wire=wire) / dp
+            full = ring_all_gather(shard, "dp", axis_size=dp,
+                                   chunk=chunk, wire=wire)
+            return full[:g.size].reshape(g.shape).astype(p.dtype)
 
         def reduce_one(g, p):
             if not jnp.issubdtype(g.dtype, jnp.floating):
                 return g
+            if use_ring:
+                return ring_reduce_one(g, p)
             bufs = quantize_rows_traced(g, wire)
             # XLA:CPU's AllReducePromotion pass crashes on sub-f32
             # all-reduce (see parallel/pipeline._psum) — promote the

@@ -29,7 +29,17 @@ transport ships.  ``wire_dtype``:
   the f32 bytes; the reduce-scatter becomes quantize → ``all_to_all`` →
   dequantize → local sum (a collective cannot sum encoded payloads);
 - ``"int8"`` — quarter the bytes + one f32 scale per ``chunk`` elements
-  (symmetric per-chunk scale, same discipline as the PS int8 wire).
+  (symmetric per-chunk scale, same discipline as the PS int8 wire);
+- ``"int4"`` — eighth the bytes: two nibbles per byte + one f32 scale
+  per chunk (PR 19's packed codec, shared with the PS wire).
+
+``FLAGS_zero_ring_collectives`` (or ``ring=True``) swaps both legs for
+the fused ring in ``parallel/ring.py``: quantize/dequantize overlapped
+with the neighbor ``ppermute`` instead of a bandwidth-serial codec
+prologue around ``all_to_all``/``all_gather``.  Analytic wire bytes
+are identical (``(dp-1)`` encoded chunks per leg per replica); the f32
+wire keeps the native XLA collectives, so the exact leg stays
+bitwise-identical with the ring flag on or off.
 
 Observability: a ``zero.step`` tracer span wraps the dispatch with
 ``zero.reduce_scatter`` / ``zero.update`` / ``zero.all_gather`` child
@@ -127,7 +137,8 @@ class ShardedUpdateTrainStep:
                  mesh: Optional[Mesh] = None, wire_dtype: Optional[str] = None,
                  chunk: int = 256, amp_level=None, amp_dtype="bfloat16",
                  recompute: bool = False, donate: bool = True,
-                 collective_retries: int = 2):
+                 collective_retries: int = 2,
+                 ring: Optional[bool] = None):
         from paddle_tpu.framework.flags import flag
         from paddle_tpu.optimizer import LarsMomentum
         if isinstance(optimizer, LarsMomentum):
@@ -150,6 +161,10 @@ class ShardedUpdateTrainStep:
             wire_dtype = flag("zero_wire_dtype")
         self.wire = normalize_wire(wire_dtype,
                                    known=COLLECTIVE_WIRE_DTYPES)
+        # fused ring legs (parallel/ring.py): quant/dequant overlapped
+        # with the neighbor ppermute; f32 stays on the native ops
+        self.ring = bool(flag("zero_ring_collectives")
+                         if ring is None else ring)
         if int(chunk) < 1:
             raise ValueError("chunk must be >= 1")
         self.chunk = int(chunk)
@@ -261,7 +276,10 @@ class ShardedUpdateTrainStep:
 
     # -- compiled step ------------------------------------------------------
     def _build_mapped(self, n_inputs, numerics_aux: bool = False):
+        from paddle_tpu.parallel.ring import (ring_all_gather,
+                                              ring_reduce_scatter)
         mesh, dp, chunk, wire = self.mesh, self.dp, self.chunk, self.wire
+        use_ring = self.ring
         specs = self._specs
         opt = self.optimizer
         names = list(specs)
@@ -271,6 +289,11 @@ class ShardedUpdateTrainStep:
 
         def reduce_scatter(gflat):
             """(padded,) local grad -> (shard_len,) owned mean chunk."""
+            if use_ring:
+                # fused ring (parallel/ring.py): encode/accumulate per
+                # hop; f32 dispatches to the same psum_scatter below
+                return ring_reduce_scatter(gflat, "dp", axis_size=dp,
+                                           chunk=chunk, wire=wire) / dp
             if wire == "f32":
                 return jax.lax.psum_scatter(
                     gflat, "dp", scatter_dimension=0, tiled=True) / dp
@@ -285,6 +308,9 @@ class ShardedUpdateTrainStep:
             quantized leg dequantizes EVERY chunk — including the
             locally owned one — so all replicas hold bit-identical
             parameters."""
+            if use_ring:
+                return ring_all_gather(shard, "dp", axis_size=dp,
+                                       chunk=chunk, wire=wire)
             if wire == "f32":
                 return jax.lax.all_gather(shard, "dp", tiled=True)
             rows = shard.reshape(-1, chunk)
@@ -473,7 +499,8 @@ class ShardedUpdateTrainStep:
         with tracer.start_span(
                 "zero.step",
                 attrs={"step": int(self.optimizer._global_step),
-                       "wire": self.wire, "dp": self.dp}):
+                       "wire": self.wire, "dp": self.dp,
+                       "ring": self.ring}):
             self._collective_guard()
             with manual_region():    # model-internal constrain() no-ops
                 out = fn(params, self._opt_shards, buffers, key, lr,
@@ -488,20 +515,34 @@ class ShardedUpdateTrainStep:
             else:
                 new_params, self._opt_shards, new_buffers, loss = out
             # leg marker spans: exact byte accounting for the fused
-            # step's collectives (device timing is not separable)
+            # step's collectives.  Per-leg device timing is not
+            # separable on the host, so under an armed tracer the two
+            # wire legs fence the async dispatch instead — the
+            # reduce-scatter span waits out the sharded opt state
+            # (grad RS + update), the all-gather span the re-assembled
+            # params — and carry an explicit `category` so the wait
+            # claims blame as `collective` time.  Untraced steps keep
+            # the async dispatch (zero-duration markers, no fence).
+            traced = tracer.enabled
             with tracer.start_span("zero.reduce_scatter",
-                                   attrs={"wire": self.wire,
+                                   attrs={"category": "collective",
+                                          "wire": self.wire,
+                                          "ring": self.ring,
                                           "bytes": bytes_[
                                               "reduce_scatter"]}):
-                pass
+                if traced:
+                    jax.block_until_ready(self._opt_shards)
             with tracer.start_span("zero.update",
                                    attrs={"opt_state_bytes_per_replica":
                                           opt_bytes}):
                 pass
             with tracer.start_span("zero.all_gather",
-                                   attrs={"wire": self.wire,
+                                   attrs={"category": "collective",
+                                          "wire": self.wire,
+                                          "ring": self.ring,
                                           "bytes": bytes_["all_gather"]}):
-                pass
+                if traced:
+                    jax.block_until_ready(new_params)
         for n, p in named_params.items():
             p._data = new_params[n]
         for n, b in named_buffers.items():

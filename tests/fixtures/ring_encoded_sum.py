@@ -1,0 +1,81 @@
+"""Seeded fused-ring misuse fixture — the PTA504 ring-flavor
+acceptance artifact.
+
+A deliberately broken fused ring all-reduce under ``shard_map`` over
+``dp``: each hop ``ppermute``s the **int8-encoded** carry one neighbor
+over and then ADDS the received encoding to the local encoding without
+dequantizing first.  The sum of quantized encodings is not the encoding
+of the sum — the partial saturates/wraps after one hop — so the pass
+must flag the ``add``-consumes-a-``ppermute``-result idiom by name:
+
+* ``python tools/prog_lint.py --collectives
+  tests/fixtures/ring_encoded_sum.py`` flags PTA504 ("fused ring sums
+  encoded payloads") and exits nonzero.
+
+The CORRECT hop body (``parallel/ring.py``) decodes the received
+partial to f32, adds the local block at full precision, and re-encodes
+for the next ``ppermute`` — that program traces clean (the
+``ring_collectives`` zoo entry pins it).  Deliberately a finding: do
+NOT "fix" the missing dequantize and do NOT pragma it.
+"""
+import os
+import sys
+
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax                                        # noqa: E402
+import jax.numpy as jnp                           # noqa: E402
+
+DP = 4
+CHUNK = 8
+
+
+def _mesh():
+    from paddle_tpu.parallel.mesh import make_mesh
+    return make_mesh({"dp": DP}, devices=jax.devices()[:DP])
+
+
+def _mapped_ring(mesh):
+    """The UNJITTED shard-mapped broken ring: a complete neighbor
+    cycle whose scan carry stays ENCODED across the add (the bug)."""
+    from paddle_tpu.parallel.mesh import shard_map_compat
+    perm = [(i, (i + 1) % DP) for i in range(DP)]
+
+    def local(gflat):
+        scale = jnp.maximum(jnp.max(jnp.abs(gflat)), 1e-8) / 127.0
+        q = jnp.clip(jnp.round(gflat / scale), -127, 127).astype(jnp.int8)
+
+        def hop(carry, _):
+            recv = jax.lax.ppermute(carry, "dp", perm)
+            return recv + q, None        # BUG: sums encoded payloads
+        acc, _ = jax.lax.scan(hop, q, None, length=DP - 1)
+        return acc.astype(jnp.float32) * scale / DP
+
+    return shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(jax.sharding.PartitionSpec(),),
+        out_specs=jax.sharding.PartitionSpec())
+
+
+def collectives_report():
+    """The static half: trace the broken ring and run the PTA5xx
+    passes (prog_lint --collectives imports this hook)."""
+    from paddle_tpu.framework.analysis import analyze_collectives
+    closed = jax.make_jaxpr(_mapped_ring(_mesh()))(
+        jax.ShapeDtypeStruct((DP * CHUNK,), jnp.float32))
+    return analyze_collectives(closed, name="fixture.ring_encoded_sum")
+
+
+if __name__ == "__main__":
+    rep = collectives_report()
+    for d in rep.diagnostics:
+        print(d.rule, d.severity.name, d.message)
+    sys.exit(1 if rep.errors else 0)

@@ -680,3 +680,173 @@ class TestFixtureAcceptance:
         assert doc["version"] == 1
         f = [x for x in doc["findings"] if x["rule"] == "PTA501"]
         assert f and f[0]["frontend"] == "collective"
+
+
+# ---------------------------------------------------------------------------
+# fused quantized ring (parallel/ring.py): recognition + misuse flavor
+# ---------------------------------------------------------------------------
+
+RING_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                            "ring_encoded_sum.py")
+
+
+class TestRingAnalysis:
+    def _mesh4(self):
+        return make_mesh({"dp": 4}, devices=jax.devices()[:4])
+
+    def test_ring_all_gather_recognized_as_gather(self):
+        """PTA501: a complete-cycle ppermute scan assembling every
+        seat's chunk IS a gather — the quantized ring AG's replicated
+        claim must trace clean."""
+        from paddle_tpu.parallel.ring import ring_all_gather
+        mesh = self._mesh4()
+
+        def good(x):
+            return ring_all_gather(x, "dp", axis_size=4, chunk=8,
+                                   wire="int8")
+
+        r = _trace(good, mesh, (P("dp"),), P(), f32(32))
+        assert "PTA501" not in rules_of(r)
+        assert r.errors == [], r.to_text()
+
+    def test_incomplete_cycle_still_flags_pta501(self):
+        """The recognition is specific: a shift-by-2 perm on dp=4 is
+        two disjoint 2-cycles, NOT a ring — a replicated claim over it
+        keeps the PTA501 error."""
+        mesh = self._mesh4()
+        perm = [(i, (i + 2) % 4) for i in range(4)]
+
+        def bad(x):
+            def hop(c, _):
+                return jax.lax.ppermute(c, "dp", perm) + 0.0, None
+            acc, _ = jax.lax.scan(hop, x, None, length=3)
+            return acc
+
+        r = _trace(bad, mesh, (P("dp"),), P(), f32(8))
+        d = [d for d in r.diagnostics if d.rule == "PTA501"]
+        assert d and d[0].severity == Severity.ERROR
+
+    def test_ring_reduce_scatter_hop_accepted(self):
+        """PTA504 accepts the decode-add-reencode hop body: the ring
+        RS over a quantized wire traces with zero findings."""
+        from paddle_tpu.parallel.ring import ring_reduce_scatter
+        mesh = self._mesh4()
+
+        def good(x):
+            return ring_reduce_scatter(x, "dp", axis_size=4, chunk=8,
+                                       wire="int4")
+
+        r = _trace(good, mesh, (P("dp"),), P("dp"), f32(128))
+        assert r.errors == [] and r.warnings == [], r.to_text()
+
+    def test_encoded_sum_flagged_once(self):
+        """The fused-ring misuse: adding a ppermute-received int8
+        carry without decoding.  Exactly ONE error — the scan fixpoint
+        re-walks the body, and the finding must not duplicate."""
+        mesh = self._mesh4()
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+
+        def bad(x):
+            q = jnp.clip(jnp.round(x), -127, 127).astype(jnp.int8)
+
+            def hop(c, _):
+                return jax.lax.ppermute(c, "dp", perm) + q, None
+            acc, _ = jax.lax.scan(hop, q, None, length=3)
+            return acc.astype(jnp.float32)
+
+        r = _trace(bad, mesh, (P("dp"),), P("dp"), f32(8))
+        d = [d for d in r.diagnostics if d.rule == "PTA504"]
+        assert len(d) == 1, r.to_text()
+        assert d[0].severity == Severity.ERROR
+        assert "encoded payloads" in d[0].message
+
+    def test_low_precision_carry_warns(self):
+        """bf16 ring accumulation is the WARNING flavor (representable
+        but drifts), mirroring the psum dtype ladder."""
+        mesh = self._mesh4()
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+
+        def warm(x):
+            c0 = x.astype(jnp.bfloat16)
+
+            def hop(c, _):
+                return jax.lax.ppermute(c, "dp", perm) + c0, None
+            acc, _ = jax.lax.scan(hop, c0, None, length=3)
+            return acc.astype(jnp.float32)
+
+        r = _trace(warm, mesh, (P("dp"),), P("dp"), f32(8))
+        d = [d for d in r.diagnostics if d.rule == "PTA504"]
+        assert d and d[0].severity == Severity.WARNING
+
+    def test_scan_ring_wire_bytes_multiply_by_trips(self):
+        """PTA106: a ppermute inside a length-L scan moves its payload
+        L times — the cost pass multiplies, so the fused ring's wire
+        bytes are comparable with the unfused collectives'."""
+        from paddle_tpu.framework.analysis import analyze_jaxpr
+        mesh = _mesh()
+        perm = [(0, 1), (1, 0)]
+
+        def ring(x):
+            def hop(c, _):
+                return jax.lax.ppermute(c, "dp", perm), None
+            acc, _ = jax.lax.scan(hop, x, None, length=3)
+            return acc
+
+        mapped = shard_map_compat(ring, mesh=mesh, in_specs=(P("dp"),),
+                                  out_specs=P("dp"))
+        closed = jax.make_jaxpr(mapped)(f32(8))
+        r = analyze_jaxpr(closed)
+        by = {row["op"]: row for row in r.cost["by_op"]}
+        # local payload (4,) f32 = 16 B, one full payload per hop, x3
+        assert by["ppermute"]["bytes"] == 3 * 16
+
+    def test_zoo_entries_clean(self):
+        from tools.prog_lint import COLLECTIVES_ZOO, PALLAS_ZOO
+        r = COLLECTIVES_ZOO["ring_collectives"]()
+        assert r.errors == [] and r.warnings == [], r.to_text()
+        r = PALLAS_ZOO["ring_quant"]()
+        assert r.errors == [] and r.warnings == [], r.to_text()
+
+    def test_ring_zero_step_clean(self):
+        """The in-tree regression extended to the fused path: the
+        ring-enabled sharded update traces clean on quantized wires."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu import optimizer
+        from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                 parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            return ((m(x) - y) ** 2).mean()
+
+        step = ShardedUpdateTrainStep(model, loss_fn, opt,
+                                      mesh=_mesh(), wire_dtype="int4",
+                                      chunk=8, ring=True)
+        r = step.analyze(f32(8, 8), f32(8, 4), with_cost=False)
+        assert r.errors == [] and r.warnings == [], r.to_text()
+
+
+class TestRingFixtureAcceptance:
+    def _load(self):
+        spec = importlib.util.spec_from_file_location(
+            "ring_encoded_sum_fixture", RING_FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_static_flags_pta504_ring_flavor_by_name(self):
+        r = self._load().collectives_report()
+        d = [d for d in r.diagnostics if d.rule == "PTA504"]
+        assert len(d) == 1, r.to_text()
+        assert d[0].severity == Severity.ERROR
+        assert "fixture.ring_encoded_sum" in d[0].message
+        assert "encoded payloads" in d[0].message
+
+    def test_cli_flags_ring_fixture(self):
+        from tools import prog_lint
+        rc = prog_lint.main(["--collectives", RING_FIXTURE,
+                             "--format=json"])
+        assert rc == 1

@@ -407,6 +407,46 @@ JAX_PLATFORMS=cpu python tools/op_bench.py --zero-collectives \
     --compare tools/op_bench_baseline.json \
     --thresholds tools/op_bench_thresholds.json
 
+echo "== fused ring collectives lane (wire-byte gate -> ledger improvement -> collective blame) =="
+# (1) analytic per-leg wire MB of the chunked ring at dp=2 per wire
+# dtype, gated vs baseline AND vs the f32 leg (bf16 <= 0.51x,
+# int8 <= 0.26x, int4 <= 0.14x — in-function ceiling; wall clock
+# reported, not gated).  (2) two clean f32 ZeRO mini-trains plus one
+# int4-ring run appended to a fresh ledger: the cross-run compare MUST
+# print the zero_collective_bytes_per_step series as a named
+# IMPROVEMENT (bytes fell ~8x) with zero regressions — the observatory
+# seeing the ring pay off.  (3) blame --check over the ring run's
+# trace: the per-step DAG reconstructs (categories sum to the step
+# span) and the fused path's fenced wait lands in the `collective`
+# category — the same ms that ledgers as blame_collective_ms
+RING=$(mktemp -d /tmp/pt_ring.XXXXXX)
+JAX_PLATFORMS=cpu python tools/op_bench.py --ring-collectives \
+    --compare tools/op_bench_baseline.json \
+    --thresholds tools/op_bench_thresholds.json
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 12 --zero \
+    --ledger "$RING/ledger.jsonl" --max-anomalies 0
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 12 --zero \
+    --ledger "$RING/ledger.jsonl" --max-anomalies 0
+JAX_PLATFORMS=cpu python tools/health_check.py --mini-train 12 --zero \
+    --zero-wire int4 --zero-ring --trace-dir "$RING/trace" \
+    --ledger "$RING/ledger.jsonl" --max-anomalies 0
+rc=0
+JAX_PLATFORMS=cpu python tools/perf_report.py compare \
+    --ledger "$RING/ledger.jsonl" | tee "$RING/verdict.txt" || rc=$?
+if [ "$rc" != 0 ] || \
+   ! grep -q "^improvement .*zero_collective_bytes_per_step" "$RING/verdict.txt"; then
+  echo "ring lane FAILED: int4 ring run not flagged as a wire-byte improvement (rc=$rc)" >&2
+  exit 1
+fi
+JAX_PLATFORMS=cpu python tools/perf_report.py blame \
+    --trace-dir "$RING/trace" --step-span zero.step --check \
+    | tee "$RING/blame.txt"
+if ! grep -q "zero.reduce_scatter \[child -> collective\]" "$RING/blame.txt"; then
+  echo "ring lane FAILED: fused reduce-scatter wait not blamed as collective" >&2
+  exit 1
+fi
+rm -rf "$RING"
+
 echo "== replica-parity probe overhead gate (armed <= 2% step, disarmed exactly zero) =="
 # armed: the probe's amortized cost at the default cadence must stay
 # under 2% of the mlp1m step (in-function gate) and its analytic hash

@@ -64,9 +64,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# the --zero leg shards over a dp=2 mesh of CPU virtual devices; the
+# flag only takes effect if it lands before jax's backend initializes
+# (set here, at import, because the paddle import chain pulls jax in
+# during argument validation — a no-op for non-CPU backends and for
+# embedders that already initialized jax)
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
 __all__ = ["load_metrics", "build_report", "evaluate_gates",
            "parse_max_blame", "format_report", "mini_train",
-           "mini_train_ps", "main"]
+           "mini_train_ps", "mini_train_zero", "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -134,11 +145,13 @@ def _tail_spans(spans: list, n: int, step_span: str = "train.step"):
 
 def build_report(snap: dict, trace_dir: Optional[str] = None,
                  health_snapshot: Optional[dict] = None,
-                 blame_tail: Optional[int] = None) -> dict:
+                 blame_tail: Optional[int] = None,
+                 step_span: str = "train.step") -> dict:
     """Fold a metrics snapshot (+ optional trace dir and live health
     state) into the report dict the gates and renderers consume.
     ``blame_tail=N`` computes blame over only the last N steps' spans
-    (see :func:`_tail_spans`)."""
+    (see :func:`_tail_spans`); ``step_span`` names the per-step span
+    blame anchors on (``zero.step`` for the ZeRO leg)."""
     stats = snap.get("stats", {})
     hists = snap.get("histograms", {})
 
@@ -228,8 +241,9 @@ def build_report(snap: dict, trace_dir: Optional[str] = None,
         from paddle_tpu.framework import blame
         spans = blame.load_trace_dir(trace_dir)
         if blame_tail:
-            spans = _tail_spans(spans, int(blame_tail))
-        res = blame.compute_blame(spans)
+            spans = _tail_spans(spans, int(blame_tail),
+                                step_span=step_span)
+        res = blame.compute_blame(spans, step_span=step_span)
         if res["n_steps"]:
             # the FULL result (edges trimmed): evaluate_gates reads
             # shares/per_step_ms, and main() hands the same dict to
@@ -712,6 +726,68 @@ def mini_train_ps(n_steps: int, trace_dir: str,
     return monitor.snapshot(), None, ctl
 
 
+def mini_train_zero(n_steps: int, trace_dir: str, wire: str = "f32",
+                    ring: bool = False):
+    """ZeRO-sharded mini-train leg: the same decision surface as
+    :func:`mini_train`, but the step is the fused
+    ``ShardedUpdateTrainStep`` on a dp=2 mesh of CPU virtual devices,
+    so the run exercises (and records) the fused reduce-scatter /
+    all-gather pair.  Per-step wire bytes land on the
+    ``zero_collective_bytes_per_step`` stat (whitelisted into the
+    ledger summary — the observatory's wire-byte series), and under
+    the armed tracer the ``zero.reduce_scatter`` / ``zero.all_gather``
+    leg spans fence the dispatch, so the fused collectives' wall time
+    claims blame as ``collective``.  ``wire``/``ring`` select the
+    collective codec and the chunked ring schedule (passed to the step
+    directly — no flag mutation).  Deterministic: fixed seeds, fixed
+    shapes."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer
+    from paddle_tpu.framework import health, monitor
+    from paddle_tpu.framework.observability import tracer
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "--zero needs >= 2 devices for a dp=2 mesh (jax "
+            "initialized before the CPU virtual-device flag could be "
+            "set; export XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8)")
+    for signal, kw in health.DEFAULT_SIGNALS.items():
+        health.watch(signal, **dict(kw))
+    tracer.enable(trace_dir, label="health_check_zero")
+    try:
+        paddle.seed(0)
+        rng = np.random.default_rng(0)
+        mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+        model = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                              nn.Linear(64, 32))
+        opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                 parameters=model.parameters())
+        step = ShardedUpdateTrainStep(
+            model, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt,
+            mesh=mesh, wire_dtype=wire, ring=ring)
+        x = paddle.to_tensor(rng.standard_normal((8, 32))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((8, 32))
+                             .astype(np.float32))
+        losses = [float(step(x, y)) for _ in range(n_steps)]
+        assert all(np.isfinite(losses)), \
+            f"ZeRO mini train diverged: {losses[-5:]}"
+        health.memory.sample(tags={
+            "params": sum(int(p._data.nbytes)
+                          for p in model.parameters())})
+    finally:
+        tracer.disable()
+    return monitor.snapshot(), None, None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="health_check.py", description=__doc__,
@@ -742,6 +818,20 @@ def main(argv=None) -> int:
                          "(in-process PsServer over localhost TCP) so "
                          "real ps.rpc traffic feeds the detectors and "
                          "the run record")
+    ap.add_argument("--zero", action="store_true",
+                    help="mini-train option: run the ZeRO-sharded leg "
+                         "(fused reduce-scatter/all-gather on a dp=2 "
+                         "mesh of CPU virtual devices) so collective "
+                         "wire bytes and collective blame feed the "
+                         "detectors and the run record")
+    ap.add_argument("--zero-wire", default="f32",
+                    choices=("f32", "bf16", "int8", "int4"),
+                    help="--zero option: collective wire codec "
+                         "(default f32)")
+    ap.add_argument("--zero-ring", action="store_true",
+                    help="--zero option: use the fused chunked-ring "
+                         "collectives (parallel/ring.py) instead of "
+                         "the native psum_scatter/all_gather pair")
     ap.add_argument("--nan-storm", type=int, default=None, metavar="T",
                     help="mini-train option (with --nan-step K): widen "
                          "the poison into a T-step storm starting at "
@@ -816,6 +906,15 @@ def main(argv=None) -> int:
     if a.ps and a.numerics:
         ap.error("--ps and --numerics/--nan-step are separate "
                  "mini-train legs — run them as two invocations")
+    if a.zero and a.mini_train is None:
+        ap.error("--zero is a mini-train option")
+    if a.zero and (a.ps or a.numerics):
+        ap.error("--zero, --ps and --numerics/--nan-step are separate "
+                 "mini-train legs — run them as separate invocations")
+    if (a.zero_ring or a.zero_wire != "f32") and not a.zero:
+        ap.error("--zero-wire/--zero-ring are --zero options")
+    if a.autopilot and a.zero:
+        ap.error("--autopilot has no actuators on the --zero leg")
     if a.ledger is not None and a.mini_train is None:
         ap.error("--ledger records a mini train; pass --mini-train")
     if a.autopilot and a.mini_train is None:
@@ -839,6 +938,10 @@ def main(argv=None) -> int:
                 a.mini_train, a.trace_dir, autopilot=a.autopilot,
                 autopilot_ledger=a.ledger,
                 autopilot_dry_run=a.autopilot_dry_run or None)
+        elif a.zero:
+            snap, provenance, ctl = mini_train_zero(
+                a.mini_train, a.trace_dir, wire=a.zero_wire,
+                ring=a.zero_ring)
         else:
             snap, provenance, ctl = mini_train(
                 a.mini_train, a.trace_dir, numerics=a.numerics,
@@ -852,7 +955,9 @@ def main(argv=None) -> int:
 
     report = build_report(snap, trace_dir=a.trace_dir,
                           health_snapshot=health_snapshot,
-                          blame_tail=a.blame_tail)
+                          blame_tail=a.blame_tail,
+                          step_span="zero.step" if a.zero
+                          else "train.step")
     if provenance is not None:
         report["numerics"]["provenance"] = provenance
     if ctl is not None:
@@ -876,6 +981,7 @@ def main(argv=None) -> int:
         # the verdict rides along; RunLedger.append never raises
         from paddle_tpu.framework import runlog
         label = a.run_label or ("ps" if a.ps else
+                                "zero" if a.zero else
                                 "numerics" if a.numerics else "dense")
         rec = runlog.capture("health_check", label=label,
                              trace_dir=a.trace_dir,

@@ -202,6 +202,105 @@ def zero_collectives_bench(repeats=3):
     return results
 
 
+# Fused ring collective suite (--ring-collectives): the same ~1M-param
+# MLP step at dp=2 with the quantized ring engaged (parallel/ring.py),
+# one record per (leg, wire) across every collective wire including the
+# packed int4 codec.  ``wire_mb`` is ANALYTIC and DETERMINISTIC (the
+# ring moves the same (dp-1) encoded chunks per leg per replica as the
+# unfused exchange — ShardedUpdateTrainStep.collective_wire_bytes), so
+# the compare gate holds the line on encoded bytes; ``ms`` is the
+# measured fused-step wall clock and stays informational.  The bench
+# additionally gates the CODEC RATIOS in-function: each quantized
+# wire's per-leg bytes must stay under its analytic ceiling relative to
+# f32 (bf16 0.51x, int8 0.26x, int4 0.14x — the acceptance bars; the
+# real ratios at chunk=256 are 0.500x / 0.2539x / 0.1289x).
+RING_COLLECTIVES_SUITE = [
+    {"name": "ring_rs_mlp1m_f32", "leg": "reduce_scatter", "wire": "f32"},
+    {"name": "ring_rs_mlp1m_bf16", "leg": "reduce_scatter",
+     "wire": "bf16"},
+    {"name": "ring_rs_mlp1m_int8", "leg": "reduce_scatter",
+     "wire": "int8"},
+    {"name": "ring_rs_mlp1m_int4", "leg": "reduce_scatter",
+     "wire": "int4"},
+    {"name": "ring_ag_mlp1m_f32", "leg": "all_gather", "wire": "f32"},
+    {"name": "ring_ag_mlp1m_bf16", "leg": "all_gather", "wire": "bf16"},
+    {"name": "ring_ag_mlp1m_int8", "leg": "all_gather", "wire": "int8"},
+    {"name": "ring_ag_mlp1m_int4", "leg": "all_gather", "wire": "int4"},
+]
+
+# per-leg wire-byte ceiling vs the f32 leg (analytic, chunk=256)
+RING_WIRE_RATIO_MAX = {"bf16": 0.51, "int8": 0.26, "int4": 0.14}
+
+
+def ring_collectives_bench(repeats=3):
+    """One ring-enabled sharded-update step per wire dtype on a dp=2
+    mesh; emits a record per (leg, wire) with the analytic per-replica
+    wire MB (gated vs baseline AND vs the f32 leg's ratio ceiling) and
+    the measured step ms (informational)."""
+    if "jax" not in sys.modules:
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in xf:
+            os.environ["XLA_FLAGS"] = (
+                xf + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.zero import ShardedUpdateTrainStep
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "--ring-collectives needs >= 2 devices for a dp=2 mesh "
+            "(CPU hosts get a virtual mesh automatically unless jax "
+            "was already initialized single-device)")
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+
+    def loss_fn(m, x, y):
+        return ((m(x) - y) ** 2).mean()
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 512)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 512)).astype(np.float32))
+    results = []
+    by_wire = {}
+    for wire in ("f32", "bf16", "int8", "int4"):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(512, 1024), nn.ReLU(),
+                              nn.Linear(1024, 512))
+        opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                 parameters=model.parameters())
+        step = ShardedUpdateTrainStep(model, loss_fn, opt, mesh=mesh,
+                                      wire_dtype=wire, ring=True)
+        step(x, y)                       # warm (compile)
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            loss = step(x, y)
+            np.asarray(loss._data)       # execution fence
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        by_wire[wire] = (best, step.collective_wire_bytes())
+    # in-function ratio gate: the codec must actually shrink the wire
+    for wire, cap in RING_WIRE_RATIO_MAX.items():
+        for leg in ("reduce_scatter", "all_gather"):
+            ratio = by_wire[wire][1][leg] / by_wire["f32"][1][leg]
+            if ratio > cap:
+                raise RuntimeError(
+                    f"ring {wire} {leg} wire bytes are {ratio:.4f}x of "
+                    f"the f32 leg (ceiling {cap}x) — the codec stopped "
+                    "compressing; check wire.py wire_nbytes")
+    for cfg in RING_COLLECTIVES_SUITE:
+        best, bytes_ = by_wire[cfg["wire"]]
+        r = {"name": cfg["name"], "op": f"ring.{cfg['leg']}",
+             "ms": round(best * 1e3, 3),
+             "wire_mb": round(bytes_[cfg["leg"]] / 1e6, 5),
+             "device": "host"}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    return results
+
+
 # Replica-parity probe suite (--parity-probe): the runtime half of the
 # distributed-semantics plane on the same ~1M-param MLP at dp=2.  The
 # contract gated here: ARMED, the probe's amortized cost at the default
@@ -764,6 +863,12 @@ def main(argv=None):
                          "(reduce-scatter/all-gather per wire dtype at "
                          "dp=2); gates on analytic wire_mb, which is "
                          "deterministic — ms is informational")
+    ap.add_argument("--ring-collectives", action="store_true",
+                    help="fused quantized ring collective bytes "
+                         "(ring reduce-scatter/all-gather per wire "
+                         "dtype incl. int4 at dp=2); gates on analytic "
+                         "wire_mb plus the per-wire ratio ceiling vs "
+                         "f32 — ms is informational")
     ap.add_argument("--parity-probe", action="store_true",
                     help="replica-parity probe overhead (dp=2 mlp1m): "
                          "armed <= 2% of step time at the default "
@@ -819,6 +924,9 @@ def main(argv=None):
     elif a.zero_collectives:
         suite = ZERO_COLLECTIVES_SUITE
         results = zero_collectives_bench(repeats=a.repeats)
+    elif a.ring_collectives:
+        suite = RING_COLLECTIVES_SUITE
+        results = ring_collectives_bench(repeats=a.repeats)
     elif a.parity_probe:
         suite = PARITY_PROBE_SUITE
         results = parity_probe_bench(repeats=a.repeats)
@@ -851,6 +959,7 @@ def main(argv=None):
         from paddle_tpu.framework import runlog
         variant = "ps_transport" if a.ps_transport else \
             "zero_collectives" if a.zero_collectives else \
+            "ring_collectives" if a.ring_collectives else \
             "parity_probe" if a.parity_probe else "suite"
         legs = []
         for r in results:
@@ -888,6 +997,7 @@ def main(argv=None):
         known = suite_names | {c["name"] for c in BUILTIN_SUITE} \
             | {c["name"] for c in PS_TRANSPORT_SUITE} \
             | {c["name"] for c in ZERO_COLLECTIVES_SUITE} \
+            | {c["name"] for c in RING_COLLECTIVES_SUITE} \
             | {c["name"] for c in PARITY_PROBE_SUITE}
         missing_base = sorted(suite_names - set(base))
         if missing_base:

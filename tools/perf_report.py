@@ -299,6 +299,14 @@ SUMMARY_SIGNAL_CFG: Dict[str, dict] = {
                                "z_threshold": 6.0},
     "numerics_nonfinite_steps_total": {"worse": "up", "min_mad": 0.1,
                                        "z_threshold": 6.0},
+    # ZeRO collective wire bytes per step (parallel/zero.py via
+    # monitor stats): deterministic byte accounting for the fused
+    # reduce-scatter + all-gather pair, so a wire/codec change shows
+    # up as a named byte-series move — a quantized ring run against an
+    # f32 baseline prints an IMPROVEMENT here, a silently-widened wire
+    # a regression.  Bytes are exact (no timing jitter): tiny floors
+    "zero_collective_bytes_per_step": {"worse": "up", "min_mad": 1.0,
+                                       "rel_floor": 0.02},
     # cluster-granularity series (framework/collector.py
     # CollectorServer.capture_record): the collector's cross-worker
     # view gates here — a new straggler, a step-skew jump, or RPC-p99

@@ -71,6 +71,8 @@ MODULES = [
     "paddle_tpu.onnx",
     "paddle_tpu.regularizer",
     "paddle_tpu.parallel.zero",
+    "paddle_tpu.parallel.ring",
+    "paddle_tpu.parallel.dp_meta",
     "paddle_tpu.framework.flags",
     "paddle_tpu.framework.crypto",
     "paddle_tpu.framework.monitor",

@@ -532,11 +532,44 @@ def _czoo_ring_attention():
         name="zoo:ring_attention")
 
 
+def _czoo_ring_collectives():
+    """Trace the fused quantized ring collectives (parallel/ring.py) on
+    a dp=4 mesh — the quantize-inside-a-ppermute-scan idiom.  The ring
+    RS carries an encoded partial with an f32 accumulator and the ring
+    AG assembles every seat's chunk via a complete-cycle scan; PTA504
+    must accept the decode-add-reencode hop and PTA501 must recognize
+    the complete ring as a gather (zero findings)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.framework.analysis import analyze_callable
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.mesh import shard_map_compat
+    from paddle_tpu.parallel.ring import (ring_all_gather,
+                                          ring_reduce_scatter)
+    _require_devices(4, "zoo:ring_collectives")
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+
+    def allreduce(g):
+        def body(gflat):
+            s = ring_reduce_scatter(gflat, "dp", axis_size=4, chunk=8,
+                                    wire="int8") / 4
+            return ring_all_gather(s, "dp", axis_size=4, chunk=8,
+                                   wire="int4")
+        return shard_map_compat(body, mesh, P(), P())(g)
+
+    return analyze_callable(
+        allreduce, jax.ShapeDtypeStruct((128,), jnp.float32),
+        name="zoo:ring_collectives")
+
+
 COLLECTIVES_ZOO = {
     "zero_step": _czoo_zero_step,
     "sharded_step": _czoo_sharded_step,
     "tp_layers": _czoo_tp_layers,
     "ring_attention": _czoo_ring_attention,
+    "ring_collectives": _czoo_ring_collectives,
 }
 
 
@@ -631,11 +664,29 @@ def _pzoo_ring_attention():
                            name="zoo:ring_attention")
 
 
+def _pzoo_ring_quant():
+    """Trace the fused-ring row quantizer at a non-row-block-aligned
+    row count (r=1000: padded tail block) for both quantized wires —
+    the pad/slice path the ring's codec leg rides."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework.analysis import analyze_kernels
+    from paddle_tpu.ops.pallas.ring_quant import ring_quant_rows
+
+    sds = jax.ShapeDtypeStruct((1000, 256), jnp.float32)
+    return analyze_kernels(
+        lambda x: (ring_quant_rows(x, "int8", force=True)
+                   + ring_quant_rows(x, "int4", force=True)),
+        sds, name="zoo:ring_quant")
+
+
 PALLAS_ZOO = {
     "flash_attention": _pzoo_flash_attention,
     "fused_adam": _pzoo_fused_adam,
     "fused_ce": _pzoo_fused_ce,
     "ring_attention": _pzoo_ring_attention,
+    "ring_quant": _pzoo_ring_quant,
 }
 
 
