@@ -819,6 +819,11 @@ class PSTrainStep:
 
     def __call__(self, ids, *inputs):
         import time as _time
+        # postmortem ring: the pulled-row ids ARE the sparse tier's step
+        # input — ring them with the dense batch so a PS incident
+        # replays the exact rows it pulled (one flag lookup disarmed)
+        from paddle_tpu.framework import incident
+        incident.maybe_note(self, (ids,) + tuple(inputs))
         t_start = _time.perf_counter()
         step_span = self._tracer().start_span(
             "train.step",

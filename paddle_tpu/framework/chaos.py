@@ -139,6 +139,15 @@ Fault points shipped in-tree (grep for ``fault_point(`` to audit):
                         call (its output stays bit-identical);
                         ``mode="latency"`` a slow oracle the call
                         simply absorbs
+``incident.capture``    head of every incident-bundle capture
+                        (framework/incident.py IncidentRecorder, armed
+                        via FLAGS_incident) — ``mode="error"`` is a
+                        broken/full bundle disk the capture must
+                        swallow and count
+                        (``incident_capture_errors_total``): the
+                        postmortem recorder must never crash the run
+                        it records; ``mode="latency"`` a slow disk the
+                        (already off-hot-path) capture simply absorbs
 =====================  ====================================================
 
 Injection is schedule-driven and deterministic: ``nth`` (trip exactly on
@@ -173,7 +182,7 @@ import numpy as np
 __all__ = ["InjectedFault", "FaultSpec", "fault_point", "inject", "arm",
            "disarm", "stats", "reset", "arm_from_flags", "FAULT_POINTS",
            "register_fault_point", "known_fault_points",
-           "payload_fault_points"]
+           "payload_fault_points", "arm_state", "restore_state"]
 
 FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "ckpt.save", "ckpt.async", "ckpt.verify",
@@ -182,7 +191,7 @@ FAULT_POINTS = ("ps.rpc", "ps.pipeline", "data.pipeline", "fs.write",
                 "health.detector", "zero.collective",
                 "numerics.observe", "runlog.observe", "collector.rpc",
                 "locks.observe", "parity.observe", "autopilot.act",
-                "pallas.verify")
+                "pallas.verify", "incident.capture")
 _known_points = set(FAULT_POINTS)
 # points whose fault_point() call carries a payload (the only ones where
 # mode="nan" can transform anything)
@@ -271,6 +280,7 @@ class FaultSpec:
 class ChaosRegistry:
     def __init__(self, seed: int = 0):
         self._specs: Dict[str, FaultSpec] = {}
+        self._seed = int(seed)
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
         self.armed = False               # fast-path gate for fault_point
@@ -305,7 +315,49 @@ class ChaosRegistry:
         # and a reseed racing a fire must swap the reference atomically
         # with the schedule state (PTA403)
         with self._lock:
+            self._seed = int(seed)
             self._rng = np.random.default_rng(seed)
+
+    def export_state(self) -> Dict[str, Any]:
+        """JSON-able snapshot of the whole injection state: seed, the
+        probability stream's mid-sequence generator state, and every
+        armed spec WITH its call/trip counters — what an incident
+        bundle records so a replay resumes the exact fault schedule a
+        mid-run incident saw, not the schedule from call zero."""
+        with self._lock:
+            specs = {}
+            for name, s in self._specs.items():
+                specs[name] = {
+                    "mode": s.mode, "nth": s.nth, "every": s.every,
+                    "p": s.p, "latency": s.latency, "n_times": s.n_times,
+                    "message": s.message,
+                    "payload_index": s.payload_index,
+                    "calls": s.calls, "trips": s.trips}
+            return {"seed": self._seed, "armed": self.armed,
+                    "rng_state": self._rng.bit_generator.state,
+                    "specs": specs}
+
+    def import_state(self, state: Dict[str, Any]):
+        """Reinstall an :meth:`export_state` snapshot: specs are rebuilt
+        with their call/trip counters reinstated, and the probability
+        stream resumes from the recorded generator state (falling back
+        to a fresh seed when the snapshot predates ``rng_state``)."""
+        specs = {}
+        for name, kw in dict(state.get("specs") or {}).items():
+            kw = dict(kw)
+            calls = int(kw.pop("calls", 0))
+            trips = int(kw.pop("trips", 0))
+            fs = FaultSpec(**kw)
+            fs.calls, fs.trips = calls, trips
+            specs[name] = fs
+        with self._lock:
+            self._seed = int(state.get("seed", 0))
+            self._rng = np.random.default_rng(self._seed)
+            rng_state = state.get("rng_state")
+            if rng_state is not None:
+                self._rng.bit_generator.state = rng_state
+            self._specs = specs
+            self.armed = bool(specs)
 
     def fire(self, name: str, payload: Any = None, meta: dict = None):
         spec = self._specs.get(name)
@@ -437,6 +489,34 @@ def reset(seed: int = 0):
 
 def stats() -> Dict[str, Dict[str, int]]:
     return _registry.stats()
+
+
+def arm_state() -> Dict[str, Any]:
+    """JSON-able snapshot of the full chaos state — seed, mid-sequence
+    rng stream, and every armed spec with its call/trip counters.
+    Recorded into incident bundles so :func:`restore_state` resumes the
+    exact fault schedule a mid-run incident saw (the seed alone would
+    replay from call zero, a different schedule)."""
+    if not _env_armed:
+        arm_from_flags()
+    return _registry.export_state()
+
+
+def restore_state(state: Dict[str, Any]):
+    """Reinstall an :func:`arm_state` snapshot (replay's arming path).
+
+    Pins the seed as explicit (lazy env arming must not clobber a
+    restored stream) and auto-registers spec names this process has not
+    declared — they were valid where the snapshot was taken, and a
+    replay refusing its own recorded schedule would be the
+    false-green the registry exists to prevent."""
+    global _env_armed, _explicit_seed
+    _env_armed = True
+    _explicit_seed = True
+    for name in dict(state.get("specs") or {}):
+        if name not in _known_points:
+            register_fault_point(name, carries_payload=True)
+    _registry.import_state(state)
 
 
 @contextlib.contextmanager

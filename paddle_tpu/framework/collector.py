@@ -249,6 +249,16 @@ def local_payload(since_seq: int = 0, extra: Optional[dict] = None,
                 payload["spans"] = rows
         except Exception:  # noqa: BLE001 — telemetry never crashes
             pass
+    try:
+        # incident notices (postmortem plane): the CUMULATIVE bounded
+        # queue ships whole each push — fire-and-forget pushes drop, so
+        # the server dedups by id rather than the client draining
+        from paddle_tpu.framework import incident as _incident
+        notices = _incident.drain_notices()
+        if notices:
+            payload["incidents"] = notices
+    except Exception:  # noqa: BLE001 — telemetry never crashes
+        pass
     if extra:
         payload.update(extra)
     return payload
@@ -460,7 +470,8 @@ class _WorkerState:
                  "gaps", "stale", "first_ts", "last_ts", "stats",
                  "hists", "spans", "flight_kind_totals", "flight_seen",
                  "step_count", "step_sum", "interval_means",
-                 "straggler_score", "straggler", "detector_anomalies")
+                 "straggler_score", "straggler", "detector_anomalies",
+                 "incidents")
 
     def __init__(self, role: str, window: int):
         self.role = role
@@ -483,6 +494,7 @@ class _WorkerState:
         self.straggler_score = 1.0
         self.straggler = False
         self.detector_anomalies = 0
+        self.incidents: Dict[int, dict] = {}  # id → notice (dedup'd)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -681,6 +693,16 @@ class CollectorServer:
                 kind = str(ev.get("kind", "?"))
                 self._flight_kind_totals[kind] = \
                     self._flight_kind_totals.get(kind, 0) + 1
+            # incident notices: the client ships its cumulative bounded
+            # queue whole each push — dedup by id so a re-shipped
+            # notice lands exactly once and a dropped push loses none
+            for n in payload.get("incidents") or []:
+                try:
+                    nid = int(n.get("id"))
+                except (TypeError, ValueError):
+                    continue
+                if nid not in st.incidents:
+                    st.incidents[nid] = dict(n, worker=worker)
             # PS table telemetry (server roles): keep the LATEST
             # cumulative snapshot per shard — summing reports would
             # double-count
@@ -836,10 +858,16 @@ class CollectorServer:
                     "straggler": st.straggler and not expired,
                     "straggler_score": round(st.straggler_score, 4),
                     "detector_anomalies": st.detector_anomalies,
+                    "incidents_total": len(st.incidents),
                 }
                 workers[w] = row
             tables = {tname: aggregate_table_shards(agg["by_shard"])
                       for tname, agg in sorted(self._tables.items())}
+            incidents = sorted(
+                (dict(n) for st in self._workers.values()
+                 for n in st.incidents.values()),
+                key=lambda n: (str(n.get("worker")),
+                               int(n.get("id") or 0)))
             flight_rows = merge_flight_events(
                 self._group_flight_locked())
             return {
@@ -854,6 +882,7 @@ class CollectorServer:
                 "straggler_ratio": self.straggler_ratio,
                 "flight_kind_totals": dict(self._flight_kind_totals),
                 "flight": flight_rows[-64:],
+                "incidents": incidents[-64:],
             }
 
     def _group_flight_locked(self) -> Dict[tuple, List[dict]]:

@@ -441,6 +441,45 @@ define_flag("flight_storm_k", 8,
             "identical (kind, attrs) flight events tolerated per "
             "flight_storm_window before further identical events are "
             "suppressed (ring skipped, counters still bumped)")
+# postmortem tier (framework/incident.py IncidentRecorder +
+# tools/replay.py):
+define_flag("incident", False,
+            "arm the postmortem plane: ResilientTrainStep/PSTrainStep "
+            "keep a small host-side ring of recent step inputs (batch "
+            "arrays or pulled-row ids, rng state, pre-step training "
+            "state, chaos schedule) and a subscribed flight kind "
+            "(FLAGS_incident_kinds) firing assembles a crash-safe "
+            "incident bundle under FLAGS_incident_dir — checkpoint "
+            "generation ref or inline state, the input ring, flags "
+            "overrides, monitor snapshot, flight tail — that "
+            "tools/replay.py re-executes standalone.  Capture NEVER "
+            "raises (incident.capture chaos point + swallow-and-count) "
+            "and never perturbs the trajectory (host-only reads).  Off "
+            "(default): one flag lookup per step, signature-cache keys "
+            "byte-identical to the seed")
+define_flag("incident_kinds", "",
+            "comma-separated flight kinds that trigger incident "
+            "capture; empty = the built-in subscription "
+            "(train.nan_skip, health.anomaly, numerics.scale_collapse, "
+            "parity.divergence, pallas.divergence, autopilot.action, "
+            "autopilot.revert)")
+define_flag("incident_dir", "",
+            "directory incident bundles land under "
+            "(incident_<NNNNNN>/ per capture, monotonic id from a "
+            "directory scan); empty = 'incidents' under the current "
+            "directory")
+define_flag("incident_ring", 4,
+            "steps of input history the armed IncidentRecorder keeps "
+            "(host copies of step inputs + rng state + pre-step "
+            "training state); the bundle replays exactly this window "
+            "and --bisect walks it for the first divergent step")
+define_flag("incident_state_cap_mb", 64.0,
+            "inline-state size cap (MB) per incident bundle: below it "
+            "the ring's oldest pre-step params/opt-state snapshot is "
+            "embedded in the bundle (standalone replay, no checkpoint "
+            "root needed); above it the bundle records a {root, "
+            "generation} ref to the newest verified checkpoint "
+            "generation instead.  0 forces the generation-ref path")
 # durable-state tier (distributed/durable.py CheckpointManager +
 # checkpoint.py async save + the SIGTERM emergency-save contract):
 define_flag("ckpt_keep_last", 2,

@@ -575,6 +575,11 @@ class FlightRecorder:
         # the bounded ring of the root cause recorded before it
         self._storm: Dict[tuple, list] = {}
         self.suppressed = 0
+        # event listeners (framework/incident.py subscribes): called
+        # OUTSIDE the ring lock with the live ev dict — a listener may
+        # stamp attrs in place (the incident-id round-trip) but must
+        # never raise into record()
+        self._listeners: List = []
 
     def _buf(self) -> "collections.deque":
         if self._ring is None:
@@ -604,7 +609,36 @@ class FlightRecorder:
             self._seq += 1
             ev["seq"] = self._seq
             buf.append(ev)
+        # listeners run outside the lock (a listener that records its
+        # own events — incident capture does — must not re-enter it
+        # holding the ring) and get the LIVE dict: attrs they stamp
+        # propagate to recent()/since() readers.  A listener fault is
+        # swallowed — record() never fails its caller.
+        for fn in list(self._listeners):
+            try:
+                fn(ev)
+            except Exception:      # noqa: BLE001 — listener never breaks record
+                pass
         return ev
+
+    def add_listener(self, fn):
+        """Subscribe ``fn(ev)`` to every non-suppressed recorded event
+        (called outside the ring lock with the live event dict — attrs
+        stamped in place round-trip through recent()/since()).
+        Exceptions from ``fn`` are swallowed.  Returns ``fn``."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+        return fn
+
+    def remove_listener(self, fn) -> bool:
+        """Unsubscribe a listener; True when it was registered."""
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+                return True
+            except ValueError:
+                return False
 
     def _storm_suppress_locked(self, kind: str, attrs: dict,
                                now: float) -> bool:

@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.framework import chaos, monitor
+from paddle_tpu.framework import chaos, incident, monitor
 from paddle_tpu.framework.observability import flight
 
 __all__ = ["ResilientTrainStep"]
@@ -218,6 +218,10 @@ class ResilientTrainStep:
     def __call__(self, *inputs):
         if self._snap is None:
             self.snapshot()
+        # postmortem ring: PRE-poison inputs + rng + pre-step state, so
+        # a replay re-arms the recorded chaos schedule and re-derives
+        # the poison itself (host-only reads; one flag lookup disarmed)
+        incident.maybe_note(self, inputs)
         inputs = chaos.fault_point("train.step_grads", payload=inputs)  # pta: disable=PTA301 (ResilientTrainStep IS the recovery wrapper)
         self.last_step_skipped = False
         # a FRESH numerics record (stashed by the wrapped step during

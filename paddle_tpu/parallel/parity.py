@@ -46,7 +46,7 @@ from paddle_tpu.framework import chaos, monitor
 from paddle_tpu.framework.flags import flag
 
 __all__ = ["enabled", "probe_every", "ParityRecord", "ParityProbe",
-           "maybe_observe", "reset"]
+           "maybe_observe", "reset", "leaf_hash_host"]
 
 
 def enabled() -> bool:
@@ -90,6 +90,32 @@ def _leaf_hash_traced(x):
     w = jnp.arange(bits.shape[0], dtype=jnp.uint32) * jnp.uint32(2) \
         + jnp.uint32(1)
     return jnp.sum(bits * w, dtype=jnp.uint32)
+
+
+def leaf_hash_host(x) -> int:
+    """Numpy twin of :func:`_leaf_hash_traced` — bit-identical hash of
+    a HOST array, no trace, no device.  The postmortem plane
+    (framework/incident.py, tools/replay.py) hashes recorded and
+    re-executed state trees with this so a replay's first-divergence
+    bisection names the same leaf either probe would."""
+    flat = np.ascontiguousarray(np.asarray(x)).reshape(-1)
+    if flat.dtype == np.bool_:
+        flat = flat.astype(np.uint8)
+    size = flat.dtype.itemsize
+    if size == 1:
+        bits = flat.astype(np.uint32)
+    elif size == 2:
+        bits = flat.view(np.uint16).astype(np.uint32)
+    elif size == 4:
+        bits = flat.view(np.uint32)
+    else:                            # 8-byte: two uint32 words per element
+        bits = flat.view(np.uint32)
+    if bits.shape[0] == 0:
+        return 0
+    w = np.arange(bits.shape[0], dtype=np.uint32) * np.uint32(2) \
+        + np.uint32(1)
+    with np.errstate(over="ignore"):
+        return int((bits * w).sum(dtype=np.uint32))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,204 @@
+"""Postmortem-lane fixture — the incident plane's acceptance artifact
+(tools/ci.sh postmortem lane).
+
+Modes (``python tests/fixtures/postmortem_incident.py <mode> [root]``):
+
+* ``capture`` — FLAGS_incident armed over the health-check two-branch
+  numerics step (``health_check.build_incident_step`` — the replay
+  builder), ``train.step_grads`` NaN-poisoned at step 3: the
+  ``train.nan_skip`` must auto-capture a committed bundle that
+  ``verify_bundle`` accepts, stamp the live flight event with the
+  incident id, queue a collector notice, and index itself in the run
+  ledger.  Prints ``INCIDENT_CAPTURED <bundle>`` (the lane replays and
+  bisects this exact path) plus ``INCIDENT_LEDGER <ledger.jsonl>``.
+* ``clean`` — the cheap-when-off gate, both halves: (a) the SAME
+  poisoned run with FLAGS_incident off captures nothing —
+  ``INCIDENT_DISARMED_SILENT``; (b) the armed run's loss trajectory is
+  bitwise identical to the disarmed one (host-only reads: the ring
+  must never perturb the watched step) —
+  ``INCIDENT_BITIDENTICAL <crc32>``.
+* ``child`` / ``sigkill-parent`` — SIGKILL mid-capture: the child's
+  capture stalls inside a ring-file write (``ckpt.save`` latency
+  chaos), the parent kills it there, and the torn bundle directory —
+  files present, COMMIT absent — must be REFUSED by ``verify_bundle``
+  and by ``tools/replay.py`` (rc 2).  Prints
+  ``INCIDENT_SIGKILL_TORN <bundle>``.
+
+Every verdict line is grepped by tools/ci.sh; keep them stable.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.framework import chaos, incident  # noqa: E402
+from paddle_tpu.framework.flags import set_flags  # noqa: E402
+from paddle_tpu.framework.observability import flight  # noqa: E402
+
+import health_check  # noqa: E402  (tools/ — the replay builder lives there)
+
+N_STEPS = 6
+NAN_STEP = 3        # 3rd call to train.step_grads → global step 2 poisoned
+
+
+def _batches():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    z = paddle.to_tensor(rng.standard_normal((4,)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    return x, z, y
+
+
+def _run_poisoned(n_steps: int = N_STEPS):
+    """One deterministic poisoned mini-run over the replay builder's
+    step; returns (losses, step)."""
+    step = health_check.build_incident_step(seed=0, lr=0.05)
+    x, z, y = _batches()
+    chaos.arm("train.step_grads", mode="nan", nth=NAN_STEP, n_times=1,
+              payload_index=1)
+    losses = [float(step(x, z, y)) for _ in range(n_steps)]
+    return losses, step
+
+
+def mode_capture(root: str) -> int:
+    inc_dir = os.path.join(root, "incidents")
+    ledger = os.path.join(root, "ledger.jsonl")
+    set_flags({"incident": True, "incident_dir": inc_dir,
+               "numerics": True, "runlog_dir": root})
+    losses, step = _run_poisoned()
+    assert np.isfinite(losses[-1]), f"run did not recover: {losses[-3:]}"
+    bundle = incident.recorder.last_bundle
+    assert bundle and os.path.isdir(bundle), "no bundle captured"
+    problems = incident.verify_bundle(bundle)
+    assert not problems, f"committed bundle refused: {problems}"
+    man = incident.read_manifest(bundle)
+    attrs = man["event"]["attrs"]
+    assert man["event"]["kind"] == "train.nan_skip", man["event"]
+    assert attrs.get("first_bad_leaf") == "aux_w", attrs
+    # the live event was stamped with the id (round-trips via recent())
+    skips = flight.recent(20, kind="train.nan_skip")
+    assert skips and skips[-1]["attrs"].get("incident") == \
+        man["incident_id"], skips[-1] if skips else None
+    # the collector notice + the ledger index both name the bundle
+    notices = incident.drain_notices()
+    assert notices and notices[-1]["id"] == man["incident_id"], notices
+    with open(ledger) as f:
+        kinds = [json.loads(ln).get("kind") for ln in f if ln.strip()]
+    assert "incident" in kinds, kinds
+    print(f"INCIDENT_CAPTURED {bundle}")
+    print(f"INCIDENT_LEDGER {ledger}")
+    return 0
+
+
+def mode_clean(root: str) -> int:
+    inc_dir = os.path.join(root, "incidents")
+    set_flags({"numerics": True, "incident_dir": inc_dir})
+
+    # (a) disarmed: the poisoned run must capture NOTHING
+    set_flags({"incident": False})
+    losses_off, _ = _run_poisoned()
+    assert not os.path.isdir(inc_dir) or not os.listdir(inc_dir), \
+        f"disarmed run captured into {inc_dir}"
+    assert incident.recorder.captured_total == 0
+    print("INCIDENT_DISARMED_SILENT")
+
+    # (b) armed: same seeds, same poison — the loss trajectory must be
+    # BITWISE identical (the ring is host-only reads)
+    incident.reset()
+    set_flags({"incident": True})
+    losses_on, _ = _run_poisoned()
+    assert incident.recorder.captured_total >= 1, "armed run captured 0"
+    a = np.asarray(losses_off, dtype=np.float64)
+    b = np.asarray(losses_on, dtype=np.float64)
+    assert a.tobytes() == b.tobytes(), \
+        f"armed trajectory diverged: {losses_off} vs {losses_on}"
+    print(f"INCIDENT_BITIDENTICAL {zlib.crc32(a.tobytes()) & 0xFFFFFFFF}")
+    return 0
+
+
+def mode_child(root: str) -> int:
+    inc_dir = os.path.join(root, "incidents")
+    set_flags({"incident": True, "incident_dir": inc_dir,
+               "numerics": True})
+    # stall the capture mid file-sequence: bundle files go through
+    # checkpoint._atomic_save, which fires ckpt.save — nth=2 lets the
+    # first write land and hangs the second, so COMMIT never lands
+    chaos.arm("ckpt.save", mode="latency", latency=600.0, nth=2)
+    t = threading.Thread(target=lambda: _run_poisoned(), daemon=True)
+    t.start()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.isdir(inc_dir) and any(
+                n.startswith(incident.BUNDLE_PREFIX)
+                for n in os.listdir(inc_dir)):
+            break
+        time.sleep(0.01)
+    else:
+        raise AssertionError("capture never claimed a bundle dir")
+    print("CHILD_CAPTURING", flush=True)
+    time.sleep(600)
+    return 0
+
+
+def mode_sigkill_parent(root: str) -> int:
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "child", root],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = child.stdout.readline()
+            if "CHILD_CAPTURING" in line:
+                break
+        else:
+            raise AssertionError("child never reached CHILD_CAPTURING")
+        time.sleep(0.5)          # let the stalled writer settle
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    inc_dir = os.path.join(root, "incidents")
+    bundles = sorted(n for n in os.listdir(inc_dir)
+                     if n.startswith(incident.BUNDLE_PREFIX))
+    assert bundles, "child claimed no bundle dir"
+    torn = os.path.join(inc_dir, bundles[-1])
+    assert not os.path.exists(os.path.join(torn, incident.COMMIT_NAME)), \
+        "COMMIT must be written strictly last — torn capture committed!"
+    problems = incident.verify_bundle(torn)
+    assert problems, "verify_bundle accepted a torn bundle"
+    rc = subprocess.call(
+        [sys.executable, os.path.join(_REPO, "tools", "replay.py"), torn],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    assert rc == 2, f"replay must refuse a torn bundle (rc 2), got {rc}"
+    print(f"INCIDENT_SIGKILL_TORN {torn}")
+    return 0
+
+
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "capture"
+    root = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
+        os.environ.get("TMPDIR", "/tmp"),
+        f"postmortem_{mode}_{os.getpid()}")
+    os.makedirs(root, exist_ok=True)
+    return {"capture": mode_capture, "clean": mode_clean,
+            "child": mode_child,
+            "sigkill-parent": mode_sigkill_parent}[mode](root)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,525 @@
+"""Postmortem plane: incident ring + capture bundles, crash-safe
+commit discipline, deterministic replay with first-divergence
+bisection, chaos-schedule arm/restore, and the collector/perf_report
+surfacing (framework/incident.py + tools/replay.py).
+
+Acceptance (deterministic, CPU-only): an armed run whose
+``train.step_grads`` is NaN-poisoned auto-captures a committed bundle
+that replays standalone — same flight kind, same ``first_bad_leaf`` —
+and whose clean-leg bisection names the poisoned step by number; a
+torn bundle (no COMMIT) is refused; disarmed, the plane is a single
+flag lookup and captures nothing."""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import chaos, incident, monitor
+from paddle_tpu.framework.flags import get_flags, set_flags
+from paddle_tpu.framework.observability import flight
+from paddle_tpu.framework.resilient import ResilientTrainStep
+from paddle_tpu.jit import TrainStep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (REPO, os.path.join(REPO, "tools")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+import health_check  # noqa: E402 — tools/; the replay builder
+
+FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                       "postmortem_incident.py")
+REPLAY = os.path.join(REPO, "tools", "replay.py")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    saved = get_flags(["incident", "incident_dir", "incident_kinds",
+                       "incident_ring", "incident_state_cap_mb",
+                       "numerics", "runlog_dir"])
+    chaos.reset(0)
+    flight.clear()
+    incident.reset()
+    incident.recorder.captured_total = 0
+    for s in ("incident_captured_total", "incident_capture_errors_total"):
+        monitor.reset_stat(s)
+    yield
+    incident.uninstall()
+    incident.reset()
+    incident.recorder._program = None
+    set_flags(saved)
+    chaos.reset(0)
+    from paddle_tpu.framework import numerics as numerics_mod
+    numerics_mod.reset()
+
+
+def _arm(tmp_path, **over):
+    flags = {"incident": True, "numerics": True,
+             "incident_dir": str(tmp_path / "incidents")}
+    flags.update(over)
+    set_flags(flags)
+
+
+def _poisoned_run(n_steps=6, nth=3, seed=0):
+    """Deterministic NaN-poisoned mini-run over the replay builder's
+    two-branch step; poison hits the aux input on the ``nth`` call."""
+    step = health_check.build_incident_step(seed=seed, lr=0.05)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 8)).astype(np.float32))
+    z = paddle.to_tensor(rng.standard_normal((4,)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    chaos.arm("train.step_grads", mode="nan", nth=nth, n_times=1,
+              payload_index=1)
+    losses = [float(step(x, z, y)) for _ in range(n_steps)]
+    return losses, step
+
+
+# ---------------------------------------------------------------------------
+# chaos arm_state / restore_state (mid-sequence schedule snapshot)
+# ---------------------------------------------------------------------------
+
+class TestChaosArmState:
+    def test_roundtrip_preserves_counters(self):
+        chaos.arm("ckpt.save", mode="error", nth=3, n_times=1)
+        with pytest.raises(chaos.InjectedFault):
+            for _ in range(3):
+                chaos.fault_point("ckpt.save")
+        state = chaos.arm_state()
+        spec = state["specs"]["ckpt.save"]
+        assert spec["calls"] == 3 and spec["trips"] == 1
+        chaos.reset(0)
+        chaos.restore_state(state)
+        # n_times=1 already spent: the restored schedule must NOT
+        # re-fire — trip counts are part of the mid-sequence state
+        for _ in range(5):
+            chaos.fault_point("ckpt.save")
+        assert chaos.stats()["ckpt.save"]["trips"] == 1
+
+    def test_roundtrip_continues_rng_stream(self):
+        chaos.reset(7)
+        chaos.arm("ckpt.save", mode="error", p=0.5)
+
+        def fire_pattern(n):
+            out = []
+            for _ in range(n):
+                try:
+                    chaos.fault_point("ckpt.save")
+                    out.append(0)
+                except chaos.InjectedFault:
+                    out.append(1)
+            return out
+
+        head = fire_pattern(5)
+        state = chaos.arm_state()
+        tail_uninterrupted = fire_pattern(8)
+        chaos.reset(0)
+        chaos.restore_state(state)
+        assert fire_pattern(8) == tail_uninterrupted
+        assert 1 in head + tail_uninterrupted  # the pattern is real
+
+    def test_restore_registers_unknown_points(self):
+        state = {"seed": 0, "armed": True,
+                 "specs": {"custom.replay_only": {
+                     "mode": "error", "nth": 1, "every": None, "p": 0.0,
+                     "latency": 0.0, "n_times": 1, "message": "",
+                     "payload_index": None, "calls": 0, "trips": 0}}}
+        chaos.restore_state(state)
+        with pytest.raises(chaos.InjectedFault):
+            chaos.fault_point("custom.replay_only")
+
+
+# ---------------------------------------------------------------------------
+# flight listener + incident attr round-trip
+# ---------------------------------------------------------------------------
+
+class TestFlightListener:
+    def test_listener_sees_live_event_and_stamp_roundtrips(self):
+        got = []
+
+        def stamp(ev):
+            got.append(ev["kind"])
+            ev["attrs"]["incident"] = 42
+
+        flight.add_listener(stamp)
+        try:
+            flight.record("parity.divergence", severity="warn", leaf="w")
+        finally:
+            flight.remove_listener(stamp)
+        assert got == ["parity.divergence"]
+        evs = flight.recent(5, kind="parity.divergence")
+        assert evs[-1]["attrs"]["incident"] == 42
+        assert evs[-1]["attrs"]["leaf"] == "w"
+
+    def test_listener_exception_never_breaks_record(self):
+        def boom(ev):
+            raise RuntimeError("listener bug")
+
+        flight.add_listener(boom)
+        try:
+            ev = flight.record("health.anomaly", severity="warn")
+        finally:
+            flight.remove_listener(boom)
+        assert ev["kind"] == "health.anomaly"
+        assert flight.recent(3, kind="health.anomaly")
+
+
+# ---------------------------------------------------------------------------
+# ring + capture
+# ---------------------------------------------------------------------------
+
+class TestCapture:
+    def test_disarmed_is_inert(self, tmp_path):
+        set_flags({"incident": False,
+                   "incident_dir": str(tmp_path / "incidents"),
+                   "numerics": True})
+        losses, _ = _poisoned_run()
+        assert np.isfinite(losses[-1])
+        assert incident.recorder.captured_total == 0
+        assert not os.path.isdir(str(tmp_path / "incidents"))
+
+    def test_armed_nan_skip_captures_committed_bundle(self, tmp_path):
+        _arm(tmp_path)
+        losses, step = _poisoned_run()
+        assert np.isfinite(losses[-1])
+        bundle = incident.recorder.last_bundle
+        assert bundle and os.path.isdir(bundle)
+        assert incident.verify_bundle(bundle) == []
+        man = incident.read_manifest(bundle)
+        assert man["event"]["kind"] == "train.nan_skip"
+        assert man["event"]["attrs"]["first_bad_leaf"] == "aux_w"
+        assert man["state"]["inline"] is True
+        assert len(man["ring"]) == 3          # steps 0, 1, 2 noted
+        assert [e["step"] for e in man["ring"]] == [0, 1, 2]
+        assert man["post_hashes"]              # live (poisoned) state
+        assert man["program"]["builder"] == \
+            "health_check:build_incident_step"
+        # the LIVE flight event was stamped with the incident id
+        skips = flight.recent(10, kind="train.nan_skip")
+        assert skips[-1]["attrs"]["incident"] == man["incident_id"]
+        # notices feed the collector payload; ids are monotonic
+        notices = incident.drain_notices()
+        assert notices[-1]["id"] == man["incident_id"] == 1
+        assert int(monitor.get_stat("incident_captured_total")) == 1
+
+    def test_ring_is_bounded_by_flag(self, tmp_path):
+        _arm(tmp_path, incident_ring=2)
+        _poisoned_run(n_steps=6, nth=5)
+        man = incident.read_manifest(incident.recorder.last_bundle)
+        assert [e["step"] for e in man["ring"]] == [3, 4]
+
+    def test_unsubscribed_kind_does_not_capture(self, tmp_path):
+        _arm(tmp_path, incident_kinds="parity.divergence")
+        _poisoned_run()
+        assert incident.recorder.captured_total == 0
+
+    def test_capture_fault_swallowed_and_counted(self, tmp_path):
+        _arm(tmp_path)
+        chaos.arm("incident.capture", mode="error", nth=1, n_times=1)
+        losses, _ = _poisoned_run()
+        assert np.isfinite(losses[-1])        # the run survived
+        assert incident.recorder.captured_total == 0
+        assert int(monitor.get_stat(
+            "incident_capture_errors_total")) >= 1
+
+    def test_armed_trajectory_bitwise_identical(self, tmp_path):
+        set_flags({"incident": False, "numerics": True,
+                   "incident_dir": str(tmp_path / "incidents")})
+        off, _ = _poisoned_run()
+        incident.reset()
+        set_flags({"incident": True})
+        on, _ = _poisoned_run()
+        assert incident.recorder.captured_total == 1
+        assert np.asarray(off).tobytes() == np.asarray(on).tobytes()
+
+    def test_incident_ids_monotonic_across_captures(self, tmp_path):
+        _arm(tmp_path)
+        _poisoned_run()
+        first = incident.read_manifest(incident.recorder.last_bundle)
+        _poisoned_run()
+        second = incident.read_manifest(incident.recorder.last_bundle)
+        assert (first["incident_id"], second["incident_id"]) == (1, 2)
+
+    def test_ledger_indexes_capture(self, tmp_path):
+        _arm(tmp_path, runlog_dir=str(tmp_path))
+        _poisoned_run()
+        from paddle_tpu.framework import runlog
+        recs = runlog.RunLedger(
+            str(tmp_path / "ledger.jsonl")).records(kind="incident")
+        assert len(recs) == 1
+        info = recs[0]["incident"]
+        assert info["id"] == 1 and info["first_bad_leaf"] == "aux_w"
+        assert os.path.isdir(info["bundle"])
+
+
+# ---------------------------------------------------------------------------
+# verify_bundle: torn-directory refusal
+# ---------------------------------------------------------------------------
+
+class TestVerifyBundle:
+    def _bundle(self, tmp_path):
+        _arm(tmp_path)
+        _poisoned_run()
+        return incident.recorder.last_bundle
+
+    def test_missing_commit_refused(self, tmp_path):
+        b = self._bundle(tmp_path)
+        os.remove(os.path.join(b, incident.COMMIT_NAME))
+        assert incident.verify_bundle(b) == [
+            {"file": "COMMIT", "reason": "missing"}]
+
+    def test_manifest_crc_mismatch_refused(self, tmp_path):
+        b = self._bundle(tmp_path)
+        mpath = os.path.join(b, incident.MANIFEST_NAME)
+        man = incident.read_manifest(b)
+        man["incident_id"] = 999
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        assert incident.verify_bundle(b) == [
+            {"file": "manifest.json", "reason": "crc_mismatch"}]
+
+    def test_corrupt_ring_file_refused(self, tmp_path):
+        b = self._bundle(tmp_path)
+        fname = incident.read_manifest(b)["ring"][0]["inputs"][0]["file"]
+        fp = os.path.join(b, fname)
+        data = bytearray(open(fp, "rb").read())
+        data[-1] ^= 0xFF
+        with open(fp, "wb") as f:
+            f.write(bytes(data))
+        problems = incident.verify_bundle(b)
+        assert problems == [{"file": fname, "reason": "crc_mismatch"}]
+
+    def test_torn_inline_state_refused(self, tmp_path):
+        b = self._bundle(tmp_path)
+        os.remove(os.path.join(b, incident.STATE_DIRNAME, "COMMIT"))
+        problems = incident.verify_bundle(b)
+        assert {"file": "state", "reason": "state_uncommitted"} \
+            in problems
+
+
+# ---------------------------------------------------------------------------
+# replay + bisect (in-process, via tools/replay.py functions)
+# ---------------------------------------------------------------------------
+
+class TestReplay:
+    def _capture(self, tmp_path):
+        _arm(tmp_path)
+        _poisoned_run()
+        b = incident.recorder.last_bundle
+        incident.uninstall()
+        set_flags({"incident": False})
+        chaos.reset(0)
+        flight.clear()
+        return b
+
+    def test_replay_reproduces_recorded_leaf(self, tmp_path):
+        bundle = self._capture(tmp_path)
+        import replay as replay_mod
+        manifest = replay_mod.load_bundle(bundle)
+        replay_mod.apply_recorded_flags(manifest)
+        step = replay_mod.build_program(manifest)
+        replay_mod.restore_state(step, manifest, bundle)
+        verdict = replay_mod.replay_signal(step, manifest, bundle)
+        assert verdict["reproduced"] is True
+        assert verdict["replayed_first_bad_leaf"] == "aux_w"
+
+    def test_bisect_names_poisoned_step(self, tmp_path):
+        bundle = self._capture(tmp_path)
+        import replay as replay_mod
+        manifest = replay_mod.load_bundle(bundle)
+        replay_mod.apply_recorded_flags(manifest)
+        step = replay_mod.build_program(manifest)
+        replay_mod.restore_state(step, manifest, bundle)
+        verdict = replay_mod.bisect_ring(step, manifest, bundle)
+        # nth=3 poisons the third call = global step 2
+        assert verdict["divergent_step"] == 2
+        assert verdict["leaf"] == "aux_w"
+
+    def test_replay_refuses_torn_bundle(self, tmp_path, capsys):
+        bundle = self._capture(tmp_path)
+        os.remove(os.path.join(bundle, incident.COMMIT_NAME))
+        import replay as replay_mod
+        with pytest.raises(SystemExit) as ei:
+            replay_mod.load_bundle(bundle)
+        assert ei.value.code == 2
+        assert "REPLAY_REFUSED" in capsys.readouterr().out
+
+    def test_replay_missing_generation_fails_by_name(self, tmp_path,
+                                                     capsys):
+        from paddle_tpu.distributed.durable import CheckpointManager
+        # force the ref path: a 1-byte inline cap can hold no state
+        _arm(tmp_path, incident_state_cap_mb=1e-6)
+        step = health_check.build_incident_step(seed=0, lr=0.05)
+        mgr = CheckpointManager(str(tmp_path / "gens"), keep_last=4)
+        step.attach_durable(mgr, every=1, mode="sync",
+                            arm_preemption=False)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((16, 8))
+                             .astype(np.float32))
+        z = paddle.to_tensor(rng.standard_normal((4,))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((16, 4))
+                             .astype(np.float32))
+        chaos.arm("train.step_grads", mode="nan", nth=3, n_times=1,
+                  payload_index=1)
+        for _ in range(4):
+            step(x, z, y)
+        bundle = incident.recorder.last_bundle
+        man = incident.read_manifest(bundle)
+        ref = man["state"]["ref"]
+        assert man["state"]["inline"] is False
+        gen_dir = os.path.join(ref["root"],
+                               f"gen_{int(ref['generation']):08d}")
+        assert os.path.isdir(gen_dir)
+        shutil.rmtree(gen_dir)                 # "GC" the generation
+        incident.uninstall()
+        set_flags({"incident": False})
+        chaos.reset(0)
+        import replay as replay_mod
+        manifest = replay_mod.load_bundle(bundle)
+        fresh = replay_mod.build_program(manifest)
+        with pytest.raises(SystemExit) as ei:
+            replay_mod.restore_state(fresh, manifest, bundle)
+        assert ei.value.code == 2
+        out = capsys.readouterr().out
+        assert "REPLAY_MISSING_GENERATION " \
+            f"gen_{int(ref['generation']):08d}" in out
+
+
+# ---------------------------------------------------------------------------
+# subprocess acceptance: fixture capture -> replay.py CLI (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestReplayCli:
+    def test_capture_replay_bisect_cli(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        cap = subprocess.run(
+            [sys.executable, FIXTURE, "capture", str(tmp_path)],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert cap.returncode == 0, cap.stdout + cap.stderr
+        bundle = [ln.split()[1] for ln in cap.stdout.splitlines()
+                  if ln.startswith("INCIDENT_CAPTURED ")][0]
+        rep = subprocess.run(
+            [sys.executable, REPLAY, bundle],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert rep.returncode == 0, rep.stdout + rep.stderr
+        assert "REPLAY_REPRODUCED kind=train.nan_skip " \
+               "first_bad_leaf=aux_w" in rep.stdout
+        bis = subprocess.run(
+            [sys.executable, REPLAY, bundle, "--bisect"],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert bis.returncode == 0, bis.stdout + bis.stderr
+        assert "BISECT_DIVERGENCE step=2 leaf=aux_w" in bis.stdout
+
+    def test_sigkill_mid_capture_leaves_no_committed_bundle(
+            self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, FIXTURE, "sigkill-parent", str(tmp_path)],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "INCIDENT_SIGKILL_TORN" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# collector + cluster_top surfacing
+# ---------------------------------------------------------------------------
+
+class TestCollectorSurfacing:
+    NOTICE = {"id": 1, "kind": "train.nan_skip", "step": 2,
+              "bundle": "/tmp/x/incident_000001", "worker": "w0"}
+
+    def test_local_payload_ships_notices(self):
+        from paddle_tpu.framework import collector
+        incident.recorder.notices.append(dict(self.NOTICE))
+        payload = collector.local_payload()
+        assert payload["incidents"][-1]["id"] == 1
+
+    def test_server_dedups_by_id_and_views(self):
+        from paddle_tpu.framework.collector import CollectorServer
+        srv = CollectorServer()
+        for seq in (1, 2):  # same cumulative queue shipped twice
+            srv._handle_report({
+                "worker": "w0", "role": "trainer", "ident": "i0",
+                "seq": seq,
+                "payload": {"incidents": [dict(self.NOTICE)]}})
+        view = srv.view()
+        assert view["workers"]["w0"]["incidents_total"] == 1
+        assert len(view["incidents"]) == 1
+        assert view["incidents"][0]["kind"] == "train.nan_skip"
+
+    def test_cluster_top_renders_and_gates(self, monkeypatch):
+        from paddle_tpu.framework.collector import CollectorServer
+        import cluster_top
+        srv = CollectorServer()
+        srv._handle_report({
+            "worker": "w0", "role": "trainer", "ident": "i0", "seq": 1,
+            "payload": {"incidents": [dict(self.NOTICE)]}})
+        view = srv.view()
+        assert cluster_top.validate_view(view) == 1
+        text = cluster_top.render(view)
+        assert "inc" in text and "-- incidents --" in text
+        assert "incident_000001" in text
+        monkeypatch.setattr(cluster_top, "fetch_view",
+                            lambda ep, timeout=None: view)
+        assert cluster_top.main(["--collector", "x:1",
+                                 "--fail-on-incident"]) == 1
+        assert cluster_top.main(["--collector", "x:1"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# perf_report incidents (ledger join)
+# ---------------------------------------------------------------------------
+
+class TestPerfReportIncidents:
+    def _ledger(self, tmp_path):
+        from paddle_tpu.framework import runlog
+        led = runlog.RunLedger(str(tmp_path / "ledger.jsonl"))
+        for i in (1, 2, 3):
+            led.append(runlog.capture(
+                kind="incident", label="train.nan_skip",
+                include_snapshot=False,
+                extra={"incident": {
+                    "id": i, "kind": "train.nan_skip", "step": i + 1,
+                    "first_bad_leaf": "aux_w", "worker": "w0",
+                    "bundle": f"/tmp/b/incident_{i:06d}"}}))
+        led.append(runlog.capture(
+            kind="incident_replay", label="train.nan_skip",
+            include_snapshot=False,
+            extra={"replay_verdict": {
+                "id": 1, "mode": "replay", "reproduced": True,
+                "kind": "train.nan_skip"}}))
+        led.append(runlog.capture(
+            kind="incident_replay", label="train.nan_skip",
+            include_snapshot=False,
+            extra={"replay_verdict": {
+                "id": 2, "mode": "bisect", "divergent_step": 3,
+                "leaf": "aux_w"}}))
+        return str(tmp_path / "ledger.jsonl")
+
+    def test_rows_join_capture_with_verdicts(self, tmp_path):
+        import perf_report
+        from paddle_tpu.framework import runlog
+        rows = perf_report.incident_rows(
+            runlog.RunLedger(self._ledger(tmp_path)).read())
+        assert [r["replay"] for r in rows] == [
+            "reproduced", "bisect:step=3,leaf=aux_w", "unreplayed"]
+        assert all(r["first_bad_leaf"] == "aux_w" for r in rows)
+
+    def test_cli_json_and_kind_filter(self, tmp_path, capsys):
+        import perf_report
+        ledger = self._ledger(tmp_path)
+        out = str(tmp_path / "inc.json")
+        assert perf_report.main(["incidents", "--ledger", ledger,
+                                 "--json", out]) == 0
+        data = json.load(open(out))
+        assert len(data["incidents"]) == 3
+        assert perf_report.main(["incidents", "--ledger", ledger,
+                                 "--kind", "parity.divergence"]) == 0
+        text = capsys.readouterr().out
+        assert "0 captured" in text

@@ -382,6 +382,59 @@ grep -q "CKPT_CHAOS_BITIDENTICAL" "$DURA/chaos.txt" || {
   exit 1; }
 rm -rf "$DURA"
 
+echo "== postmortem lane (incident capture -> deterministic replay -> first-divergence bisect; torn-bundle refusal; cheap-when-off) =="
+# the postmortem plane end-to-end: (1) capture leg — a seeded
+# train.step_grads NaN at step 3 must AUTO-capture a committed incident
+# bundle (verify_bundle-clean, flight event stamped with the id, run
+# ledger indexed).  (2) replay leg — tools/replay.py must rebuild the
+# step from the bundle's program descriptor, re-arm the recorded chaos
+# schedule, and reproduce the recorded signal naming the SAME
+# first_bad_leaf; --bisect must re-execute CLEAN and land on the
+# poisoned step BY NUMBER via the recorded trajectory hashes; both
+# verdicts land back in the ledger and perf_report incidents joins
+# them.  (3) SIGKILL leg — a capture killed mid-write leaves a torn,
+# COMMIT-less directory that verify_bundle AND replay refuse.  (4)
+# clean leg — disarmed, the poisoned run captures NOTHING; armed, the
+# loss trajectory is BITWISE identical to the disarmed one (the ring
+# is host-only reads).
+PM=$(mktemp -d /tmp/pt_postmortem.XXXXXX)
+JAX_PLATFORMS=cpu python tests/fixtures/postmortem_incident.py capture \
+    "$PM/cap" | tee "$PM/capture.txt"
+grep -q "INCIDENT_CAPTURED" "$PM/capture.txt" || {
+  echo "postmortem lane FAILED: NaN skip did not capture a bundle" >&2
+  exit 1; }
+BUNDLE=$(grep "^INCIDENT_CAPTURED " "$PM/capture.txt" | awk '{print $2}')
+LEDGER=$(grep "^INCIDENT_LEDGER " "$PM/capture.txt" | awk '{print $2}')
+JAX_PLATFORMS=cpu python tools/replay.py "$BUNDLE" --ledger "$LEDGER" \
+    | tee "$PM/replay.txt"
+grep -q "REPLAY_REPRODUCED kind=train.nan_skip first_bad_leaf=aux_w" \
+    "$PM/replay.txt" || {
+  echo "postmortem lane FAILED: replay did not reproduce the recorded leaf" >&2
+  exit 1; }
+JAX_PLATFORMS=cpu python tools/replay.py "$BUNDLE" --bisect \
+    --ledger "$LEDGER" | tee "$PM/bisect.txt"
+grep -q "BISECT_DIVERGENCE step=2 leaf=aux_w" "$PM/bisect.txt" || {
+  echo "postmortem lane FAILED: bisect did not land on the poisoned step" >&2
+  exit 1; }
+JAX_PLATFORMS=cpu python tools/perf_report.py incidents \
+    --ledger "$LEDGER" | tee "$PM/incidents.txt"
+grep -q "bisect:step=2,leaf=aux_w" "$PM/incidents.txt" || {
+  echo "postmortem lane FAILED: ledger join lost the replay verdict" >&2
+  exit 1; }
+JAX_PLATFORMS=cpu python tests/fixtures/postmortem_incident.py \
+    sigkill-parent "$PM/kill" | tee "$PM/kill.txt"
+grep -q "INCIDENT_SIGKILL_TORN" "$PM/kill.txt" || {
+  echo "postmortem lane FAILED: torn bundle not refused" >&2
+  exit 1; }
+JAX_PLATFORMS=cpu python tests/fixtures/postmortem_incident.py clean \
+    "$PM/clean" | tee "$PM/clean.txt"
+if ! grep -q "INCIDENT_DISARMED_SILENT" "$PM/clean.txt" \
+    || ! grep -q "INCIDENT_BITIDENTICAL" "$PM/clean.txt"; then
+  echo "postmortem lane FAILED: cheap-when-off gate (disarmed capture or armed bitwise drift)" >&2
+  exit 1
+fi
+rm -rf "$PM"
+
 echo "== program lint (jaxpr IR passes + jit-safety AST lint) =="
 # whole-package AST lint plus the model-zoo jaxpr passes on the cheap-
 # to-trace entries — elastic_step traces the resilient train step and
@@ -393,7 +446,7 @@ JAX_PLATFORMS=cpu python tools/prog_lint.py paddle_tpu \
     --zoo lenet --zoo transformer_encoder --zoo elastic_step \
     --zoo ps_transport --zoo ingest --zoo health --zoo zero_step \
     --zoo numerics_step --zoo runlog --zoo collector --zoo ckpt \
-    --format=json --min-severity warning
+    --zoo incident --format=json --min-severity warning
 
 echo "== API signature freeze =="
 JAX_PLATFORMS=cpu python tools/print_signatures.py --check

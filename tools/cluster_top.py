@@ -20,7 +20,9 @@ Usage::
 
 Exit status: 0 on a rendered view, 2 when the target is unreachable,
 1 with ``--fail-on-straggler`` when the view names any straggler (the
-CI gate's inverted form).
+CI gate's inverted form), 1 with ``--fail-on-incident`` when any
+worker reported a captured incident bundle (the postmortem plane's
+gate: a green run must not have auto-captured anything).
 """
 from __future__ import annotations
 
@@ -137,8 +139,8 @@ def render(view: dict) -> str:
                  f"stragglers={len(view.get('stragglers') or [])} ==")
     cols = (("worker", 16), ("role", 8), ("steps", 7), ("p50ms", 8),
             ("p99ms", 8), ("stall%", 7), ("rpc_p99", 8), ("anom", 5),
-            ("flight", 7), ("drops", 6), ("gaps", 5), ("skew", 6),
-            ("STRAG", 6))
+            ("flight", 7), ("drops", 6), ("gaps", 5), ("inc", 4),
+            ("skew", 6), ("STRAG", 6))
     lines.append("  ".join(n.rjust(w) for n, w in cols))
     for w, row in sorted((view.get("workers") or {}).items()):
         lines.append("  ".join([
@@ -153,6 +155,7 @@ def render(view: dict) -> str:
             _fmt(row.get("flight_total"), 7),
             _fmt(row.get("drops_reported"), 6),
             _fmt(row.get("gaps"), 5),
+            _fmt(row.get("incidents_total"), 4),
             _fmt(row.get("straggler_score"), 6, 2),
             _fmt(row.get("straggler"), 6),
         ]))
@@ -166,6 +169,14 @@ def render(view: dict) -> str:
                          f"pushes={t.get('pushes', 0)} "
                          f"skew={t.get('shard_skew', 1.0)}"
                          + (f"  hot: {hot}" if hot else ""))
+    incidents = view.get("incidents") or []
+    if incidents:
+        lines.append("-- incidents --")
+        for n in incidents[-8:]:
+            lines.append(f"#{n.get('id', '?')} {n.get('kind', '?')} "
+                         f"worker={n.get('worker', '?')} "
+                         f"step={n.get('step', '?')} "
+                         f"bundle={n.get('bundle', '?')}")
     flight_rows = view.get("flight") or []
     if flight_rows:
         lines.append("-- recent flight events --")
@@ -197,6 +208,9 @@ def main(argv=None) -> int:
     ap.add_argument("--fail-on-straggler", action="store_true",
                     help="exit 1 when the view names any straggler "
                          "(CI gate form)")
+    ap.add_argument("--fail-on-incident", action="store_true",
+                    help="exit 1 when any worker reported a captured "
+                         "incident bundle (postmortem CI gate form)")
     ap.add_argument("--timeout", type=float, default=None)
     a = ap.parse_args(argv)
     if (a.collector is None) == (a.ps is None):
@@ -226,6 +240,12 @@ def main(argv=None) -> int:
         if a.fail_on_straggler and view.get("stragglers"):
             print(f"cluster_top: stragglers flagged: "
                   f"{view['stragglers']}", file=sys.stderr)
+            return 1
+        if a.fail_on_incident and view.get("incidents"):
+            ids = sorted({f"{n.get('worker')}#{n.get('id')}"
+                          for n in view["incidents"]})
+            print(f"cluster_top: incidents captured: {ids}",
+                  file=sys.stderr)
             return 1
         return 0
 

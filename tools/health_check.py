@@ -77,7 +77,59 @@ if "--xla_force_host_platform_device_count" not in \
 
 __all__ = ["load_metrics", "build_report", "evaluate_gates",
            "parse_max_blame", "format_report", "mini_train",
-           "mini_train_ps", "mini_train_zero", "main"]
+           "mini_train_ps", "mini_train_zero", "build_incident_step",
+           "main"]
+
+
+# ---------------------------------------------------------------------------
+# the two-branch numerics net — module-level so the postmortem plane's
+# replay (tools/replay.py) can rebuild the exact step surface the
+# mini-train recorded an incident on
+# ---------------------------------------------------------------------------
+
+def _two_branch_net():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    class _TwoBranch(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+            self.aux_w = self.create_parameter(
+                [4], default_initializer=paddle.nn.initializer
+                .Constant(0.1))
+
+        def forward(self, x, z):
+            return self.fc(x), (self.aux_w * z).sum()
+
+    return _TwoBranch()
+
+
+def _two_branch_loss(m, x, z, y):
+    out, aux = m(x, z)
+    return ((out - y) ** 2).mean() + 1e-3 * aux
+
+
+def build_incident_step(seed: int = 0, lr: float = 0.05,
+                        max_consecutive_bad: int = 3):
+    """Replay builder (``incident.set_program`` ref
+    ``"health_check:build_incident_step"``): the resilient-wrapped
+    two-branch numerics step the mini-train records incidents on.
+    Registers itself as this process's program descriptor, so any
+    bundle captured off the returned step replays standalone."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import incident
+    from paddle_tpu.framework.resilient import ResilientTrainStep
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(int(seed))
+    net = _two_branch_net()
+    opt = paddle.optimizer.SGD(learning_rate=float(lr),
+                               parameters=net.parameters())
+    incident.set_program("health_check:build_incident_step", seed=int(seed),
+                         lr=float(lr),
+                         max_consecutive_bad=int(max_consecutive_bad))
+    return ResilientTrainStep(TrainStep(net, _two_branch_loss, opt),
+                              max_consecutive_bad=int(max_consecutive_bad))
 
 
 # ---------------------------------------------------------------------------
@@ -538,25 +590,6 @@ def mini_train(n_steps: int, trace_dir: str, numerics: bool = False,
             params = net.parameters()
         else:
             set_flags({"numerics": True})
-
-            class _TwoBranch(nn.Layer):
-                def __init__(self):
-                    super().__init__()
-                    self.fc = nn.Linear(8, 4)
-                    self.aux_w = self.create_parameter(
-                        [4], default_initializer=paddle.nn.initializer
-                        .Constant(0.1))
-
-                def forward(self, x, z):
-                    return self.fc(x), (self.aux_w * z).sum()
-
-            def loss_fn(m, x, z, y):
-                out, aux = m(x, z)
-                return ((out - y) ** 2).mean() + 1e-3 * aux
-
-            net = _TwoBranch()
-            opt = paddle.optimizer.SGD(learning_rate=0.05,
-                                       parameters=net.parameters())
             scaler = None
             if autopilot:
                 # decr_every=1: every bad step downscales, so a
@@ -565,17 +598,23 @@ def mini_train(n_steps: int, trace_dir: str, numerics: bool = False,
                 # outlast the storm so the CONTROLLER recovers, not a
                 # train.abort
                 from paddle_tpu.amp import GradScaler
+                net = _two_branch_net()
+                opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                           parameters=net.parameters())
                 scaler = GradScaler(init_loss_scaling=2.0 ** 10,
                                     decr_every_n_nan_or_inf=1)
                 step = ResilientTrainStep(
-                    TrainStep(net, loss_fn, opt), scaler=scaler,
+                    TrainStep(net, _two_branch_loss, opt), scaler=scaler,
                     max_consecutive_bad=max(10, nan_times * 2))
                 ctl = _make_controller(
                     ledger_path=autopilot_ledger,
                     dry_run=autopilot_dry_run,
                     scaler=scaler, resilient=step)
             else:
-                step = ResilientTrainStep(TrainStep(net, loss_fn, opt))
+                # the replay builder — incidents captured off this step
+                # carry the health_check:build_incident_step descriptor
+                step = build_incident_step(seed=0, lr=0.05)
+                net = step.step.model
             x = paddle.to_tensor(rng.standard_normal((16, 8))
                                  .astype(np.float32))
             z = paddle.to_tensor(rng.standard_normal((4,))

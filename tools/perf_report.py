@@ -48,6 +48,13 @@ run ledger (``paddle_tpu/framework/runlog.py``) records for:
   a ledger as ``imported_bench`` records, so the bench trajectory
   becomes a first-class compare series.
 
+* ``incidents`` — the postmortem plane's index: list ``kind=incident``
+  ledger records (one per auto-captured bundle —
+  ``framework/incident.py``) joined by incident id with the
+  ``kind=incident_replay`` verdicts ``tools/replay.py --ledger``
+  writes back, so reproduced-vs-not (and the bisected divergence
+  step) reads next to each capture.
+
 Usage::
 
     python tools/perf_report.py attribute --mini-train 3 --json prof.json --check
@@ -56,6 +63,7 @@ Usage::
     python tools/perf_report.py blame --trace-dir /tmp/tr --expect-top ps_wait
     python tools/perf_report.py compare --ledger runs/ledger.jsonl
     python tools/perf_report.py import BENCH_r0*.json --ledger runs/hist.jsonl
+    python tools/perf_report.py incidents --ledger runs/ledger.jsonl --json inc.json
 """
 from __future__ import annotations
 
@@ -75,7 +83,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 __all__ = ["attribute_profile", "format_attribute", "check_profile",
            "mini_train_cost", "leg_signal_cfg", "SUMMARY_SIGNAL_CFG",
            "build_series", "detect_series", "compare_records",
-           "format_compare", "main"]
+           "format_compare", "incident_rows", "format_incidents",
+           "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -664,6 +673,79 @@ def _cmd_import(a) -> int:
     return 0 if imported else 1
 
 
+def incident_rows(records: List[dict],
+                  kind: Optional[str] = None) -> List[dict]:
+    """Join ``kind=incident`` ledger records (the capture plane's index)
+    with ``kind=incident_replay`` verdicts (``tools/replay.py
+    --ledger``) by incident id: one row per captured incident carrying
+    its trigger kind, step, first bad leaf, bundle path, and the latest
+    replay/bisect outcome (``unreplayed`` when none landed yet)."""
+    verdicts: Dict[Any, dict] = {}
+    for rec in records:
+        if rec.get("kind") != "incident_replay":
+            continue
+        v = rec.get("replay_verdict") or {}
+        if v.get("id") is not None:
+            verdicts[v["id"]] = v       # latest wins (ledger order)
+    rows = []
+    for rec in records:
+        if rec.get("kind") != "incident":
+            continue
+        info = rec.get("incident") or {}
+        if kind and info.get("kind") != kind:
+            continue
+        v = verdicts.get(info.get("id"))
+        if v is None:
+            replay = "unreplayed"
+        elif v.get("mode") == "bisect":
+            replay = (f"bisect:step={v.get('divergent_step')}"
+                      f",leaf={v.get('leaf')}"
+                      if v.get("divergent_step") is not None
+                      else "bisect:clean")
+        else:
+            replay = "reproduced" if v.get("reproduced") \
+                else "not_reproduced"
+        rows.append({"id": info.get("id"), "kind": info.get("kind"),
+                     "step": info.get("step"),
+                     "first_bad_leaf": info.get("first_bad_leaf"),
+                     "worker": info.get("worker"),
+                     "bundle": info.get("bundle"),
+                     "ts": rec.get("ts"), "replay": replay,
+                     "verdict": v})
+    return rows
+
+
+def format_incidents(rows: List[dict]) -> str:
+    lines = [f"== incidents: {len(rows)} captured =="]
+    hdr = (("id", 4), ("kind", 22), ("step", 6), ("first_bad_leaf", 16),
+           ("replay", 26), ("bundle", 0))
+    lines.append("  ".join(n.ljust(w) for n, w in hdr))
+    for r in rows:
+        lines.append("  ".join([
+            str(r.get("id", "?")).ljust(4),
+            str(r.get("kind", "?"))[:22].ljust(22),
+            str(r.get("step", "-")).ljust(6),
+            str(r.get("first_bad_leaf") or "-")[:16].ljust(16),
+            str(r.get("replay", "?"))[:26].ljust(26),
+            str(r.get("bundle") or "-")]))
+    return "\n".join(lines)
+
+
+def _cmd_incidents(a) -> int:
+    from paddle_tpu.framework.runlog import RunLedger
+    records = RunLedger(a.ledger).read()
+    rows = incident_rows(records, kind=a.kind)
+    if a.json:
+        with open(a.json, "w") as f:
+            json.dump({"incidents": rows}, f, indent=1, default=str)
+    print(format_incidents(rows))
+    if not rows and not records:
+        print(f"perf_report incidents: no readable records in "
+              f"{a.ledger}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="perf_report.py", description=__doc__,
@@ -740,6 +822,19 @@ def main(argv=None) -> int:
     cp.add_argument("--json", default=None, metavar="PATH",
                     help="write the full verdict JSON here")
 
+    inc = sub.add_parser("incidents",
+                         help="list captured incident bundles "
+                              "(kind=incident ledger records) joined "
+                              "with their replay/bisect verdicts "
+                              "(kind=incident_replay)")
+    inc.add_argument("--ledger", required=True,
+                     help="run ledger JSONL (runlog.RunLedger)")
+    inc.add_argument("--kind", default=None,
+                     help="only incidents triggered by this flight "
+                          "kind (e.g. train.nan_skip)")
+    inc.add_argument("--json", default=None, metavar="PATH",
+                     help="write the joined rows JSON here")
+
     im = sub.add_parser("import",
                         help="fold historical BENCH_r*.json artifacts "
                              "into a ledger as imported_bench records")
@@ -754,6 +849,8 @@ def main(argv=None) -> int:
         return _cmd_blame(a)
     if a.cmd == "compare":
         return _cmd_compare(a)
+    if a.cmd == "incidents":
+        return _cmd_incidents(a)
     return _cmd_import(a)
 
 

@@ -105,6 +105,10 @@ ZOO = {
     # the generation manager, the two-slot epoch protocol, and the
     # crash-safe fs tier) — Report, like elastic_step
     "ckpt": lambda: _zoo_ckpt(),
+    # lints the postmortem plane (incident.capture fault-point hygiene
+    # in the bundle writer + the ring hook threaded through the
+    # resilient step) — Report, like elastic_step
+    "incident": lambda: _zoo_incident(),
 }
 
 
@@ -374,6 +378,25 @@ def _zoo_ckpt():
                              "auto_checkpoint.py"),
                 os.path.join("paddle_tpu", "distributed", "fleet",
                              "utils", "fs.py")):
+        sub = lint_file(os.path.join(REPO, rel))
+        sub.files_seen = [rel]
+        for d in sub.diagnostics:
+            d.file = rel
+        report.extend(sub)
+    return report
+
+
+def _zoo_incident():
+    """AST-lint the postmortem plane — ``framework/incident.py`` (which
+    threads the ``incident.capture`` chaos fault point through bundle
+    assembly under the swallow-and-count guard) plus the ring hook's
+    host (``framework/resilient.py``, whose ``train.step_grads`` site
+    carries the recovery-ownership pragma) — so PTA301/302 validate the
+    new fault-point site against the registry."""
+    from paddle_tpu.framework.analysis import Report, lint_file
+    report = Report()
+    for rel in (os.path.join("paddle_tpu", "framework", "incident.py"),
+                os.path.join("paddle_tpu", "framework", "resilient.py")):
         sub = lint_file(os.path.join(REPO, rel))
         sub.files_seen = [rel]
         for d in sub.diagnostics:

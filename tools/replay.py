@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Deterministic incident replay + first-divergence bisection.
+
+The consumer half of the postmortem plane
+(``paddle_tpu/framework/incident.py``): given one incident bundle, this
+tool re-executes the recorded step window standalone and proves — or
+disproves — that the recorded signal reproduces.
+
+* **replay** (default) — verify the bundle (a torn directory is
+  refused, exactly like the generation walk refuses a torn
+  checkpoint), rebuild the step surface from the bundle's program
+  descriptor (``module:function`` builder), restore the recorded
+  training state (inline bundle state, or the referenced checkpoint
+  generation — a GC'd generation fails LOUDLY naming ``gen_<N>``
+  rather than replaying from the wrong state), re-arm the recorded
+  flags + the mid-sequence chaos schedule
+  (``chaos.restore_state``), re-feed the ringed inputs with each
+  entry's rng state, and gate that the recorded flight kind fires
+  again with the SAME ``first_bad_leaf``.  Prints
+  ``REPLAY_REPRODUCED kind=<k> first_bad_leaf=<leaf>`` (rc 0) or
+  ``REPLAY_NOT_REPRODUCED ...`` (rc 1); refusals print
+  ``REPLAY_REFUSED``/``REPLAY_MISSING_GENERATION`` (rc 2).
+
+* ``--bisect`` — re-execute the ring with chaos DISARMED and walk the
+  recorded per-step trajectory hashes
+  (``parity.leaf_hash_host``; entry i's post-state is entry i+1's
+  pre-state, the last entry's is the live state at capture): the first
+  step whose clean re-execution hashes differently from the recorded
+  trajectory is the poisoned step — the recorded state absorbed the
+  fault there, the clean counterfactual did not.  Prints
+  ``BISECT_DIVERGENCE step=<n> leaf=<name>`` (rc 0) or
+  ``BISECT_CLEAN`` when the whole ring re-executes bit-identically
+  (rc 1 — the incident did not come from the recorded window).
+
+* ``--ledger PATH`` — append a ``kind=incident_replay`` record carrying
+  the ``replay_verdict`` back to the run ledger, so ``perf_report
+  incidents`` shows reproduced-vs-not next to each captured incident.
+
+Usage::
+
+    python tools/replay.py /path/incidents/incident_000001
+    python tools/replay.py /path/incidents/incident_000001 --bisect
+    python tools/replay.py bundle --ledger runs/ledger.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+__all__ = ["load_bundle", "build_program", "restore_state",
+           "apply_recorded_flags", "replay_signal", "bisect_ring", "main"]
+
+#: flags a replay must NOT adopt from the bundle: the capture plane
+#: itself (a replay must never capture its own incidents), producer
+#: paths (ledger/trace/collector endpoints of the ORIGINAL run), and
+#: the chaos flags (chaos.restore_state owns the schedule)
+_FLAG_SKIP = {"incident", "incident_dir", "incident_kinds", "runlog_dir",
+              "trace_dir", "flight_dir", "collector_endpoint",
+              "chaos_spec", "chaos_seed"}
+
+
+def load_bundle(path: str) -> dict:
+    """Verify + read one bundle; raises SystemExit(2) with the refusal
+    sentinel on a torn directory."""
+    from paddle_tpu.framework import incident
+    problems = incident.verify_bundle(path)
+    if problems:
+        reasons = "; ".join(f"{p.get('file')}: {p.get('reason')}"
+                            for p in problems[:4])
+        print(f"REPLAY_REFUSED bundle={path} problems={reasons}")
+        raise SystemExit(2)
+    return incident.read_manifest(path)
+
+
+def apply_recorded_flags(manifest: dict) -> None:
+    """Re-arm the recorded flag overrides (skipping the capture plane's
+    own flags and unknown names — schema skew degrades, never crashes),
+    then force the incident plane off for the replaying process."""
+    from paddle_tpu.framework import flags
+    for name, value in (manifest.get("flags_overrides") or {}).items():
+        if name in _FLAG_SKIP:
+            continue
+        try:
+            flags.set_flags({name: value})
+        except ValueError:
+            print(f"replay: skipping unknown recorded flag {name!r}",
+                  file=sys.stderr)
+    flags.set_flags({"incident": False})
+
+
+def build_program(manifest: dict):
+    """Rebuild the step surface from the bundle's program descriptor."""
+    prog = manifest.get("program")
+    if not prog or not prog.get("builder"):
+        print("REPLAY_REFUSED no program descriptor in bundle (the "
+              "recording process never called incident.set_program)")
+        raise SystemExit(2)
+    mod_name, _, fn_name = str(prog["builder"]).partition(":")
+    try:
+        mod = importlib.import_module(mod_name)
+        builder = getattr(mod, fn_name)
+    except (ImportError, AttributeError) as e:
+        print(f"REPLAY_REFUSED builder {prog['builder']!r} not "
+              f"importable: {e!r}")
+        raise SystemExit(2)
+    return builder(**(prog.get("kwargs") or {}))
+
+
+def restore_state(step, manifest: dict, bundle: str) -> None:
+    """Restore the recorded pre-window training state into the rebuilt
+    step: the inline bundle state, or the referenced checkpoint
+    generation — which must still exist, committed and verified; a GC'd
+    generation fails loudly BY NAME instead of replaying from whatever
+    state the fresh builder happened to initialize."""
+    from paddle_tpu.distributed import checkpoint
+    from paddle_tpu.framework.incident import STATE_DIRNAME, train_surface
+    surface = train_surface(step)
+    state = manifest.get("state") or {}
+    if state.get("inline"):
+        sdir = os.path.join(bundle, state.get("dir") or STATE_DIRNAME)
+        checkpoint.load_train_state(surface, sdir)
+        return
+    ref = state.get("ref")
+    if not ref or ref.get("generation") is None:
+        print("REPLAY_REFUSED bundle has neither inline state nor a "
+              "checkpoint generation ref (state exceeded "
+              "FLAGS_incident_state_cap_mb with no durable manager "
+              "attached)")
+        raise SystemExit(2)
+    gen = int(ref["generation"])
+    gen_name = f"gen_{gen:08d}"
+    gen_dir = os.path.join(str(ref.get("root") or ""), gen_name)
+    if not os.path.isdir(gen_dir) or not checkpoint.is_committed(gen_dir):
+        print(f"REPLAY_MISSING_GENERATION {gen_name} root={ref.get('root')}"
+              " (GC'd or never committed — refusing to replay from the "
+              "wrong state)")
+        raise SystemExit(2)
+    problems = checkpoint.verify_checkpoint(gen_dir, deep=True)
+    if problems:
+        print(f"REPLAY_MISSING_GENERATION {gen_name} "
+              f"root={ref.get('root')} (corrupt: "
+              + "; ".join(sorted({p['reason'] for p in problems})) + ")")
+        raise SystemExit(2)
+    checkpoint.load_train_state(surface, gen_dir)
+
+
+def _materialize_inputs(bundle: str, entry: dict):
+    from paddle_tpu.framework import incident
+    import paddle_tpu as paddle
+    loaded = incident.load_ring_entry(bundle, entry)
+    args = []
+    for kind, arr in loaded["inputs"]:
+        args.append(paddle.to_tensor(arr) if kind == "tensor" else arr)
+    return args, loaded["rng"]
+
+
+def _run_ring(step, manifest: dict, bundle: str):
+    """Re-execute every ringed step (entry rng re-armed per step),
+    yielding (entry, loss) — shared by the replay and bisect legs."""
+    from paddle_tpu.tensor.random import set_rng_state
+    for entry in manifest.get("ring", []):
+        args, rng = _materialize_inputs(bundle, entry)
+        if rng is not None:
+            set_rng_state(rng)
+        yield entry, step(*args)
+
+
+def replay_signal(step, manifest: dict, bundle: str) -> dict:
+    """The reproduction gate: re-arm the recorded chaos schedule, re-run
+    the ring, and require the recorded flight kind (same
+    ``first_bad_leaf`` when one was recorded) to fire again."""
+    from paddle_tpu.framework import chaos
+    from paddle_tpu.framework.observability import flight
+    chaos.restore_state(manifest.get("chaos") or {})
+    want_kind = (manifest.get("event") or {}).get("kind")
+    want_leaf = ((manifest.get("event") or {}).get("attrs") or {}) \
+        .get("first_bad_leaf")
+    seq0 = flight.last_seq()
+    for _entry, _loss in _run_ring(step, manifest, bundle):
+        pass
+    got_kind, got_leaf = None, None
+    for ev in flight.since(seq0, limit=1024):
+        if ev.get("kind") == want_kind:
+            got_kind = ev["kind"]
+            got_leaf = (ev.get("attrs") or {}).get("first_bad_leaf")
+            break
+    reproduced = got_kind == want_kind and \
+        (want_leaf is None or got_leaf == want_leaf)
+    return {"reproduced": bool(reproduced), "kind": want_kind,
+            "recorded_first_bad_leaf": want_leaf,
+            "replayed_first_bad_leaf": got_leaf}
+
+
+def bisect_ring(step, manifest: dict, bundle: str) -> dict:
+    """The counterfactual walk: chaos DISARMED, re-execute the ring and
+    compare each step's post-state hashes to the recorded trajectory.
+    The first mismatching step is the one whose recorded execution
+    absorbed the fault."""
+    from paddle_tpu.framework import chaos, incident
+    chaos.reset()
+    trajectory = manifest.get("trajectory") or []
+    post = manifest.get("post_hashes")
+    ring = manifest.get("ring", [])
+    i = 0
+    for entry, _loss in _run_ring(step, manifest, bundle):
+        expected = trajectory[i + 1].get("pre_hashes") \
+            if i + 1 < len(trajectory) else post
+        i += 1
+        if not expected:
+            continue
+        live = incident.hash_step_state(step)
+        for leaf in sorted(expected):
+            if live.get(leaf) != int(expected[leaf]):
+                return {"divergent_step": entry.get("step"),
+                        "leaf": leaf, "entries_walked": i,
+                        "entries_total": len(ring)}
+    return {"divergent_step": None, "leaf": None,
+            "entries_walked": i, "entries_total": len(ring)}
+
+
+def _write_verdict(ledger_path: str, manifest: dict, verdict: dict):
+    from paddle_tpu.framework import runlog
+    rec = runlog.capture(
+        kind="incident_replay",
+        label=(manifest.get("event") or {}).get("kind"),
+        include_snapshot=False,
+        extra={"replay_verdict": dict(verdict,
+                                      id=manifest.get("incident_id"))})
+    runlog.RunLedger(ledger_path).append(rec)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="replay.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("bundle", help="incident bundle directory "
+                                   "(incident_<NNNNNN>/)")
+    ap.add_argument("--bisect", action="store_true",
+                    help="clean-leg first-divergence walk instead of "
+                         "the signal-reproduction replay")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="append the replay_verdict to this run ledger "
+                         "(kind=incident_replay)")
+    a = ap.parse_args(argv)
+
+    bundle = os.path.abspath(a.bundle)
+    manifest = load_bundle(bundle)
+    iid = manifest.get("incident_id")
+    if not manifest.get("ring"):
+        print(f"REPLAY_REFUSED incident {iid}: empty input ring — "
+              "nothing to re-execute")
+        return 2
+    apply_recorded_flags(manifest)
+    step = build_program(manifest)
+    restore_state(step, manifest, bundle)
+
+    if a.bisect:
+        verdict = bisect_ring(step, manifest, bundle)
+        verdict["mode"] = "bisect"
+        if a.ledger:
+            _write_verdict(a.ledger, manifest, verdict)
+        if verdict["divergent_step"] is None:
+            print(f"BISECT_CLEAN incident={iid} "
+                  f"entries={verdict['entries_total']}")
+            return 1
+        print(f"BISECT_DIVERGENCE step={verdict['divergent_step']} "
+              f"leaf={verdict['leaf']} incident={iid}")
+        return 0
+
+    verdict = replay_signal(step, manifest, bundle)
+    verdict["mode"] = "replay"
+    if a.ledger:
+        _write_verdict(a.ledger, manifest, verdict)
+    if verdict["reproduced"]:
+        print(f"REPLAY_REPRODUCED kind={verdict['kind']} "
+              f"first_bad_leaf={verdict['recorded_first_bad_leaf']} "
+              f"incident={iid}")
+        return 0
+    print(f"REPLAY_NOT_REPRODUCED kind={verdict['kind']} "
+          f"recorded={verdict['recorded_first_bad_leaf']} "
+          f"replayed={verdict['replayed_first_bad_leaf']} incident={iid}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
